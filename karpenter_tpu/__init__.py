@@ -1,0 +1,27 @@
+"""karpenter_tpu — a TPU-native provisioning/scheduling framework.
+
+A brand-new framework with the capabilities of kubernetes-sigs/karpenter
+(reference at /root/reference): watch unschedulable pods, evaluate their
+scheduling constraints, provision right-sized nodes, and consolidate or
+remove nodes no longer needed.
+
+Unlike the reference's pod-by-pod first-fit-decreasing Go simulation
+(reference: pkg/controllers/provisioning/scheduling/scheduler.go:270-339),
+the decision kernel here is a dense (pods x instance-types x resources)
+feasibility/cost tensor solved in batch on TPU with JAX/XLA, behind a
+pluggable Solver seam. The host-side Python FFD packer mirrors the Go
+semantics exactly and serves as the parity/cost oracle.
+
+Layout:
+  api/            data model: resources, labels, taints, requirements, objects
+  scheduling/     host-side scheduling library (queue, preferences, topology)
+  ops/            JAX kernels: feasibility, packing scan, topology tensors
+  solver/         snapshot encoding (vocab interning) + solver drivers + oracle
+  parallel/       device mesh / sharding for multi-chip solves
+  controllers/    provisioning, disruption, state, lifecycle, termination, ...
+  cloudprovider/  SPI + kwok-style and fake providers
+  kube/           in-process object store standing in for the kube-apiserver
+  utils/          shared helpers
+"""
+
+__version__ = "0.1.0"

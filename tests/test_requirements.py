@@ -1,0 +1,247 @@
+"""Requirements algebra parity tests.
+
+Behavioral tables mirror the reference's pkg/scheduling/requirements_test.go
+and requirement.go semantics: operator pair intersections, complement sets,
+Gt/Lt bounds, compatibility asymmetry for custom vs well-known labels, and
+the double-negation exemption.
+"""
+
+from karpenter_tpu.api import labels
+from karpenter_tpu.api.requirements import Operator, Requirement, Requirements
+
+A_IN = lambda *v: Requirement("key", Operator.IN, v)
+A_NOT_IN = lambda *v: Requirement("key", Operator.NOT_IN, v)
+EXISTS = lambda: Requirement("key", Operator.EXISTS)
+DOES_NOT_EXIST = lambda: Requirement("key", Operator.DOES_NOT_EXIST)
+GT = lambda v: Requirement("key", Operator.GT, [str(v)])
+LT = lambda v: Requirement("key", Operator.LT, [str(v)])
+
+
+class TestOperatorInference:
+    def test_operators(self):
+        assert A_IN("a").operator() is Operator.IN
+        assert A_NOT_IN("a").operator() is Operator.NOT_IN
+        assert EXISTS().operator() is Operator.EXISTS
+        assert DOES_NOT_EXIST().operator() is Operator.DOES_NOT_EXIST
+        assert GT(1).operator() is Operator.GT
+        assert LT(1).operator() is Operator.LT
+
+    def test_in_empty_is_does_not_exist(self):
+        assert Requirement("key", Operator.IN, []).operator() is Operator.DOES_NOT_EXIST
+
+
+class TestHas:
+    def test_in(self):
+        r = A_IN("a", "b")
+        assert r.has("a") and r.has("b") and not r.has("c")
+
+    def test_not_in(self):
+        r = A_NOT_IN("a")
+        assert not r.has("a") and r.has("b")
+
+    def test_exists_and_does_not_exist(self):
+        assert EXISTS().has("anything")
+        assert not DOES_NOT_EXIST().has("anything")
+
+    def test_gt_lt(self):
+        assert GT(5).has("6") and not GT(5).has("5")
+        assert LT(5).has("4") and not LT(5).has("5")
+        # non-numeric values fail bounds (requirement.go:313-326)
+        assert not GT(5).has("abc")
+
+
+class TestIntersection:
+    def test_in_in(self):
+        r = A_IN("a", "b").intersection(A_IN("b", "c"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_in_in_disjoint(self):
+        r = A_IN("a").intersection(A_IN("b"))
+        assert r.operator() is Operator.DOES_NOT_EXIST
+
+    def test_in_not_in(self):
+        r = A_IN("a", "b").intersection(A_NOT_IN("b"))
+        assert r.values == {"a"} and not r.complement
+
+    def test_not_in_not_in(self):
+        r = A_NOT_IN("a").intersection(A_NOT_IN("b"))
+        assert r.complement and r.values == {"a", "b"}
+
+    def test_exists_in(self):
+        r = EXISTS().intersection(A_IN("a"))
+        assert not r.complement and r.values == {"a"}
+
+    def test_does_not_exist_absorbs(self):
+        r = DOES_NOT_EXIST().intersection(A_IN("a"))
+        assert r.operator() is Operator.DOES_NOT_EXIST
+
+    def test_gt_lt_band(self):
+        r = GT(1).intersection(LT(5))
+        assert r.complement
+        assert r.has("2") and r.has("4")
+        assert not r.has("1") and not r.has("5")
+
+    def test_gt_lt_empty_band(self):
+        # greaterThan >= lessThan collapses to DoesNotExist (requirement.go:160-166)
+        r = GT(5).intersection(LT(5))
+        assert r.operator() is Operator.DOES_NOT_EXIST
+
+    def test_bounds_filter_concrete_values(self):
+        r = A_IN("1", "3", "7").intersection(GT(2))
+        assert r.values == {"3", "7"} and not r.complement
+
+    def test_bounds_dropped_for_concrete_result(self):
+        # reference: requirement.go:184-187
+        r = A_IN("3").intersection(GT(1))
+        assert r.greater_than is None and r.less_than is None
+
+    def test_min_values_max_wins(self):
+        a = Requirement("key", Operator.IN, ["a", "b"], min_values=1)
+        b = Requirement("key", Operator.IN, ["a", "b"], min_values=2)
+        assert a.intersection(b).min_values == 2
+
+    def test_commutative_on_allowed_sets(self):
+        import itertools
+
+        universe = ["a", "b", "c", "1", "5", "9"]
+        reqs = [
+            A_IN("a", "1"),
+            A_IN("b", "5", "9"),
+            A_NOT_IN("a", "9"),
+            EXISTS(),
+            DOES_NOT_EXIST(),
+            GT(2),
+            LT(7),
+        ]
+        for x, y in itertools.product(reqs, reqs):
+            lhs, rhs = x.intersection(y), y.intersection(x)
+            for v in universe:
+                assert lhs.has(v) == rhs.has(v), (x, y, v)
+
+
+class TestHasIntersection:
+    def test_matches_intersection_nonemptiness(self):
+        import itertools
+
+        reqs = [
+            A_IN("a", "1"),
+            A_IN("b"),
+            A_NOT_IN("a"),
+            EXISTS(),
+            DOES_NOT_EXIST(),
+            GT(0),
+            LT(2),
+        ]
+        for x, y in itertools.product(reqs, reqs):
+            got = x.has_intersection(y)
+            inter = x.intersection(y)
+            # complement results are never empty; concrete results are
+            # non-empty iff values remain
+            expected = inter.complement or bool(inter.values)
+            assert got == expected, (x, y)
+
+    def test_complement_pair_always_intersects(self):
+        assert A_NOT_IN("a").has_intersection(A_NOT_IN("b"))
+        assert EXISTS().has_intersection(GT(1000000))
+
+
+class TestRequirements:
+    def test_add_intersects_same_key(self):
+        reqs = Requirements(A_IN("a", "b"))
+        reqs.add(A_IN("b", "c"))
+        assert reqs.get("key").values == {"b"}
+
+    def test_get_undefined_is_exists(self):
+        reqs = Requirements()
+        assert reqs.get("missing").operator() is Operator.EXISTS
+
+    def test_label_normalization(self):
+        r = Requirement("beta.kubernetes.io/arch", Operator.IN, ["amd64"])
+        assert r.key == labels.ARCH
+
+    def test_from_labels(self):
+        reqs = Requirements.from_labels({"a": "1", "b": "2"})
+        assert reqs.get("a").values == {"1"}
+
+
+class TestCompatible:
+    """Asymmetric compatibility (requirements.go:177-196)."""
+
+    def test_well_known_undefined_allowed(self):
+        node = Requirements()
+        pod = Requirements(Requirement(labels.TOPOLOGY_ZONE, Operator.IN, ["zone-1"]))
+        assert node.compatible(pod, labels.WELL_KNOWN_LABELS) is None
+
+    def test_custom_undefined_denied(self):
+        node = Requirements()
+        pod = Requirements(Requirement("example.com/team", Operator.IN, ["infra"]))
+        assert node.compatible(pod, labels.WELL_KNOWN_LABELS) is not None
+
+    def test_custom_defined_must_intersect(self):
+        node = Requirements(Requirement("example.com/team", Operator.IN, ["web"]))
+        pod = Requirements(Requirement("example.com/team", Operator.IN, ["infra"]))
+        assert node.compatible(pod, labels.WELL_KNOWN_LABELS) is not None
+        pod2 = Requirements(Requirement("example.com/team", Operator.IN, ["web"]))
+        assert node.compatible(pod2, labels.WELL_KNOWN_LABELS) is None
+
+    def test_custom_undefined_negative_op_allowed(self):
+        # NotIn/DoesNotExist on an undefined custom label is satisfiable
+        node = Requirements()
+        pod = Requirements(Requirement("example.com/team", Operator.NOT_IN, ["infra"]))
+        assert node.compatible(pod, labels.WELL_KNOWN_LABELS) is None
+
+    def test_without_allow_undefined_well_known_denied(self):
+        # the strict direction: no allowance set
+        node = Requirements()
+        pod = Requirements(Requirement(labels.TOPOLOGY_ZONE, Operator.IN, ["zone-1"]))
+        assert node.compatible(pod) is not None
+
+
+class TestIntersects:
+    def test_disjoint_errors(self):
+        a = Requirements(A_IN("a"))
+        b = Requirements(A_IN("b"))
+        assert a.intersects(b) is not None
+
+    def test_double_negation_exempt(self):
+        # NotIn vs DoesNotExist has empty intersection but is allowed
+        # (requirements.go:247-254)
+        a = Requirements(DOES_NOT_EXIST())
+        b = Requirements(A_NOT_IN("x"))
+        assert a.intersects(b) is None
+
+    def test_negative_vs_positive_not_exempt(self):
+        a = Requirements(A_IN("x"))
+        b = Requirements(DOES_NOT_EXIST())
+        assert a.intersects(b) is not None
+
+    def test_non_overlapping_keys_ignored(self):
+        a = Requirements(Requirement("k1", Operator.IN, ["a"]))
+        b = Requirements(Requirement("k2", Operator.IN, ["b"]))
+        assert a.intersects(b) is None
+
+
+class TestLabelPolicy:
+    def test_well_known_is_restricted_node_label(self):
+        # reference labels.go:120-138: well-known labels are cloud-provider
+        # owned and must not be injected from requirements
+        assert labels.is_restricted_node_label(labels.TOPOLOGY_ZONE)
+        assert labels.is_restricted_label(labels.TOPOLOGY_ZONE) is None
+
+    def test_unprefixed_key_unrestricted(self):
+        # GetLabelDomain returns "" for slash-less keys (labels.go:140-145)
+        assert not labels.is_restricted_node_label("my.kubernetes.io")
+
+    def test_restricted_domain(self):
+        assert labels.is_restricted_node_label("kubernetes.io/foo")
+        assert labels.is_restricted_label("kubernetes.io/foo") is not None
+
+    def test_domain_exception(self):
+        assert not labels.is_restricted_node_label("node-restriction.kubernetes.io/team")
+
+    def test_labels_omit_well_known(self):
+        reqs = Requirements(
+            Requirement(labels.TOPOLOGY_ZONE, Operator.EXISTS),
+            Requirement("example.com/team", Operator.IN, ["web"]),
+        )
+        assert reqs.labels() == {"example.com/team": "web"}

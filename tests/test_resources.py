@@ -1,0 +1,88 @@
+"""Resource quantity algebra tests (mirrors reference pkg/utils/resources semantics)."""
+
+import pytest
+
+from karpenter_tpu.api import resources as res
+
+
+class TestParseQuantity:
+    def test_whole_units(self):
+        assert res.parse_quantity("1") == 1000
+        assert res.parse_quantity("16") == 16000
+        assert res.parse_quantity(2) == 2000
+
+    def test_milli(self):
+        assert res.parse_quantity("100m") == 100
+        assert res.parse_quantity("1500m") == 1500
+
+    def test_binary_suffixes(self):
+        assert res.parse_quantity("1Ki") == 1024 * 1000
+        assert res.parse_quantity("1Gi") == 2**30 * 1000
+        assert res.parse_quantity("1.5Gi") == int(1.5 * 2**30) * 1000
+
+    def test_decimal_suffixes(self):
+        assert res.parse_quantity("1k") == 10**3 * 1000
+        assert res.parse_quantity("2G") == 2 * 10**9 * 1000
+
+    def test_scientific(self):
+        assert res.parse_quantity("1e3") == 10**3 * 1000
+
+    def test_decimal_fraction(self):
+        assert res.parse_quantity("0.5") == 500
+        assert res.parse_quantity("2.5") == 2500
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            res.parse_quantity("abc")
+        with pytest.raises(ValueError):
+            res.parse_quantity("1Zi")
+
+    def test_roundtrip_format(self):
+        assert res.format_quantity(res.parse_quantity("2")) == "2"
+        assert res.format_quantity(res.parse_quantity("100m")) == "100m"
+
+
+class TestResourceListOps:
+    def test_merge(self):
+        a = {"cpu": 1000, "memory": 2000}
+        b = {"cpu": 500, "pods": 1000}
+        assert res.merge(a, b) == {"cpu": 1500, "memory": 2000, "pods": 1000}
+
+    def test_merge_empty(self):
+        assert res.merge() == {}
+
+    def test_subtract_keeps_lhs_keys_only(self):
+        # reference: resources.go:81-94 — rhs-only keys are dropped
+        a = {"cpu": 1000, "memory": 2000}
+        b = {"cpu": 400, "gpu": 7}
+        assert res.subtract(a, b) == {"cpu": 600, "memory": 2000}
+
+    def test_fits_basic(self):
+        assert res.fits({"cpu": 500}, {"cpu": 1000, "memory": 100})
+        assert not res.fits({"cpu": 1500}, {"cpu": 1000})
+
+    def test_fits_missing_total_resource_is_zero(self):
+        assert not res.fits({"gpu": 1}, {"cpu": 1000})
+        assert res.fits({"gpu": 0}, {"cpu": 1000})
+
+    def test_fits_negative_total_never_fits(self):
+        # reference: resources.go:218-222
+        assert not res.fits({}, {"cpu": -1})
+        assert not res.fits({"memory": 1}, {"cpu": -5, "memory": 100})
+
+    def test_max_resources(self):
+        assert res.max_resources({"cpu": 1, "memory": 5}, {"cpu": 3}) == {"cpu": 3, "memory": 5}
+
+    def test_resource_names_ordering(self):
+        names = res.resource_names([{"gpu": 1}, {"cpu": 2, "foo": 3}])
+        assert names[:2] == ["cpu", "memory"]
+        assert set(names) == {"cpu", "memory", "gpu", "foo"}
+
+
+class TestNegativeQuantities:
+    def test_negative_whole(self):
+        assert res.parse_quantity("-2") == -2000
+
+    def test_negative_fraction_ceils(self):
+        # milli-scale ceiling: ceil(-1.5) == -1
+        assert res.parse_quantity("-1.5m") == -1

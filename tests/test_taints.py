@@ -1,0 +1,86 @@
+"""Taint / toleration matching tests (reference: pkg/scheduling/taints.go)."""
+
+from karpenter_tpu.api import taints
+from karpenter_tpu.api.objects import Pod, PodSpec, Taint, Toleration
+
+
+def taint(key="k", value="v", effect=taints.NO_SCHEDULE):
+    return Taint(key=key, value=value, effect=effect)
+
+
+class TestToleratesTaint:
+    def test_exact_equal(self):
+        t = Toleration(key="k", operator="Equal", value="v", effect=taints.NO_SCHEDULE)
+        assert taints.tolerates_taint(t, taint())
+
+    def test_value_mismatch(self):
+        t = Toleration(key="k", operator="Equal", value="other", effect=taints.NO_SCHEDULE)
+        assert not taints.tolerates_taint(t, taint())
+
+    def test_exists_ignores_value(self):
+        t = Toleration(key="k", operator="Exists", effect=taints.NO_SCHEDULE)
+        assert taints.tolerates_taint(t, taint())
+
+    def test_empty_effect_matches_all(self):
+        t = Toleration(key="k", operator="Exists")
+        assert taints.tolerates_taint(t, taint(effect=taints.NO_EXECUTE))
+
+    def test_empty_key_exists_matches_everything(self):
+        t = Toleration(operator="Exists")
+        assert taints.tolerates_taint(t, taint(key="anything"))
+
+    def test_effect_mismatch(self):
+        t = Toleration(key="k", operator="Exists", effect=taints.NO_SCHEDULE)
+        assert not taints.tolerates_taint(t, taint(effect=taints.NO_EXECUTE))
+
+
+class TestTolerates:
+    def test_all_taints_must_be_tolerated(self):
+        ts = [taint(key="a"), taint(key="b")]
+        tols = [Toleration(key="a", operator="Exists", effect=taints.NO_SCHEDULE)]
+        err = taints.tolerates(ts, tols)
+        assert err is not None and "b" in err
+
+    def test_pod_path(self):
+        pod = Pod(spec=PodSpec(tolerations=[Toleration(operator="Exists")]))
+        assert taints.tolerates_pod([taint()], pod) is None
+
+    def test_empty_taints_ok(self):
+        assert taints.tolerates([], []) is None
+
+
+class TestMerge:
+    def test_first_wins_per_key_effect(self):
+        a = [taint(key="k", value="v1")]
+        b = [taint(key="k", value="v2"), taint(key="other")]
+        merged = taints.merge(a, b)
+        assert len(merged) == 2
+        assert merged[0].value == "v1"
+
+
+class TestEphemeral:
+    def test_known_ephemeral(self):
+        assert taints.is_ephemeral(
+            Taint(key=taints.TAINT_NODE_NOT_READY, effect=taints.NO_SCHEDULE)
+        )
+
+    def test_unregistered_taint(self):
+        from karpenter_tpu.api import labels
+
+        assert taints.is_ephemeral(
+            Taint(key=labels.UNREGISTERED_TAINT_KEY, effect=taints.NO_EXECUTE)
+        )
+
+    def test_ordinary_not_ephemeral(self):
+        assert not taints.is_ephemeral(taint())
+
+
+class TestKubernetesParity:
+    def test_exists_with_value_never_tolerates(self):
+        # corev1.Toleration.ToleratesTaint: Exists requires empty value
+        t = Toleration(key="k", operator="Exists", value="v", effect=taints.NO_SCHEDULE)
+        assert not taints.tolerates_taint(t, taint())
+
+    def test_unknown_operator_never_tolerates(self):
+        t = Toleration(key="k", operator="Equals", value="v", effect=taints.NO_SCHEDULE)
+        assert not taints.tolerates_taint(t, taint())

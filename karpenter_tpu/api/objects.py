@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import itertools
 import uuid
-from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 from . import labels as labels_mod
 from . import resources as res
-from .requirements import Operator, Requirement, Requirements
+from .requirements import Requirement, Requirements
 
 _uid_counter = itertools.count(1)
 
@@ -261,11 +261,10 @@ class Condition:
 
 
 class ConditionSet:
-    """Minimal condition bookkeeping with root-Ready aggregation."""
+    """Minimal condition bookkeeping over a NodeClaim/NodePool status."""
 
-    def __init__(self, conditions: List[Condition], clock=None):
+    def __init__(self, conditions: List[Condition]):
         self._conditions = conditions
-        self._clock = clock
 
     def get(self, cond_type: str) -> Optional[Condition]:
         for c in self._conditions:
@@ -278,19 +277,21 @@ class ConditionSet:
         return c is not None and c.status == "True"
 
     def set(self, cond_type: str, status: str, reason: str = "", message: str = "", now: float = 0.0) -> bool:
+        """Upsert; returns True when anything changed. The transition time
+        only moves when the status flips."""
         c = self.get(cond_type)
         if c is None:
             self._conditions.append(
                 Condition(cond_type, status, reason, message, last_transition_time=now)
             )
             return True
-        if c.status != status or c.reason != reason:
-            c.status = status
-            c.reason = reason
-            c.message = message
+        changed = (c.status, c.reason, c.message) != (status, reason, message)
+        if c.status != status:
             c.last_transition_time = now
-            return True
-        return False
+        c.status = status
+        c.reason = reason
+        c.message = message
+        return changed
 
     def clear(self, cond_type: str) -> None:
         self._conditions[:] = [c for c in self._conditions if c.type != cond_type]
@@ -298,7 +299,7 @@ class ConditionSet:
 
 @dataclass
 class NodeClassRef:
-    group: str = "karpenter.tpu"
+    group: str = labels_mod.GROUP
     kind: str = "KWOKNodeClass"
     name: str = "default"
 

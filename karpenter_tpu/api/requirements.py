@@ -197,7 +197,9 @@ class Requirement:
             return min(self.values)  # deterministic, unlike the reference's map order
         if op in (Operator.NOT_IN, Operator.EXISTS):
             lo = (self.greater_than + 1) if self.greater_than is not None else 0
-            hi = self.less_than if self.less_than is not None else 2**31
+            hi = self.less_than if self.less_than is not None else 2**63
+            if hi <= lo:
+                return ""
             for _ in range(64):
                 candidate = str(random.randrange(lo, hi))
                 if candidate not in self.values:
@@ -259,6 +261,29 @@ class Requirement:
         return f"{self.key} {op.value} {sorted(self.values)}"
 
 
+class IntersectsError:
+    """Deferred-formatting intersection failure (reference badKeyError,
+    requirements.go:219-230): built from the failing (key, incoming,
+    existing) triples, stringified only if anyone actually reads it."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items
+
+    def __str__(self) -> str:
+        return "; ".join(
+            f"key {key}, {incoming!r} not in {existing!r}"
+            for key, incoming, existing in self.items
+        )
+
+    def __repr__(self) -> str:
+        return str(self)
+
+    def __contains__(self, needle: str) -> bool:
+        return needle in str(self)
+
+
 class Requirements:
     """Keyed requirement collection (reference: requirements.go:36-45).
 
@@ -318,14 +343,13 @@ class Requirements:
     ) -> bool:
         return self.compatible(other, allow_undefined) is None
 
-    def compatible(
-        self, other: "Requirements", allow_undefined: FrozenSet[str] = frozenset()
-    ) -> Optional[str]:
+    def compatible(self, other: "Requirements", allow_undefined: FrozenSet[str] = frozenset()):
         """Asymmetric compatibility (reference: requirements.go:177-196).
 
         Custom labels (not in ``allow_undefined``) that ``other`` constrains
         positively must be defined on self; well-known labels may be
-        undefined. Returns an error string or None.
+        undefined. Returns a stringable error (str or IntersectsError) or
+        None.
         """
         for key in other.keys():
             if key in allow_undefined:
@@ -336,11 +360,14 @@ class Requirements:
             return f"label {key!r} does not have known values"
         return self.intersects(other)
 
-    def intersects(self, other: "Requirements") -> Optional[str]:
+    def intersects(self, other: "Requirements") -> Optional["IntersectsError"]:
         """Overlap check over shared keys with the double-negation exemption
-        (reference: requirements.go:241-262). Returns error string or None.
+        (reference: requirements.go:241-262). Returns a lazily-formatted error
+        or None — most callers only test for None on the hot path, so no
+        strings are built here (mirrors the reference's lazy badKeyError,
+        requirements.go:219-230).
         """
-        errs = []
+        errs = None
         small, large = (
             (self._by_key, other._by_key)
             if len(self._by_key) <= len(other._by_key)
@@ -355,8 +382,10 @@ class Requirements:
                 if incoming.operator() in (Operator.NOT_IN, Operator.DOES_NOT_EXIST):
                     if existing.operator() in (Operator.NOT_IN, Operator.DOES_NOT_EXIST):
                         continue
-                errs.append(f"key {key}, {incoming!r} not in {existing!r}")
-        return "; ".join(errs) if errs else None
+                if errs is None:
+                    errs = []
+                errs.append((key, incoming, existing))
+        return IntersectsError(errs) if errs else None
 
     def labels(self) -> Dict[str, str]:
         """Concrete node labels implied by the requirements

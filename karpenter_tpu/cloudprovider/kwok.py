@@ -1,0 +1,183 @@
+"""kwok-style reference provider: NodeClaims become Nodes with no kubelet.
+
+Mirror of the reference harness (kwok/cloudprovider/cloudprovider.go:44-216):
+``create`` picks the cheapest compatible offering, synthesizes the Node's
+labels from the claim requirements + instance type, and registers the Node
+after ``registration_delay`` simulated seconds (the reference does this on a
+goroutine; here registrations are flushed by ``process_registrations``, driven
+by the controller loop or tests).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..api import labels as labels_mod
+from ..api import resources as res
+from ..api import taints as taints_mod
+from ..api.objects import Node, NodeClaim, NodeStatus, ObjectMeta, Taint
+from ..api.requirements import Requirements
+from ..kube import Client
+from . import corpus
+from .types import (
+    CloudProvider,
+    InstanceType,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    Offering,
+    available,
+    cheapest,
+    compatible_offerings,
+)
+
+
+@dataclass
+class KwokInstance:
+    provider_id: str
+    node: Node
+    instance_type: InstanceType
+    offering: Offering
+    terminated: bool = False
+
+
+class KwokCloudProvider(CloudProvider):
+    def __init__(
+        self,
+        client: Client,
+        instance_types: Optional[Sequence[InstanceType]] = None,
+        registration_delay: float = 0.0,
+    ):
+        self._client = client
+        self._instance_types = list(instance_types if instance_types is not None else corpus.generate())
+        self._by_name = {it.name: it for it in self._instance_types}
+        self._instances: Dict[str, KwokInstance] = {}
+        self._pending: List[tuple] = []  # (due_time, KwokInstance)
+        self._registration_delay = registration_delay
+        self._seq = itertools.count(1)
+
+    def name(self) -> str:
+        return "kwok"
+
+    # -- SPI ---------------------------------------------------------------
+
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        reqs = node_claim.spec.scheduling_requirements()
+        # cheapest compatible (instance type, offering) pair, mirroring
+        # kwok/cloudprovider/cloudprovider.go:168-216
+        best = None
+        for it in self._instance_types:
+            if reqs.intersects(it.requirements) is not None:
+                continue
+            ofs = compatible_offerings(available(it.offerings), reqs)
+            # also respect requirements tightened to the instance type
+            merged = Requirements(*reqs.values())
+            merged.add(*it.requirements.values())
+            ofs = compatible_offerings(ofs, merged)
+            of = cheapest(ofs)
+            if of is not None and (best is None or of.price < best[1].price):
+                best = (it, of)
+        if best is None:
+            raise InsufficientCapacityError(
+                f"no compatible instance type/offering for {node_claim.name}"
+            )
+        it, offering = best
+        provider_id = f"kwok://{node_claim.name}-{next(self._seq)}"
+
+        node = self._to_node(node_claim, it, offering, provider_id)
+        instance = KwokInstance(provider_id, node, it, offering)
+        self._instances[provider_id] = instance
+
+        now = self._client.clock.now()
+        self._pending.append((now + self._registration_delay, instance))
+
+        node_claim.status.provider_id = provider_id
+        node_claim.status.image_id = f"kwok-image-{it.name}"
+        node_claim.status.capacity = dict(it.capacity)
+        node_claim.status.allocatable = dict(it.allocatable())
+        node_claim.metadata.labels.setdefault(labels_mod.INSTANCE_TYPE, it.name)
+        node_claim.metadata.labels.setdefault(
+            labels_mod.CAPACITY_TYPE_LABEL_KEY, offering.capacity_type()
+        )
+        node_claim.metadata.labels.setdefault(labels_mod.TOPOLOGY_ZONE, offering.zone())
+        return node_claim
+
+    def _to_node(
+        self, claim: NodeClaim, it: InstanceType, offering: Offering, provider_id: str
+    ) -> Node:
+        node_labels = dict(claim.metadata.labels)
+        # concrete values for every instance-type requirement key
+        for req in it.requirements:
+            v = req.any()
+            if v:
+                node_labels[req.key] = v
+        node_labels[labels_mod.INSTANCE_TYPE] = it.name
+        node_labels[labels_mod.TOPOLOGY_ZONE] = offering.zone()
+        node_labels[labels_mod.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type()
+        # claim requirements refine labels (e.g. a specific zone subset)
+        for req in claim.spec.scheduling_requirements():
+            if req.key not in node_labels or not Requirements(req).get(req.key).has(
+                node_labels.get(req.key, "")
+            ):
+                v = req.any()
+                if v:
+                    node_labels[req.key] = v
+        node_labels[labels_mod.HOSTNAME] = claim.name
+
+        node_taints = taints_mod.merge(
+            list(claim.spec.taints),
+            [Taint(key=labels_mod.UNREGISTERED_TAINT_KEY, effect=taints_mod.NO_EXECUTE)],
+        )
+        return Node(
+            metadata=ObjectMeta(name=claim.name, labels=node_labels),
+            provider_id=provider_id,
+            taints=node_taints,
+            status=NodeStatus(
+                capacity=dict(it.capacity),
+                allocatable=dict(it.allocatable()),
+                ready=True,
+            ),
+        )
+
+    def process_registrations(self, now: Optional[float] = None) -> List[Node]:
+        """Create Node objects whose registration delay has elapsed."""
+        now = self._client.clock.now() if now is None else now
+        due = [inst for t, inst in self._pending if t <= now and not inst.terminated]
+        self._pending = [(t, i) for t, i in self._pending if t > now and not i.terminated]
+        created = []
+        for inst in due:
+            if self._client.try_get(Node, inst.node.name) is None:
+                self._client.create(inst.node)
+                created.append(inst.node)
+        return created
+
+    def delete(self, node_claim: NodeClaim) -> None:
+        inst = self._instances.pop(node_claim.status.provider_id, None)
+        if inst is None:
+            raise NodeClaimNotFoundError(node_claim.status.provider_id)
+        inst.terminated = True
+
+    def get(self, provider_id: str) -> NodeClaim:
+        inst = self._instances.get(provider_id)
+        if inst is None or inst.terminated:
+            raise NodeClaimNotFoundError(provider_id)
+        return self._instance_to_claim(inst)
+
+    def list(self) -> List[NodeClaim]:
+        return [
+            self._instance_to_claim(i) for i in self._instances.values() if not i.terminated
+        ]
+
+    def _instance_to_claim(self, inst: KwokInstance) -> NodeClaim:
+        claim = NodeClaim(metadata=ObjectMeta(name=inst.node.name, labels=dict(inst.node.metadata.labels)))
+        claim.status.provider_id = inst.provider_id
+        claim.status.capacity = dict(inst.instance_type.capacity)
+        claim.status.allocatable = dict(inst.instance_type.allocatable())
+        return claim
+
+    def get_instance_types(self, node_pool) -> List[InstanceType]:
+        return list(self._instance_types)
+
+    def is_drifted(self, node_claim: NodeClaim) -> str:
+        return ""

@@ -1,0 +1,13 @@
+from .clock import Clock, RealClock, TestClock
+from .store import Client, Event, NotFoundError, ConflictError, AlreadyExistsError
+
+__all__ = [
+    "Clock",
+    "RealClock",
+    "TestClock",
+    "Client",
+    "Event",
+    "NotFoundError",
+    "ConflictError",
+    "AlreadyExistsError",
+]

@@ -1,0 +1,191 @@
+"""In-process typed object store standing in for the kube-apiserver.
+
+The reference is level-triggered against a real apiserver: all durable state
+lives in CRs, caches are rebuilt from watches, deletion is a two-phase
+finalizer dance. This store reproduces those semantics in-process:
+
+- objects are keyed by (kind, namespace, name) and carry resource versions;
+- ``delete`` stamps ``deletion_timestamp`` when finalizers are present and
+  only removes the object once the last finalizer is gone;
+- watchers receive ADDED/MODIFIED/DELETED events synchronously, which is what
+  the informer controllers in controllers/state consume.
+
+Objects are stored by reference (single process); callers mutate copies and
+``update`` them, mirroring client-go's update-by-replacement.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from .clock import Clock, RealClock
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ConflictError(ValueError):
+    pass
+
+
+@dataclass
+class Event:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    object: object
+
+
+def kind_of(obj) -> str:
+    return type(obj).__name__
+
+
+class Client:
+    """Typed in-memory object store with watch + finalizer semantics."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self._clock = clock or RealClock()
+        self._objects: Dict[Tuple[str, str, str], object] = {}
+        self._by_uid: Dict[str, Tuple[str, str, str]] = {}
+        self._watchers: List[Callable[[Event], None]] = []
+        self._lock = threading.RLock()
+        self._rv = 0
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, handler: Callable[[Event], None]) -> None:
+        self._watchers.append(handler)
+
+    def _notify(self, event: Event) -> None:
+        for handler in list(self._watchers):
+            handler(event)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _key(self, obj) -> Tuple[str, str, str]:
+        meta = obj.metadata
+        return (kind_of(obj), getattr(meta, "namespace", "default"), meta.name)
+
+    def _bump(self, obj) -> None:
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj):
+        with self._lock:
+            key = self._key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self._clock.now()
+            self._bump(obj)
+            self._objects[key] = obj
+            self._by_uid[obj.metadata.uid] = key
+        self._notify(Event(ADDED, key[0], obj))
+        return obj
+
+    def get(self, kind, name: str, namespace: str = "default"):
+        kind_name = kind if isinstance(kind, str) else kind.__name__
+        with self._lock:
+            obj = self._objects.get((kind_name, namespace, name))
+        if obj is None:
+            raise NotFoundError(f"{kind_name} {namespace}/{name} not found")
+        return obj
+
+    def get_by_uid(self, uid: str):
+        with self._lock:
+            key = self._by_uid.get(uid)
+            if key is None:
+                raise NotFoundError(f"uid {uid} not found")
+            return self._objects[key]
+
+    def try_get(self, kind, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, kind, namespace: Optional[str] = None, predicate=None) -> List:
+        kind_name = kind if isinstance(kind, str) else kind.__name__
+        with self._lock:
+            out = [
+                o
+                for (k, ns, _), o in self._objects.items()
+                if k == kind_name and (namespace is None or ns == namespace)
+            ]
+        if predicate is not None:
+            out = [o for o in out if predicate(o)]
+        return out
+
+    def update(self, obj):
+        with self._lock:
+            key = self._key(obj)
+            if key not in self._objects:
+                raise NotFoundError(f"{key} not found")
+            self._bump(obj)
+            self._objects[key] = obj
+        self._notify(Event(MODIFIED, key[0], obj))
+        return obj
+
+    def update_status(self, obj):
+        # Single-store process: status updates are plain updates.
+        return self.update(obj)
+
+    def delete(self, obj, grace_period: Optional[float] = None):
+        """Two-phase delete honoring finalizers (apiserver semantics)."""
+        with self._lock:
+            key = self._key(obj)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{key} not found")
+            if stored.metadata.finalizers:
+                if stored.metadata.deletion_timestamp is None:
+                    stored.metadata.deletion_timestamp = self._clock.now()
+                    self._bump(stored)
+                    event = Event(MODIFIED, key[0], stored)
+                else:
+                    return stored
+            else:
+                del self._objects[key]
+                self._by_uid.pop(stored.metadata.uid, None)
+                event = Event(DELETED, key[0], stored)
+        self._notify(event)
+        return stored
+
+    def remove_finalizer(self, obj, finalizer: str) -> None:
+        """Drop a finalizer; completes deletion if it was the last one and the
+        object was marked deleted."""
+        with self._lock:
+            key = self._key(obj)
+            stored = self._objects.get(key)
+            if stored is None:
+                return
+            if finalizer in stored.metadata.finalizers:
+                stored.metadata.finalizers.remove(finalizer)
+            if not stored.metadata.finalizers and stored.metadata.deletion_timestamp is not None:
+                del self._objects[key]
+                self._by_uid.pop(stored.metadata.uid, None)
+                event = Event(DELETED, key[0], stored)
+            else:
+                self._bump(stored)
+                event = Event(MODIFIED, key[0], stored)
+        self._notify(event)
+
+    def deleted(self, obj) -> bool:
+        return obj.metadata.deletion_timestamp is not None
+
+    @property
+    def clock(self) -> Clock:
+        return self._clock

@@ -1,0 +1,19 @@
+from .hostports import HostPortUsage
+from .queue import Queue
+from .topology import (
+    Topology,
+    TopologyDomainGroup,
+    TopologyGroup,
+    TopologyNodeFilter,
+    TopologyType,
+)
+
+__all__ = [
+    "HostPortUsage",
+    "Queue",
+    "Topology",
+    "TopologyDomainGroup",
+    "TopologyGroup",
+    "TopologyNodeFilter",
+    "TopologyType",
+]

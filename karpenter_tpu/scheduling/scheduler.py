@@ -1,0 +1,342 @@
+"""The scheduling simulation driver.
+
+Host-side mirror of the reference's Scheduler.Solve
+(scheduler.go:80-134, 270-425): FFD queue -> place each pod on existing
+nodes, then open in-flight claims (fewest pods first), then a new claim from
+the highest-weight feasible NodePool; on failure relax preferences and
+requeue. This implementation is the exact-semantics oracle and fallback; the
+TPU solver (karpenter_tpu.solver) accelerates the same decision problem and
+is parity-tested against this.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as labels_mod
+from ..api import resources as res
+from ..api import taints as taints_mod
+from ..api.objects import Node, NodePool, Pod
+from ..api.requirements import (
+    Requirements,
+    has_preferred_node_affinity,
+    pod_requirements,
+    strict_pod_requirements,
+)
+from ..cloudprovider import types as cp
+from .inflight import (
+    ExistingNode,
+    InFlightNodeClaim,
+    PodData,
+    RESERVED_OFFERING_MODE_FALLBACK,
+    ReservedOfferingError,
+    filter_instance_types,
+)
+from .preferences import Preferences
+from .queue import Queue
+from .reservation import ReservationManager
+from .template import MAX_INSTANCE_TYPES, NodeClaimTemplate
+from .topology import Topology
+
+
+class AddError:
+    """Lazily-formatted placement failure for one pod; ``reserved`` marks a
+    reservation-policy failure which must not trigger relaxation
+    (scheduler.go:313-321)."""
+
+    __slots__ = ("parts", "reserved")
+
+    def __init__(self, parts, reserved=False):
+        self.parts = parts
+        self.reserved = reserved
+
+    def __str__(self) -> str:
+        if not self.parts:
+            return "no nodepool matched pod"
+        return "; ".join(
+            f"incompatible with nodepool {p[0]!r}, {p[1]}" if isinstance(p, tuple) else str(p)
+            for p in self.parts
+        )
+
+    def __repr__(self) -> str:
+        return str(self)
+
+
+@dataclass
+class Results:
+    """Outcome of one Solve (reference: scheduler.go:161-165)."""
+
+    new_node_claims: List[InFlightNodeClaim] = field(default_factory=list)
+    existing_nodes: List[ExistingNode] = field(default_factory=list)
+    pod_errors: Dict[str, str] = field(default_factory=dict)  # pod uid -> error
+
+    def all_pods_scheduled(self) -> bool:
+        return not self.pod_errors
+
+    def truncate_instance_types(self, max_types: int = MAX_INSTANCE_TYPES) -> "Results":
+        """Price-ordered truncation per new claim (scheduler.go:249-267)."""
+        valid = []
+        for claim in self.new_node_claims:
+            truncated, err = cp.truncate(
+                claim.instance_type_options, claim.requirements, max_types
+            )
+            if err is not None:
+                for pod in claim.pods:
+                    self.pod_errors[pod.uid] = (
+                        f"nodepool {claim.template.node_pool_name!r} couldn't meet"
+                        f" minValues requirements after truncation"
+                    )
+            else:
+                claim.instance_type_options = truncated
+                valid.append(claim)
+        self.new_node_claims = valid
+        return self
+
+    def node_count(self) -> int:
+        return len(self.new_node_claims)
+
+    def total_price(self) -> float:
+        """Packing cost: sum of each new claim's cheapest launchable price.
+
+        The packing-cost comparator used for oracle-vs-TPU parity
+        (BASELINE.json metric)."""
+        total = 0.0
+        for claim in self.new_node_claims:
+            prices = [
+                cp.min_compatible_price(it, claim.requirements)
+                for it in claim.instance_type_options
+            ]
+            total += min(prices) if prices else 0.0
+        return total
+
+
+class Scheduler:
+    def __init__(
+        self,
+        node_pools: Sequence[NodePool],
+        instance_types: Dict[str, List[cp.InstanceType]],
+        topology: Topology,
+        state_nodes: Sequence = (),
+        daemonset_pods: Sequence[Pod] = (),
+        reserved_offering_mode: str = RESERVED_OFFERING_MODE_FALLBACK,
+        reserved_capacity_enabled: bool = False,
+        clock=None,
+    ):
+        # tolerate PreferNoSchedule during relaxation if any pool taints with it
+        tolerate_pns = any(
+            t.effect == taints_mod.PREFER_NO_SCHEDULE
+            for np in node_pools
+            for t in np.spec.template.spec.taints
+        )
+        self.preferences = Preferences(tolerate_prefer_no_schedule=tolerate_pns)
+        self.topology = topology
+        self.reservation_manager = ReservationManager(instance_types)
+        self.reserved_offering_mode = reserved_offering_mode
+        self.reserved_capacity_enabled = reserved_capacity_enabled
+
+        # templates in weight order, pre-filtered to feasible instance types
+        # (scheduler.go:104-114); order: weight desc, then name
+        self.templates: List[NodeClaimTemplate] = []
+        for np in sorted(node_pools, key=lambda p: (-p.spec.weight, p.name)):
+            nct = NodeClaimTemplate(np)
+            options, _ = filter_instance_types(
+                instance_types.get(np.name, []), nct.requirements, {}, {}, {}
+            )
+            if not options:
+                continue  # pool requirements filtered out all instance types
+            nct.instance_type_options = options
+            self.templates.append(nct)
+
+        self.daemon_overhead = {
+            nct: _daemon_overhead(nct, daemonset_pods) for nct in self.templates
+        }
+        self.remaining_resources: Dict[str, res.ResourceList] = {
+            np.name: dict(np.spec.limits) for np in node_pools if np.spec.limits
+        }
+        self.cached_pod_data: Dict[str, PodData] = {}
+        self.new_node_claims: List[InFlightNodeClaim] = []
+        self.existing_nodes: List[ExistingNode] = []
+        self._calculate_existing_nodes(state_nodes, daemonset_pods)
+
+    # -- existing nodes (scheduler.go:427-463) ----------------------------
+
+    def _calculate_existing_nodes(self, state_nodes, daemonset_pods) -> None:
+        for sn in state_nodes:
+            taints = sn.taints()
+            daemons = []
+            for p in daemonset_pods:
+                if taints_mod.tolerates_pod(taints, p) is not None:
+                    continue
+                if (
+                    Requirements.from_labels(sn.labels()).compatible(pod_requirements(p))
+                    is not None
+                ):
+                    continue
+                daemons.append(p)
+            daemon_requests = res.merge(*(p.spec.requests for p in daemons)) if daemons else {}
+            self.existing_nodes.append(
+                ExistingNode(sn, self.topology, taints, daemon_requests)
+            )
+            pool = sn.labels().get(labels_mod.NODEPOOL_LABEL_KEY)
+            if pool in self.remaining_resources:
+                self.remaining_resources[pool] = res.subtract(
+                    self.remaining_resources[pool], sn.capacity()
+                )
+        self.existing_nodes.sort(key=lambda n: (not n.initialized(), n.name))
+
+    # -- per-pod placement (scheduler.go:357-425) -------------------------
+
+    def _update_cached_pod_data(self, pod: Pod) -> None:
+        requirements = pod_requirements(pod)
+        strict = requirements
+        if has_preferred_node_affinity(pod):
+            strict = strict_pod_requirements(pod)
+        self.cached_pod_data[pod.uid] = PodData(
+            requests=dict(pod.spec.requests),
+            requirements=requirements,
+            strict_requirements=strict,
+        )
+
+    def _add(self, pod: Pod) -> Optional[AddError]:
+        pod_data = self.cached_pod_data[pod.uid]
+        # 1. existing nodes, initialized first
+        for node in self.existing_nodes:
+            if node.add(pod, pod_data) is None:
+                return None
+        # 2. open in-flight claims, fewest pods first
+        self.new_node_claims.sort(key=lambda c: len(c.pods))
+        for claim in self.new_node_claims:
+            try:
+                if claim.add(pod, pod_data) is None:
+                    return None
+            except ReservedOfferingError:
+                continue
+        # 3. new claim from the highest-weight feasible template
+        errs = []
+        reserved = False
+        for nct in self.templates:
+            instance_types = nct.instance_type_options
+            if nct.node_pool_name in self.remaining_resources:
+                instance_types = _filter_by_remaining_resources(
+                    instance_types, self.remaining_resources[nct.node_pool_name]
+                )
+                if not instance_types:
+                    errs.append(
+                        f"all instance types exceed limits for nodepool"
+                        f" {nct.node_pool_name!r}"
+                    )
+                    continue
+            claim = InFlightNodeClaim(
+                nct,
+                self.topology,
+                self.daemon_overhead[nct],
+                instance_types,
+                self.reservation_manager,
+                self.reserved_offering_mode,
+                self.reserved_capacity_enabled,
+            )
+            try:
+                err = claim.add(pod, pod_data)
+            except ReservedOfferingError as e:
+                claim.destroy()
+                errs.append(f"reserved offering policy for {nct.node_pool_name!r}: {e}")
+                reserved = True
+                # don't fall back to lower-weight pools past a reservation error
+                break
+            if err is not None:
+                claim.destroy()
+                errs.append((nct.node_pool_name, err))
+                continue
+            self.new_node_claims.append(claim)
+            if nct.node_pool_name in self.remaining_resources:
+                self.remaining_resources[nct.node_pool_name] = _subtract_max(
+                    self.remaining_resources[nct.node_pool_name],
+                    claim.instance_type_options,
+                )
+            return None
+        return AddError(errs, reserved=reserved)
+
+    # -- the solve loop (scheduler.go:270-339) ----------------------------
+
+    def solve(self, pods: Sequence[Pod]) -> Results:
+        for p in pods:
+            self._update_cached_pod_data(p)
+        queue = Queue(
+            list(pods), {uid: d.requests for uid, d in self.cached_pod_data.items()}
+        )
+        pod_errors: Dict[str, str] = {}
+        pods_by_uid = {p.uid: p for p in pods}
+        while True:
+            pod = queue.pop()
+            if pod is None:
+                break
+            err = self._add(pod)
+            if err is None:
+                pod_errors.pop(pod.uid, None)
+                continue
+            pod_errors[pod.uid] = err
+            relaxed = False
+            if not err.reserved:
+                relaxed = self.preferences.relax(pod)
+                if relaxed:
+                    self.topology.update(pod)
+                    self._update_cached_pod_data(pod)
+            queue.push(pod, relaxed)
+        for claim in self.new_node_claims:
+            claim.finalize()
+        return Results(
+            new_node_claims=self.new_node_claims,
+            existing_nodes=self.existing_nodes,
+            pod_errors=pod_errors,
+        )
+
+
+def _daemon_overhead(nct: NodeClaimTemplate, daemonset_pods: Sequence[Pod]) -> res.ResourceList:
+    """Total requests of daemon pods compatible with the template
+    (scheduler.go:466-492)."""
+    compatible = [p for p in daemonset_pods if _daemon_compatible(nct, p)]
+    return res.merge(*(p.spec.requests for p in compatible)) if compatible else {}
+
+
+def _daemon_compatible(nct: NodeClaimTemplate, pod: Pod) -> bool:
+    import copy
+
+    pod = copy.deepcopy(pod)
+    prefs = Preferences()
+    prefs._tolerate_prefer_no_schedule_taints(pod)
+    if taints_mod.tolerates_pod(nct.taints, pod) is not None:
+        return False
+    while True:
+        if (
+            nct.requirements.compatible(
+                strict_pod_requirements(pod), labels_mod.WELL_KNOWN_LABELS
+            )
+            is None
+        ):
+            return True
+        if prefs._remove_required_node_affinity_term(pod) is None:
+            return False
+
+
+def _subtract_max(
+    remaining: res.ResourceList, instance_types: Sequence[cp.InstanceType]
+) -> res.ResourceList:
+    """Pessimistically subtract the max capacity per resource
+    (scheduler.go:498-515)."""
+    if not instance_types:
+        return remaining
+    max_caps = res.max_resources(*(it.capacity for it in instance_types))
+    return {k: v - max_caps.get(k, 0) for k, v in remaining.items()}
+
+
+def _filter_by_remaining_resources(
+    instance_types: Sequence[cp.InstanceType], remaining: res.ResourceList
+) -> List[cp.InstanceType]:
+    """Drop instance types whose capacity exceeds any remaining limit
+    (scheduler.go:517-534)."""
+    out = []
+    for it in instance_types:
+        if all(it.capacity.get(name, 0) <= q for name, q in remaining.items()):
+            out.append(it)
+    return out

@@ -1,0 +1,125 @@
+"""Object builders for tests (role of the reference's pkg/test fixtures)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import labels as labels_mod
+from karpenter_tpu.api import resources as res
+from karpenter_tpu.api.objects import (
+    DaemonSet,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodePool,
+    NodePoolSpec,
+    NodeClaimTemplate,
+    NodeClaimSpec,
+    NodeSelectorRequirement,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodSpec,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+_seq = itertools.count(1)
+
+
+def make_pod(
+    name: Optional[str] = None,
+    cpu: str = "1",
+    memory: str = "1Gi",
+    labels: Optional[Dict[str, str]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    requirements: Sequence[NodeSelectorRequirement] = (),
+    preferred: Sequence[PreferredSchedulingTerm] = (),
+    tolerations: Sequence[Toleration] = (),
+    spread: Sequence[TopologySpreadConstraint] = (),
+    pod_affinity: Sequence[PodAffinityTerm] = (),
+    pod_anti_affinity: Sequence[PodAffinityTerm] = (),
+    extra_requests: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    phase: str = "Pending",
+) -> Pod:
+    i = next(_seq)
+    requests = {"cpu": res.parse_quantity(cpu), "memory": res.parse_quantity(memory)}
+    for k, v in (extra_requests or {}).items():
+        requests[k] = res.parse_quantity(v)
+    affinity = None
+    if requirements or preferred:
+        affinity = NodeAffinity(
+            required=[tuple(requirements)] if requirements else [],
+            preferred=list(preferred),
+        )
+    pod = Pod(
+        metadata=ObjectMeta(name=name or f"pod-{i}", labels=dict(labels or {})),
+        spec=PodSpec(
+            node_selector=dict(node_selector or {}),
+            node_affinity=affinity,
+            tolerations=list(tolerations),
+            requests=requests,
+            topology_spread_constraints=list(spread),
+            pod_affinity=list(pod_affinity),
+            pod_anti_affinity=list(pod_anti_affinity),
+            node_name=node_name,
+        ),
+    )
+    pod.status.phase = phase
+    return pod
+
+
+def make_pods(count: int, **kwargs) -> List[Pod]:
+    return [make_pod(**kwargs) for _ in range(count)]
+
+
+def make_nodepool(
+    name: str = "default",
+    weight: int = 1,
+    limits: Optional[Dict[str, str]] = None,
+    taints: Sequence[Taint] = (),
+    requirements: Sequence[NodeSelectorRequirement] = (),
+    labels: Optional[Dict[str, str]] = None,
+) -> NodePool:
+    return NodePool(
+        metadata=ObjectMeta(name=name),
+        spec=NodePoolSpec(
+            template=NodeClaimTemplate(
+                labels=dict(labels or {}),
+                spec=NodeClaimSpec(
+                    requirements=list(requirements),
+                    taints=list(taints),
+                ),
+            ),
+            limits={k: res.parse_quantity(v) for k, v in (limits or {}).items()},
+            weight=weight,
+        ),
+    )
+
+
+def spread_constraint(
+    topology_key: str,
+    max_skew: int = 1,
+    labels: Optional[Dict[str, str]] = None,
+    when_unsatisfiable: str = "DoNotSchedule",
+    min_domains: Optional[int] = None,
+) -> TopologySpreadConstraint:
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=topology_key,
+        when_unsatisfiable=when_unsatisfiable,
+        label_selector=LabelSelector(match_labels=dict(labels or {})),
+        min_domains=min_domains,
+    )
+
+
+def affinity_term(topology_key: str, labels: Dict[str, str]) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        topology_key=topology_key,
+        label_selector=LabelSelector(match_labels=dict(labels)),
+    )

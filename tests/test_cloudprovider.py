@@ -1,0 +1,242 @@
+"""CloudProvider SPI tests: ordering, truncation, minValues, kwok/fake providers."""
+
+import pytest
+
+from karpenter_tpu.api import labels
+from karpenter_tpu.api.objects import NodeClaim, NodeClaimSpec, NodeSelectorRequirement, ObjectMeta
+from karpenter_tpu.api.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.cloudprovider import corpus, fake, types as cp
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube import Client, TestClock
+
+
+def reqs(*rs):
+    return Requirements(*rs)
+
+
+class TestCorpus:
+    def test_grid_size(self):
+        its = corpus.generate()
+        assert len(its) == len(corpus.FAMILIES) * len(corpus.SIZES) * 2
+
+    def test_unique_names_extended(self):
+        its = corpus.generate(400)
+        names = [it.name for it in its]
+        assert len(set(names)) == 400
+
+    def test_offerings_cover_zones_and_capacity_types(self):
+        it = corpus.generate(1)[0]
+        zones = {o.zone() for o in it.offerings}
+        cts = {o.capacity_type() for o in it.offerings}
+        assert zones == set(corpus.DEFAULT_ZONES)
+        assert cts == {labels.CAPACITY_TYPE_SPOT, labels.CAPACITY_TYPE_ON_DEMAND}
+
+    def test_spot_cheaper_than_on_demand(self):
+        it = corpus.generate(1)[0]
+        spot = [o for o in it.offerings if o.capacity_type() == labels.CAPACITY_TYPE_SPOT]
+        od = [o for o in it.offerings if o.capacity_type() == labels.CAPACITY_TYPE_ON_DEMAND]
+        assert max(o.price for o in spot) < min(o.price for o in od)
+
+    def test_allocatable_below_capacity(self):
+        it = corpus.generate(1)[0]
+        alloc = it.allocatable()
+        assert alloc["cpu"] < it.capacity["cpu"]
+        assert alloc["memory"] < it.capacity["memory"]
+
+
+class TestOrderingAndTruncation:
+    def test_order_by_price_spot_first(self):
+        its = corpus.generate(10)
+        ordered = cp.order_by_price(its, Requirements())
+        prices = [cp.min_compatible_price(it, Requirements()) for it in ordered]
+        assert prices == sorted(prices)
+
+    def test_order_by_price_respects_capacity_type(self):
+        its = corpus.generate(10)
+        od_only = reqs(
+            Requirement(
+                labels.CAPACITY_TYPE_LABEL_KEY, Operator.IN, [labels.CAPACITY_TYPE_ON_DEMAND]
+            )
+        )
+        ordered = cp.order_by_price(its, od_only)
+        prices = [cp.min_compatible_price(it, od_only) for it in ordered]
+        assert prices == sorted(prices)
+        # on-demand prices are used, not spot
+        spot_price = cp.min_compatible_price(ordered[0], Requirements())
+        assert prices[0] > spot_price
+
+    def test_truncate(self):
+        its = corpus.generate(100)
+        truncated, err = cp.truncate(its, Requirements(), 60)
+        assert err is None and len(truncated) == 60
+
+    def test_truncate_min_values_violation(self):
+        its = corpus.generate(4)
+        # require more distinct instance types than truncation would keep
+        r = reqs(
+            Requirement(
+                labels.INSTANCE_TYPE,
+                Operator.IN,
+                [it.name for it in its],
+                min_values=4,
+            )
+        )
+        truncated, err = cp.truncate(its, r, 2)
+        assert err is not None
+        assert len(truncated) == 4  # untruncated on violation
+
+    def test_satisfies_min_values_counts_prefix(self):
+        its = corpus.generate(6)
+        r = reqs(
+            Requirement(
+                labels.INSTANCE_TYPE,
+                Operator.IN,
+                [it.name for it in its],
+                min_values=3,
+            )
+        )
+        n, err = cp.satisfies_min_values(its, r)
+        assert err is None and n == 3
+
+    def test_no_min_values_is_trivially_satisfied(self):
+        n, err = cp.satisfies_min_values(corpus.generate(2), Requirements())
+        assert (n, err) == (0, None)
+
+
+class TestWorstLaunchPrice:
+    def test_precedence_spot_over_on_demand(self):
+        it = corpus.generate(1)[0]
+        # with no capacity-type constraint, spot offerings exist -> spot worst
+        worst = cp.worst_launch_price(it.offerings, Requirements())
+        spot_prices = [
+            o.price for o in it.offerings if o.capacity_type() == labels.CAPACITY_TYPE_SPOT
+        ]
+        assert worst == max(spot_prices)
+
+
+def make_claim(name="claim-1", requirements=()):
+    return NodeClaim(
+        metadata=ObjectMeta(name=name, labels={labels.NODEPOOL_LABEL_KEY: "default"}),
+        spec=NodeClaimSpec(requirements=list(requirements)),
+    )
+
+
+class TestKwokProvider:
+    def test_create_picks_cheapest(self):
+        client = Client(TestClock())
+        provider = KwokCloudProvider(client, corpus.generate(20))
+        claim = provider.create(make_claim())
+        assert claim.status.provider_id.startswith("kwok://")
+        assert claim.metadata.labels[labels.CAPACITY_TYPE_LABEL_KEY] == labels.CAPACITY_TYPE_SPOT
+        # cheapest = smallest spot offering among compatible types
+        its = cp.order_by_price(provider.get_instance_types(None), Requirements())
+        assert claim.metadata.labels[labels.INSTANCE_TYPE] == its[0].name
+
+    def test_create_respects_requirements(self):
+        client = Client(TestClock())
+        provider = KwokCloudProvider(client, corpus.generate(20))
+        claim = provider.create(
+            make_claim(
+                requirements=[
+                    NodeSelectorRequirement(labels.TOPOLOGY_ZONE, "In", ("test-zone-b",)),
+                    NodeSelectorRequirement(
+                        labels.CAPACITY_TYPE_LABEL_KEY, "In", (labels.CAPACITY_TYPE_ON_DEMAND,)
+                    ),
+                ]
+            )
+        )
+        assert claim.metadata.labels[labels.TOPOLOGY_ZONE] == "test-zone-b"
+        assert claim.metadata.labels[labels.CAPACITY_TYPE_LABEL_KEY] == labels.CAPACITY_TYPE_ON_DEMAND
+
+    def test_registration_delay(self):
+        clock = TestClock()
+        client = Client(clock)
+        provider = KwokCloudProvider(client, corpus.generate(5), registration_delay=30)
+        provider.create(make_claim())
+        assert provider.process_registrations() == []
+        clock.step(31)
+        nodes = provider.process_registrations()
+        assert len(nodes) == 1
+        # node carries the unregistered NoExecute taint until lifecycle strips it
+        assert any(t.key == labels.UNREGISTERED_TAINT_KEY for t in nodes[0].taints)
+        from karpenter_tpu.api.objects import Node
+
+        assert client.get(Node, nodes[0].name) is nodes[0]
+
+    def test_delete_then_get_raises(self):
+        client = Client(TestClock())
+        provider = KwokCloudProvider(client, corpus.generate(5))
+        claim = provider.create(make_claim())
+        provider.delete(claim)
+        with pytest.raises(cp.NodeClaimNotFoundError):
+            provider.get(claim.status.provider_id)
+
+    def test_incompatible_requirements_raise(self):
+        client = Client(TestClock())
+        provider = KwokCloudProvider(client, corpus.generate(5))
+        with pytest.raises(cp.InsufficientCapacityError):
+            provider.create(
+                make_claim(
+                    requirements=[
+                        NodeSelectorRequirement(labels.TOPOLOGY_ZONE, "In", ("nowhere",))
+                    ]
+                )
+            )
+
+
+class TestFakeProvider:
+    def test_error_injection(self):
+        provider = fake.FakeCloudProvider()
+        provider.next_create_err = cp.InsufficientCapacityError("boom")
+        with pytest.raises(cp.InsufficientCapacityError):
+            provider.create(make_claim())
+        # next call succeeds
+        claim = provider.create(make_claim("claim-2"))
+        assert claim.status.provider_id
+
+    def test_allowed_create_calls(self):
+        provider = fake.FakeCloudProvider()
+        provider.allowed_create_calls = 1
+        provider.create(make_claim("a"))
+        with pytest.raises(cp.InsufficientCapacityError):
+            provider.create(make_claim("b"))
+
+    def test_list_and_delete(self):
+        provider = fake.FakeCloudProvider()
+        claim = provider.create(make_claim())
+        assert len(provider.list()) == 1
+        provider.delete(claim)
+        assert provider.list() == []
+
+
+class TestKubeStore:
+    def test_crud_and_watch(self):
+        client = Client(TestClock())
+        events = []
+        client.watch(events.append)
+        claim = make_claim()
+        client.create(claim)
+        got = client.get(NodeClaim, "claim-1")
+        assert got is claim
+        client.update(claim)
+        client.delete(claim)
+        assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_finalizer_two_phase_delete(self):
+        client = Client(TestClock())
+        claim = make_claim()
+        claim.metadata.finalizers.append("karpenter.tpu/termination")
+        client.create(claim)
+        client.delete(claim)
+        # still present, marked deleting
+        assert client.get(NodeClaim, "claim-1").metadata.deletion_timestamp is not None
+        client.remove_finalizer(claim, "karpenter.tpu/termination")
+        assert client.try_get(NodeClaim, "claim-1") is None
+
+    def test_duplicate_create_raises(self):
+        from karpenter_tpu.kube import AlreadyExistsError
+
+        client = Client(TestClock())
+        client.create(make_claim())
+        with pytest.raises(AlreadyExistsError):
+            client.create(make_claim())

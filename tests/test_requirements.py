@@ -245,3 +245,30 @@ class TestLabelPolicy:
             Requirement("example.com/team", Operator.IN, ["web"]),
         )
         assert reqs.labels() == {"example.com/team": "web"}
+
+
+class TestAnyAndLazyErrors:
+    def test_any_gt_operator_returns_empty(self):
+        # reference Any() only generates values for In/NotIn/Exists
+        # (requirement.go:231-247); Gt/Lt return ""
+        assert Requirement("key", Operator.GT, [str(2**31)]).any() == ""
+
+    def test_any_not_in_never_crashes(self):
+        r = A_NOT_IN("0", "1", "2")
+        v = r.any()
+        assert v not in {"0", "1", "2"} and v != ""
+
+    def test_any_empty_band_returns_empty(self):
+        r = GT(5).intersection(LT(7))  # only "6" allowed... complement band
+        assert r.has("6")
+        r2 = Requirement("key", Operator.GT, [str(2**62)]).intersection(
+            Requirement("key", Operator.LT, [str(2**62 + 1)])
+        )
+        assert r2.any() == ""
+
+    def test_intersects_error_is_lazy_and_stringable(self):
+        a = Requirements(A_IN("a"))
+        b = Requirements(A_IN("b"))
+        err = a.intersects(b)
+        assert err is not None
+        assert "key" in str(err) and "not in" in str(err)

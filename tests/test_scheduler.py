@@ -1,0 +1,460 @@
+"""Scheduler behavior tests.
+
+Scenario coverage mirrors the reference's provisioning suite
+(pkg/controllers/provisioning/suite_test.go, scheduling/topology_test.go,
+scheduling/instance_selection_test.go) against the host-side oracle
+scheduler.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import (
+    Node,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Pod,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.scheduling.topology import Topology
+
+from helpers import (
+    affinity_term,
+    make_nodepool,
+    make_pod,
+    make_pods,
+    spread_constraint,
+)
+
+
+def solve(
+    pods,
+    node_pools=None,
+    instance_types=None,
+    state_nodes=(),
+    daemonset_pods=(),
+    client=None,
+):
+    client = client or Client(TestClock())
+    node_pools = [make_nodepool()] if node_pools is None else node_pools
+    its = instance_types if instance_types is not None else corpus.generate(20)
+    its_by_pool = {np.name: list(its) for np in node_pools}
+    topology = Topology(client, state_nodes, node_pools, its_by_pool, pods)
+    scheduler = Scheduler(
+        node_pools,
+        its_by_pool,
+        topology,
+        state_nodes=state_nodes,
+        daemonset_pods=daemonset_pods,
+    )
+    return scheduler.solve(pods)
+
+
+class TestBasicScheduling:
+    def test_single_pod_single_node(self):
+        results = solve([make_pod()])
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 1
+
+    def test_identical_pods_pack_together(self):
+        # 10 x 1cpu pods should not need 10 nodes given types up to 96 cpu
+        results = solve(make_pods(10, cpu="1", memory="1Gi"))
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 1
+
+    def test_oversized_pod_fails(self):
+        results = solve([make_pod(cpu="1000")])
+        assert not results.all_pods_scheduled()
+        assert results.node_count() == 0
+
+    def test_no_nodepools_fails(self):
+        results = solve([make_pod()], node_pools=[])
+        assert not results.all_pods_scheduled()
+
+    def test_ffd_order_packs_large_first(self):
+        # a 60-cpu pod and many small ones: big pod must land somewhere
+        pods = [make_pod(cpu="60")] + make_pods(20, cpu="500m")
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_pods_requesting_unknown_resource_fail(self):
+        results = solve([make_pod(extra_requests={"example.com/fpga": "1"})])
+        assert not results.all_pods_scheduled()
+
+    def test_gpu_pod_gets_gpu_node(self):
+        results = solve([make_pod(extra_requests={"nvidia.com/gpu": "1"})],
+                        instance_types=corpus.generate())
+        assert results.all_pods_scheduled()
+        claim = results.new_node_claims[0]
+        assert all(
+            "nvidia.com/gpu" in it.capacity for it in claim.instance_type_options
+        )
+
+
+class TestInstanceSelection:
+    def test_node_selector_zone(self):
+        results = solve([make_pod(node_selector={labels.TOPOLOGY_ZONE: "test-zone-b"})])
+        assert results.all_pods_scheduled()
+        claim = results.new_node_claims[0]
+        assert claim.requirements.get(labels.TOPOLOGY_ZONE).values == {"test-zone-b"}
+
+    def test_incompatible_zone_fails(self):
+        results = solve([make_pod(node_selector={labels.TOPOLOGY_ZONE: "mars"})])
+        assert not results.all_pods_scheduled()
+
+    def test_arch_requirement(self):
+        results = solve(
+            [
+                make_pod(
+                    requirements=[
+                        NodeSelectorRequirement(labels.ARCH, "In", ("arm64",))
+                    ]
+                )
+            ]
+        )
+        assert results.all_pods_scheduled()
+        claim = results.new_node_claims[0]
+        for it in claim.instance_type_options:
+            assert it.requirements.get(labels.ARCH).has("arm64")
+
+    def test_incompatible_pods_get_separate_nodes(self):
+        pods = [
+            make_pod(node_selector={labels.ARCH: "amd64"}),
+            make_pod(node_selector={labels.ARCH: "arm64"}),
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 2
+
+    def test_custom_label_requires_pool_definition(self):
+        # a pod constraining a custom label fails against a pool that doesn't
+        # define the key (requirements.go:177-191 asymmetry)
+        pods = [
+            make_pod(
+                requirements=[
+                    NodeSelectorRequirement(corpus.INSTANCE_FAMILY_LABEL, "In", ("r",))
+                ]
+            ),
+        ]
+        results = solve(pods)
+        assert not results.all_pods_scheduled()
+
+    def test_instance_type_filter_tightens_per_pod(self):
+        # with the family key defined on the pool, the pod constraint narrows
+        # the claim's instance types to that family
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "In", ("c", "m", "r")
+                )
+            ]
+        )
+        pods = [
+            make_pod(),
+            make_pod(
+                requirements=[
+                    NodeSelectorRequirement(corpus.INSTANCE_FAMILY_LABEL, "In", ("r",))
+                ]
+            ),
+        ]
+        results = solve(pods, node_pools=[pool], instance_types=corpus.generate())
+        assert results.all_pods_scheduled()
+
+
+class TestNodePools:
+    def test_weight_order(self):
+        pools = [
+            make_nodepool("low", weight=1),
+            make_nodepool("high", weight=50),
+        ]
+        results = solve([make_pod()], node_pools=pools)
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.node_pool_name == "high"
+
+    def test_limits_restrict(self):
+        # limit prohibits any instance launch (every type exceeds 1 cpu limit)
+        pools = [make_nodepool("limited", limits={"cpu": "1"})]
+        results = solve([make_pod()], node_pools=pools)
+        assert not results.all_pods_scheduled()
+
+    def test_limits_fall_back_to_other_pool(self):
+        pools = [
+            make_nodepool("limited", weight=50, limits={"cpu": "1"}),
+            make_nodepool("open", weight=1),
+        ]
+        results = solve([make_pod()], node_pools=pools)
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.node_pool_name == "open"
+
+    def test_taints_respected(self):
+        pools = [
+            make_nodepool(
+                "tainted",
+                weight=50,
+                taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")],
+            ),
+            make_nodepool("open", weight=1),
+        ]
+        results = solve([make_pod()], node_pools=pools)
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.node_pool_name == "open"
+
+    def test_toleration_allows_tainted_pool(self):
+        pools = [
+            make_nodepool(
+                "tainted",
+                weight=50,
+                taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")],
+            ),
+            make_nodepool("open", weight=1),
+        ]
+        pod = make_pod(
+            tolerations=[Toleration(key="dedicated", operator="Exists", effect="NoSchedule")]
+        )
+        results = solve([pod], node_pools=pools)
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.node_pool_name == "tainted"
+
+    def test_pool_requirements_restrict_types(self):
+        pools = [
+            make_nodepool(
+                "amd-only",
+                requirements=[NodeSelectorRequirement(labels.ARCH, "In", ("amd64",))],
+            )
+        ]
+        results = solve([make_pod()], node_pools=pools)
+        assert results.all_pods_scheduled()
+        for it in results.new_node_claims[0].instance_type_options:
+            assert it.requirements.get(labels.ARCH).has("amd64")
+
+
+class TestTopologySpread:
+    def test_zonal_spread(self):
+        app = {"app": "web"}
+        pods = make_pods(
+            6, labels=app, spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)]
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        # count domains across claims
+        zone_counts = {}
+        for claim in results.new_node_claims:
+            zone = claim.requirements.get(labels.TOPOLOGY_ZONE)
+            assert not zone.complement and len(zone.values) == 1
+            z = next(iter(zone.values))
+            zone_counts[z] = zone_counts.get(z, 0) + len(claim.pods)
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+        assert len(zone_counts) == 3
+
+    def test_hostname_spread_forces_nodes(self):
+        app = {"app": "api"}
+        pods = make_pods(
+            4, labels=app, spread=[spread_constraint(labels.HOSTNAME, labels=app)]
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 4
+
+    def test_hostname_anti_affinity_forces_nodes(self):
+        app = {"app": "db"}
+        pods = make_pods(
+            3, labels=app, pod_anti_affinity=[affinity_term(labels.HOSTNAME, app)]
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 3
+
+    def test_zonal_affinity_colocates(self):
+        app = {"app": "cache"}
+        pods = make_pods(
+            5, labels=app, pod_affinity=[affinity_term(labels.TOPOLOGY_ZONE, app)]
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        zones = set()
+        for claim in results.new_node_claims:
+            zone = claim.requirements.get(labels.TOPOLOGY_ZONE)
+            zones.update(zone.values)
+        assert len(zones) == 1
+
+    def test_zonal_anti_affinity_late_committal(self):
+        # Reference semantics (topology_test.go:2678-2723): an in-flight claim
+        # may land in any of its zones, so zonal self-anti-affinity blocks all
+        # possible domains pessimistically — only one pod schedules per batch.
+        app = {"app": "zk"}
+        pods = make_pods(
+            4, labels=app, pod_anti_affinity=[affinity_term(labels.TOPOLOGY_ZONE, app)]
+        )
+        results = solve(pods)
+        assert len(results.pod_errors) == 3
+        assert results.node_count() == 1
+
+    def test_zonal_anti_affinity_with_existing_pods(self):
+        # once zones are concrete (pods bound to real nodes), anti-affinity
+        # pods land in the remaining empty zones
+        client = Client(TestClock())
+        app = {"app": "zk"}
+        for i, zone in enumerate(["test-zone-a", "test-zone-b"]):
+            node = Node(
+                metadata=ObjectMeta(
+                    name=f"n-{i}",
+                    labels={labels.TOPOLOGY_ZONE: zone, labels.HOSTNAME: f"n-{i}"},
+                )
+            )
+            client.create(node)
+            client.create(
+                make_pod(labels=app, node_name=f"n-{i}", phase="Running",
+                         pod_anti_affinity=[affinity_term(labels.TOPOLOGY_ZONE, app)])
+            )
+        pods = make_pods(
+            2, labels=app, pod_anti_affinity=[affinity_term(labels.TOPOLOGY_ZONE, app)]
+        )
+        results = solve(pods, client=client)
+        # one lands in test-zone-c, the other can't (every zone blocked)
+        assert len(results.pod_errors) == 1
+        assert results.node_count() == 1
+        claim = results.new_node_claims[0]
+        assert claim.requirements.get(labels.TOPOLOGY_ZONE).values == {"test-zone-c"}
+
+    def test_schedule_anyway_spread_is_relaxed(self):
+        # A ScheduleAnyway spread over an impossible key is dropped during
+        # relaxation. The selector must not select the pod itself: a group
+        # whose selector matches the pod keeps applying via counting even
+        # after the constraint is removed (topology.go getMatchingTopologies),
+        # matching the reference's "violate max-skew ... ConsistOf(1, 2)"
+        # behavior where relaxed pods can still fail.
+        pods = make_pods(
+            2,
+            labels={"app": "soft"},
+            spread=[
+                spread_constraint(
+                    "nonexistent.io/key",
+                    labels={"app": "other"},
+                    when_unsatisfiable="ScheduleAnyway",
+                )
+            ],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_do_not_schedule_spread_matching_self_cannot_relax(self):
+        # DoNotSchedule over a domainless key with a self-matching selector
+        # fails permanently (reference parity)
+        app = {"app": "hard"}
+        pods = make_pods(
+            2,
+            labels=app,
+            spread=[spread_constraint("nonexistent.io/key", labels=app)],
+        )
+        results = solve(pods)
+        assert len(results.pod_errors) == 2
+
+
+class TestPreferenceRelaxation:
+    def test_unsatisfiable_preferred_affinity_dropped(self):
+        pod = make_pod(
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=10,
+                    requirements=(
+                        NodeSelectorRequirement(labels.TOPOLOGY_ZONE, "In", ("mars",)),
+                    ),
+                )
+            ]
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_satisfiable_preference_honored(self):
+        pod = make_pod(
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=10,
+                    requirements=(
+                        NodeSelectorRequirement(
+                            labels.TOPOLOGY_ZONE, "In", ("test-zone-c",)
+                        ),
+                    ),
+                )
+            ]
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        claim = results.new_node_claims[0]
+        assert claim.requirements.get(labels.TOPOLOGY_ZONE).values == {"test-zone-c"}
+
+
+class TestExistingNodes:
+    def _state_node(self, client, cpu="16", zone="test-zone-a"):
+        from karpenter_tpu.controllers.state import StateNode
+
+        node = Node(
+            metadata=ObjectMeta(
+                name="existing-1",
+                labels={
+                    labels.TOPOLOGY_ZONE: zone,
+                    labels.HOSTNAME: "existing-1",
+                    labels.ARCH: "amd64",
+                    labels.OS: "linux",
+                    labels.INSTANCE_TYPE: "m-16x-amd64-linux",
+                },
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity(cpu),
+            "memory": res.parse_quantity("64Gi"),
+            "pods": res.parse_quantity("110"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        client.create(node)
+        return StateNode(node=node)
+
+    def test_pods_prefer_existing_capacity(self):
+        client = Client(TestClock())
+        sn = self._state_node(client)
+        results = solve(make_pods(3, cpu="1"), state_nodes=[sn], client=client)
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 0
+        assert len(results.existing_nodes[0].pods) == 3
+
+    def test_overflow_to_new_node(self):
+        client = Client(TestClock())
+        sn = self._state_node(client, cpu="2")
+        results = solve(make_pods(4, cpu="1"), state_nodes=[sn], client=client)
+        assert results.all_pods_scheduled()
+        assert results.node_count() == 1
+        assert len(results.existing_nodes[0].pods) == 2
+
+
+class TestDaemonOverhead:
+    def test_daemon_requests_reserved_on_new_nodes(self):
+        daemon = make_pod(cpu="1", memory="1Gi")
+        # smallest type is 1 cpu; with 1 cpu daemon overhead a 1-cpu pod
+        # cannot fit the 1x types
+        results = solve(
+            [make_pod(cpu="1")],
+            daemonset_pods=[daemon],
+            instance_types=corpus.generate(20),
+        )
+        assert results.all_pods_scheduled()
+        claim = results.new_node_claims[0]
+        for it in claim.instance_type_options:
+            assert it.allocatable()["cpu"] >= res.parse_quantity("2")
+
+
+class TestResultsTruncation:
+    def test_truncate_instance_types(self):
+        results = solve(make_pods(2), instance_types=corpus.generate(100))
+        results.truncate_instance_types(10)
+        for claim in results.new_node_claims:
+            assert len(claim.instance_type_options) <= 10
+
+    def test_total_price_positive(self):
+        results = solve(make_pods(3))
+        assert results.total_price() > 0

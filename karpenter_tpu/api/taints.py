@@ -25,7 +25,9 @@ def tolerates_taint(toleration, taint) -> bool:
         return False
     op = toleration.operator or "Equal"
     if op == "Exists":
-        return not toleration.value
+        # upstream ToleratesTaint matches unconditionally; API validation
+        # separately forbids a value with Exists
+        return True
     if op == "Equal":
         return (toleration.value or "") == (taint.value or "")
     return False

@@ -117,9 +117,7 @@ class KwokCloudProvider(CloudProvider):
         node_labels[labels_mod.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type()
         # claim requirements refine labels (e.g. a specific zone subset)
         for req in claim.spec.scheduling_requirements():
-            if req.key not in node_labels or not Requirements(req).get(req.key).has(
-                node_labels.get(req.key, "")
-            ):
+            if not req.has(node_labels.get(req.key, "")):
                 v = req.any()
                 if v:
                     node_labels[req.key] = v

@@ -336,6 +336,11 @@ class Cluster:
                         del self._nodes[pid]
             return
         pid = node.provider_id or f"node://{node.name}"
+        old_pid = self._node_name_to_provider_id.get(node.name)
+        if old_pid is not None and old_pid != pid:
+            # providerID appeared/changed after registration: drop the entry
+            # tracked under the old id (reference: cluster.go:606-612)
+            self._nodes.pop(old_pid, None)
         self._node_name_to_provider_id[node.name] = pid
         sn = self._nodes.get(pid)
         if sn is None:
@@ -381,6 +386,14 @@ class Cluster:
         if pod.spec.pod_anti_affinity:
             self._anti_affinity_pods.add(pod.uid)
         old_node = self._bindings.get(pod.uid)
+        if pod.status.phase in ("Succeeded", "Failed"):
+            # terminal pods release node usage (reference: cluster.go:337-349)
+            if old_node is not None:
+                sn = self._state_node_by_name(old_node)
+                if sn is not None:
+                    sn.remove_pod(pod.uid)
+                self._bindings.pop(pod.uid, None)
+            return
         if pod.spec.node_name:
             if old_node and old_node != pod.spec.node_name:
                 sn = self._state_node_by_name(old_node)

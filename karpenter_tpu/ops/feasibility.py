@@ -1,0 +1,163 @@
+"""Batched feasibility kernels: the tensorization of the per-pod filters.
+
+These jitted functions replace the reference's hot loops — per-key set walks
+in Requirements.Intersects/Compatible (requirements.go:241-262, 177-196) and
+the per-instance-type scan in filterInstanceTypesByRequirements
+(nodeclaim.go:363-426) — with masked AND/ANY reductions over
+(entities x keys x value-slots) boolean tensors. Shapes are static per
+snapshot bucket; everything fuses into a handful of XLA ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def requirements_intersect(a_def, a_neg, a_mask, b_def, b_neg, b_mask):
+    """Batched Requirements.Intersects (requirements.go:241-262).
+
+    All args broadcast over leading batch dims; key axis is -2 for masks'
+    [-2]=K, [-1]=V1. Undefined keys carry all-true masks, so the overlap test
+    alone is correct for them; the both-defined gate only matters for the
+    double-negation exemption.
+    """
+    overlap = jnp.any(a_mask & b_mask, axis=-1)  # [..., K]
+    exempt = a_neg & b_neg
+    ok = overlap | exempt | ~(a_def & b_def)
+    return jnp.all(ok, axis=-1)
+
+
+def requirements_compatible(
+    node_def, node_neg, node_mask, pod_def, pod_neg, pod_mask, well_known
+):
+    """Batched Requirements.Compatible with AllowUndefinedWellKnownLabels
+    (requirements.go:177-196): custom labels the pod constrains positively
+    must be defined node-side."""
+    custom_ok = jnp.all(~pod_def | well_known | node_def | pod_neg, axis=-1)
+    return custom_ok & requirements_intersect(
+        node_def, node_neg, node_mask, pod_def, pod_neg, pod_mask
+    )
+
+
+def merge_requirements(a_def, a_neg, a_mask, b_def, b_neg, b_mask):
+    """Requirement-set union-with-intersection (Requirements.Add): masks
+    AND, defined OR, neg only survives when both sides are negative."""
+    return a_def | b_def, a_neg & b_neg, a_mask & b_mask
+
+
+def offering_ok(zone_mask, ct_mask, o_avail, o_zone, o_ct):
+    """Batched 'has an available compatible offering'
+    (nodeclaim.go:389-397): any available offering whose concrete zone and
+    capacity-type values are admitted by the claim's masks.
+
+    zone_mask/ct_mask: [..., V1]; o_*: [T, O] (broadcast against leading
+    batch dims of the masks with a T axis).
+    """
+    z_ok = jnp.take_along_axis(
+        zone_mask[..., None, :], jnp.maximum(o_zone, 0)[..., None], axis=-1
+    )[..., 0] | (o_zone < 0)
+    c_ok = jnp.take_along_axis(
+        ct_mask[..., None, :], jnp.maximum(o_ct, 0)[..., None], axis=-1
+    )[..., 0] | (o_ct < 0)
+    return jnp.any(o_avail & z_ok & c_ok, axis=-1)
+
+
+def fits_count(alloc, base, req):
+    """How many identical pods of `req` fit on top of `base` within `alloc`.
+
+    alloc/base/req broadcast to [..., R]. Mirrors resources.Fits
+    (resources.go:217-231) applied repeatedly: zero-request resources only
+    need base <= alloc; positive-request resources bound the count.
+    """
+    headroom = alloc - base
+    ok_zero = jnp.all((req > 0) | (headroom >= 0), axis=-1)
+    per_res = jnp.where(req > 0, jnp.floor(headroom / jnp.maximum(req, 1e-9)), jnp.inf)
+    n = jnp.min(per_res, axis=-1)
+    n = jnp.where(jnp.isinf(n), jnp.float32(2**30), n)
+    return jnp.where(ok_zero, jnp.maximum(n, 0), 0).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("zone_kid", "ct_kid"))
+def fresh_claim_feasibility(
+    g_def, g_neg, g_mask, g_req,
+    p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+    t_def, t_mask, t_alloc,
+    o_avail, o_zone, o_ct,
+    well_known,
+    zone_kid: int,
+    ct_kid: int,
+):
+    """For every (template P, group G): can a fresh claim from P host pods of
+    G, and on which instance types?
+
+    Returns:
+      compat_pg   [P, G]      pod-vs-template compatibility incl. taints
+      type_ok_pgt [P, G, T]   per-type feasibility for a single pod
+      n_fit_pgt   [P, G, T]   pods of G per fresh node of type T
+    """
+    P, K, V1 = p_mask.shape
+    G = g_mask.shape[0]
+
+    # claim requirements = template ∪ group
+    c_def, c_neg, c_mask = merge_requirements(
+        p_def[:, None, :], p_neg[:, None, :], p_mask[:, None, :, :],
+        g_def[None, :, :], g_neg[None, :, :], g_mask[None, :, :, :],
+    )  # [P, G, K(,V1)]
+
+    compat_pg = p_tol & requirements_compatible(
+        p_def[:, None, :], p_neg[:, None, :], p_mask[:, None, :, :],
+        g_def[None, :, :], g_neg[None, :, :], g_mask[None, :, :, :],
+        well_known,
+    )  # [P, G]
+
+    # instance-type compatibility vs merged claim requirements
+    # (compatible() in nodeclaim.go:428-430 is Intersects only)
+    t_neg = jnp.zeros_like(t_def)
+    type_compat = requirements_intersect(
+        t_def[None, None, :, :], t_neg[None, None, :, :], t_mask[None, None, :, :, :],
+        c_def[:, :, None, :], c_neg[:, :, None, :], c_mask[:, :, None, :, :],
+    )  # [P, G, T]
+
+    # offerings vs merged zone/capacity-type masks
+    off = offering_ok(
+        c_mask[:, :, None, zone_kid, :], c_mask[:, :, None, ct_kid, :],
+        o_avail[None, None, :, :], o_zone[None, None, :, :], o_ct[None, None, :, :],
+    )  # [P, G, T]
+
+    n_fit = fits_count(
+        t_alloc[None, None, :, :], p_daemon[:, None, None, :], g_req[None, :, None, :]
+    )  # [P, G, T]
+
+    type_ok = (
+        type_compat & off & (n_fit >= 1) & p_titype_ok[:, None, :] & compat_pg[:, :, None]
+    )
+    return compat_pg, type_ok, n_fit
+
+
+@jax.jit
+def existing_node_feasibility(
+    g_def, g_neg, g_mask, g_req,
+    n_def, n_mask, n_avail, n_base, n_tol,
+    well_known,
+):
+    """For every (existing node N, group G): capacity for pods of G.
+
+    Existing nodes have concrete labels, so compatibility uses the strict
+    direction (no well-known allowance — existingnode.go:96 calls Compatible
+    without options).
+
+    Returns cap_ng [N, G] int32.
+    """
+    n_neg = jnp.zeros_like(n_def)
+    compat = requirements_compatible(
+        n_def[:, None, :], n_neg[:, None, :], n_mask[:, None, :, :],
+        g_def[None, :, :], g_neg[None, :, :], g_mask[None, :, :, :],
+        jnp.zeros_like(well_known),
+    )  # [N, G]
+    cap = fits_count(
+        n_avail[:, None, :], n_base[:, None, :], g_req[None, :, :]
+    )  # [N, G]
+    return jnp.where(compat & n_tol, cap, 0)

@@ -1,0 +1,285 @@
+"""Grouped first-fit-decreasing packing as a lax.scan.
+
+The reference places one pod at a time, mutating per-node state
+(scheduler.go:357-425). Here the scan runs over pod *groups* (equivalence
+classes); each step places a whole group:
+
+1. existing nodes, in priority order, greedy prefix fill (the per-pod
+   "first accepting node in fixed order" collapses to a cumsum);
+2. open claims, least-loaded first (the per-pod "sort by fewest pods, first
+   accepting" collapses to an integer water-fill, solved by bisection);
+3. new claims from the highest-weight feasible template, opened one at a
+   time in a while_loop because each opening pessimistically debits the
+   NodePool limit ledger (subtractMax, scheduler.go:498-515) which can
+   change the feasible template/type set for the next claim.
+
+All constraint checks are precomputed batched tables from
+ops/feasibility.py; the scan body is index arithmetic over [NMAX] slots.
+Pods with sequential topology state are not routed here (see
+solver/encode.py:is_tensorizable).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .feasibility import fits_count
+
+
+def _cumsum_excl(x, axis=-1):
+    return jnp.cumsum(x, axis=axis) - x
+
+
+def greedy_prefix_fill(cap, n):
+    """Fill slots in order: slot i gets min(cap_i, remaining)."""
+    before = _cumsum_excl(cap)
+    return jnp.clip(n - before, 0, cap)
+
+
+def waterfill(npods, cap, n):
+    """Distribute n pods to slots, always to the least-loaded slot with
+    remaining capacity (ties by slot index). Returns fills [NSLOTS] int32.
+
+    Equivalent to the reference's per-pod re-sort by fewest pods
+    (scheduler.go:366); solved as: find the smallest water level L with
+    f(L) = sum(clip(L - npods, 0, cap)) >= n by bisection, then hand the
+    deficit layer out by slot index.
+    """
+    n = jnp.minimum(n, jnp.sum(cap))
+
+    def f(level):
+        return jnp.sum(jnp.clip(level - npods, 0, cap))
+
+    hi0 = jnp.max(npods + cap) + 1
+
+    def body(_, lo_hi):
+        lo, hi = lo_hi
+        mid = (lo + hi) // 2
+        ge = f(mid) >= n
+        return jnp.where(ge, lo, mid), jnp.where(ge, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (jnp.int32(0), hi0.astype(jnp.int32)))
+    level = hi  # smallest L with f(L) >= n
+    base = jnp.clip((level - 1) - npods, 0, cap)
+    deficit = n - jnp.sum(base)
+    elig = (base < cap) & (npods <= level - 1)
+    rank = jnp.cumsum(elig.astype(jnp.int32))
+    fills = base + (elig & (rank <= deficit)).astype(jnp.int32)
+    return fills
+
+
+class PackState(NamedTuple):
+    exist_used: jnp.ndarray  # [N, R]
+    c_used: jnp.ndarray  # [NMAX, R]
+    c_npods: jnp.ndarray  # [NMAX] int32
+    c_active: jnp.ndarray  # [NMAX] bool
+    c_pool: jnp.ndarray  # [NMAX] int32
+    c_tmask: jnp.ndarray  # [NMAX, T] bool
+    c_def: jnp.ndarray  # [NMAX, K] bool
+    c_neg: jnp.ndarray  # [NMAX, K] bool
+    c_mask: jnp.ndarray  # [NMAX, K, V1] bool
+    pool_rem: jnp.ndarray  # [P, R]
+    n_open: jnp.ndarray  # scalar int32
+    overflow: jnp.ndarray  # scalar bool
+
+
+@partial(jax.jit, static_argnames=("nmax", "zone_kid", "ct_kid"))
+def pack(
+    # groups (FFD order)
+    g_count, g_req, g_def, g_neg, g_mask,
+    # precomputed feasibility tables
+    compat_pg, type_ok_pgt, n_fit_pgt,  # [P,G], [P,G,T], [P,G,T]
+    cap_ng,  # [N, G] existing-node capacity at t0 (compat ∧ taints)
+    # instance types
+    t_alloc, t_cap,
+    # offerings zone×ct availability per type
+    a_tzc,  # [T, Vz, Vc] bool
+    # templates
+    p_daemon, p_limit, p_has_limit, p_tol,
+    # existing nodes
+    n_avail, n_base,
+    well_known,
+    nmax: int,
+    zone_kid: int,
+    ct_kid: int,
+):
+    """Run the grouped-FFD scan. Returns per-group placement matrices and the
+    final claim state for decoding."""
+    P, G, T = type_ok_pgt.shape
+    N = n_avail.shape[0]
+    R = t_alloc.shape[1]
+    K, V1 = g_mask.shape[1], g_mask.shape[2]
+
+    a_tzc_f = a_tzc.astype(jnp.float32)
+
+    state = PackState(
+        exist_used=n_base,
+        c_used=jnp.zeros((nmax, R), jnp.float32),
+        c_npods=jnp.zeros((nmax,), jnp.int32),
+        c_active=jnp.zeros((nmax,), bool),
+        c_pool=jnp.zeros((nmax,), jnp.int32),
+        c_tmask=jnp.zeros((nmax, T), bool),
+        c_def=jnp.zeros((nmax, K), bool),
+        c_neg=jnp.zeros((nmax, K), bool),
+        c_mask=jnp.ones((nmax, K, V1), bool),
+        pool_rem=p_limit,
+        n_open=jnp.int32(0),
+        overflow=jnp.bool_(False),
+    )
+
+    def claim_offering_ok_per_type(zc_mask, cc_mask, tmask_unused=None):
+        """off[t] for every claim given its zone/ct masks [NMAX, V1]."""
+        # einsum over (claims, types, zone-values, ct-values)
+        vz = a_tzc.shape[1]
+        vc = a_tzc.shape[2]
+        z = zc_mask[:, :vz].astype(jnp.float32)
+        c = cc_mask[:, :vc].astype(jnp.float32)
+        return jnp.einsum("nz,tzc,nc->nt", z, a_tzc_f, c) > 0
+
+    def step(state: PackState, xs):
+        (gi,) = xs
+        count = g_count[gi]
+        req = g_req[gi]
+        gdef, gneg, gmask = g_def[gi], g_neg[gi], g_mask[gi]
+
+        # ---- 1. existing nodes, fixed priority order ----
+        exist_cap = jnp.where(
+            cap_ng[:, gi] > 0,
+            fits_count(n_avail, state.exist_used, req[None, :]),
+            0,
+        )
+        exist_fill = greedy_prefix_fill(exist_cap, count)
+        exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
+        rem = count - jnp.sum(exist_fill)
+
+        # ---- 2. open claims, least-loaded first ----
+        # claim-level compatibility with the group
+        overlap = jnp.any(state.c_mask & gmask[None, :, :], axis=-1)  # [NMAX,K]
+        exempt = state.c_neg & gneg[None, :]
+        key_ok = overlap | exempt | ~(state.c_def & gdef[None, :])
+        custom_ok = jnp.all(
+            ~gdef[None, :] | well_known[None, :] | state.c_def | gneg[None, :], axis=-1
+        )
+        claim_compat = jnp.all(key_ok, axis=-1) & custom_ok
+        claim_compat &= p_tol[state.c_pool, gi] & compat_pg[state.c_pool, gi]
+
+        # per-type feasibility on each claim: current options ∧ (template ∪
+        # group) table ∧ fits under current load ∧ offering under merged masks
+        merged_mask = state.c_mask & gmask[None, :, :]
+        tm = state.c_tmask & type_ok_pgt[state.c_pool, gi, :]
+        add_fit = fits_count(
+            t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
+        )  # [NMAX, T]
+        off = claim_offering_ok_per_type(
+            merged_mask[:, zone_kid, :], merged_mask[:, ct_kid, :]
+        )
+        tm = tm & off & (add_fit >= 1)
+        claim_cap = jnp.where(
+            state.c_active & claim_compat, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0
+        )
+        claim_fill = waterfill(state.c_npods, claim_cap, rem)
+        rem = rem - jnp.sum(claim_fill)
+
+        got = claim_fill > 0
+        c_used = state.c_used + claim_fill[:, None] * req[None, :]
+        c_npods = state.c_npods + claim_fill
+        c_def = state.c_def | (got[:, None] & gdef[None, :])
+        c_neg = jnp.where(got[:, None], state.c_neg & gneg[None, :], state.c_neg)
+        c_mask = jnp.where(got[:, None, None], merged_mask, state.c_mask)
+        # surviving types: previous options ∧ group table ∧ still fits load
+        still_fits = jnp.all(t_alloc[None, :, :] >= c_used[:, None, :], axis=-1)
+        c_tmask = jnp.where(
+            got[:, None],
+            state.c_tmask & type_ok_pgt[state.c_pool, gi, :] & off & still_fits,
+            state.c_tmask,
+        )
+
+        # ---- 3. new claims from highest-weight feasible template ----
+        def body(carry):
+            st, rem, fills = carry
+            # feasible types per template under the remaining pool limits
+            within_limits = jnp.where(
+                p_has_limit[:, None],
+                jnp.all(t_cap[None, :, :] <= st.pool_rem[:, None, :], axis=-1),
+                True,
+            )  # [P, T]
+            avail = type_ok_pgt[:, gi, :] & within_limits  # [P, T]
+            feas_p = jnp.any(avail, axis=-1)
+            p_star = jnp.argmax(feas_p)  # first True in weight order
+            any_feasible = jnp.any(feas_p)
+            n_per = jnp.max(jnp.where(avail[p_star], n_fit_pgt[p_star, gi], 0))
+            n_take = jnp.minimum(rem, n_per)
+
+            slot = st.n_open
+            would_overflow = slot >= nmax
+            ok = any_feasible & ~would_overflow & (n_take > 0)
+
+            tmask_new = avail[p_star] & (n_fit_pgt[p_star, gi] >= n_take)
+            used_new = p_daemon[p_star] + n_take.astype(jnp.float32) * req
+            # merged claim requirement state (template handled via tables; the
+            # stored masks start from the group's own constraint set)
+            write = lambda arr, val: jnp.where(
+                ok, arr.at[jnp.minimum(slot, nmax - 1)].set(val), arr
+            )
+            # pessimistic limit debit: max capacity over the claim's options
+            debit = jnp.max(
+                jnp.where(avail[p_star][:, None], t_cap, 0), axis=0
+            )  # [R]
+            pool_rem = jnp.where(
+                ok & p_has_limit[p_star],
+                st.pool_rem.at[p_star].add(-debit),
+                st.pool_rem,
+            )
+            st = st._replace(
+                c_used=write(st.c_used, used_new),
+                c_npods=write(st.c_npods, n_take),
+                c_active=write(st.c_active, True),
+                c_pool=write(st.c_pool, p_star),
+                c_tmask=write(st.c_tmask, tmask_new),
+                c_def=write(st.c_def, gdef),
+                c_neg=write(st.c_neg, gneg),
+                c_mask=write(st.c_mask, gmask),
+                pool_rem=pool_rem,
+                n_open=jnp.where(ok, slot + 1, st.n_open),
+                overflow=st.overflow | (any_feasible & would_overflow),
+            )
+            fills = jnp.where(
+                ok, fills.at[jnp.minimum(slot, nmax - 1)].add(n_take), fills
+            )
+            rem = jnp.where(ok, rem - n_take, rem)
+            return st, rem, fills
+
+        # loop while rem>0 and the last iteration made progress; a stuck
+        # iteration means no feasible template remains (those pods error out)
+        def cond2(carry):
+            st, rem, fills, stuck = carry
+            return (rem > 0) & ~st.overflow & ~stuck
+
+        def body2(carry):
+            st, rem, fills, _ = carry
+            st2, rem2, fills2 = body((st, rem, fills))
+            stuck = rem2 == rem  # no progress: unplaceable or overflow
+            return st2, rem2, fills2, stuck
+
+        new_state = state._replace(
+            exist_used=exist_used,
+            c_used=c_used,
+            c_npods=c_npods,
+            c_def=c_def,
+            c_neg=c_neg,
+            c_mask=c_mask,
+            c_tmask=c_tmask,
+        )
+        new_state, rem, claim_fill, _ = jax.lax.while_loop(
+            cond2, body2, (new_state, rem, claim_fill, jnp.bool_(False))
+        )
+        return new_state, (exist_fill, claim_fill, rem)
+
+    state, (exist_fills, claim_fills, unplaced) = jax.lax.scan(
+        step, state, (jnp.arange(G),)
+    )
+    return state, exist_fills, claim_fills, unplaced

@@ -1,0 +1,73 @@
+"""Fused one-dispatch solve kernel.
+
+Under the axon tunnel each jit dispatch costs tens of milliseconds of
+round-trip latency regardless of compute, so the feasibility tables
+(ops/feasibility.py) and the packing scan (ops/packing.py) are fused into a
+single jitted call: one host->device transfer of the snapshot, one dispatch,
+one device->host readback of the (small) placement matrices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .feasibility import existing_node_feasibility, fresh_claim_feasibility
+from .packing import pack
+
+
+@partial(jax.jit, static_argnames=("nmax", "zone_kid", "ct_kid"))
+def solve_all(
+    g_count, g_req, g_def, g_neg, g_mask,
+    p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
+    t_def, t_mask, t_alloc, t_cap,
+    o_avail, o_zone, o_ct,
+    a_tzc,
+    n_def, n_mask, n_avail, n_base, n_tol,
+    well_known,
+    nmax: int,
+    zone_kid: int,
+    ct_kid: int,
+):
+    compat_pg, type_ok, n_fit = fresh_claim_feasibility(
+        g_def, g_neg, g_mask, g_req,
+        p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+        t_def, t_mask, t_alloc,
+        o_avail, o_zone, o_ct,
+        well_known,
+        zone_kid=zone_kid,
+        ct_kid=ct_kid,
+    )
+    if n_avail.shape[0]:
+        cap_ng = existing_node_feasibility(
+            g_def, g_neg, g_mask, g_req,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            well_known,
+        )
+    else:
+        cap_ng = jnp.zeros((0, g_count.shape[0]), jnp.int32)
+
+    state, exist_fills, claim_fills, unplaced = pack(
+        g_count, g_req, g_def, g_neg, g_mask,
+        compat_pg, type_ok, n_fit,
+        cap_ng,
+        t_alloc, t_cap,
+        a_tzc,
+        p_daemon, p_limit, p_has_limit, p_tol,
+        n_avail, n_base,
+        well_known,
+        nmax=nmax,
+        zone_kid=zone_kid,
+        ct_kid=ct_kid,
+    )
+    return (
+        state.c_pool,
+        state.c_tmask,
+        state.n_open,
+        state.overflow,
+        exist_fills,
+        claim_fills,
+        unplaced,
+    )

@@ -537,12 +537,15 @@ class Topology:
     def _matching_topologies(
         self, pod: Pod, taints: Sequence[Taint], requirements: Requirements
     ) -> List[TopologyGroup]:
+        """Forward groups apply only to their OWNER pods; inverse
+        anti-affinity groups apply to any pod they select that would count on
+        this node (reference: topology.go:513-528)."""
         out = []
         for tg in self.topology_groups.values():
-            if tg.is_owned_by(pod.uid) or tg.counts(pod, taints, requirements):
+            if tg.is_owned_by(pod.uid):
                 out.append(tg)
         for tg in self.inverse_topology_groups.values():
-            if tg.selects(pod):
+            if tg.counts(pod, taints, requirements):
                 out.append(tg)
         return out
 

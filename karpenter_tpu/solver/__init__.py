@@ -1,0 +1,3 @@
+from .driver import TpuSolver, SolverConfig
+
+__all__ = ["TpuSolver", "SolverConfig"]

@@ -1,0 +1,261 @@
+"""TpuSolver: the batched solver behind the Scheduler seam.
+
+Routes pods between the TPU fast path and the host oracle:
+
+- *Tensorizable* pods (no pod-affinity/spread/host-port/minValues/Gt-Lt
+  state — solver/encode.py:is_tensorizable) are grouped, encoded to dense
+  arrays, and solved by the jitted feasibility + grouped-FFD kernels
+  (ops/feasibility.py, ops/packing.py).
+- Everything else falls through to the exact host oracle
+  (scheduling/scheduler.py) in the same solve, sharing existing-node
+  capacity with the TPU placements.
+
+The oracle remains the semantic source of truth; parity tests assert the two
+paths agree on node count and packing cost (tests/test_solver_parity.py).
+"""
+
+from __future__ import annotations
+
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as labels_mod
+from ..api import resources as res
+from ..api.objects import NodePool, Pod
+from ..api.requirements import Requirements
+from ..cloudprovider import types as cp
+from ..ops.solve import solve_all
+from ..scheduling.scheduler import Results, Scheduler
+from ..scheduling.template import NodeClaimTemplate
+from ..scheduling.topology import Topology
+from . import encode as enc
+
+
+@dataclass
+class SolverConfig:
+    max_claims: Optional[int] = None  # NMAX override; default auto-estimated
+    force_oracle: bool = False  # route everything host-side (debugging)
+
+
+@dataclass
+class DecodedClaim:
+    """A claim produced by the TPU path; duck-types InFlightNodeClaim for
+    Results consumers (pods, instance_type_options, requirements,
+    template)."""
+
+    template: NodeClaimTemplate
+    pods: List[Pod]
+    instance_type_options: List[cp.InstanceType]
+    requirements: Requirements
+
+    def finalize(self) -> None:  # parity with InFlightNodeClaim
+        pass
+
+
+class TpuSolver:
+    """Drop-in Solve() accelerator at the Scheduler seam."""
+
+    def __init__(
+        self,
+        node_pools: Sequence[NodePool],
+        instance_types: Dict[str, List[cp.InstanceType]],
+        topology: Topology,
+        state_nodes: Sequence = (),
+        daemonset_pods: Sequence[Pod] = (),
+        config: Optional[SolverConfig] = None,
+        **scheduler_kwargs,
+    ):
+        self.config = config or SolverConfig()
+        # the oracle scheduler provides template prefiltering, daemon
+        # overhead, existing-node models, and the fallback solve loop
+        self.oracle = Scheduler(
+            node_pools,
+            instance_types,
+            topology,
+            state_nodes=state_nodes,
+            daemonset_pods=daemonset_pods,
+            **scheduler_kwargs,
+        )
+        self.pool_limits = {
+            np_.name: dict(np_.spec.limits) for np_ in node_pools if np_.spec.limits
+        }
+
+    # -- solve ------------------------------------------------------------
+
+    def solve(self, pods: Sequence[Pod]) -> Results:
+        if self.config.force_oracle:
+            return self.oracle.solve(pods)
+        fast: List[Pod] = []
+        rest: List[Pod] = []
+        for p in pods:
+            (fast if enc.is_tensorizable(p) else rest).append(p)
+
+        tpu_claims: List[DecodedClaim] = []
+        tpu_errors: Dict[str, object] = {}
+        if fast:
+            tpu_claims, tpu_errors = self._solve_fast(fast)
+
+        results = self.oracle.solve(rest) if rest else Results(
+            new_node_claims=[], existing_nodes=self.oracle.existing_nodes, pod_errors={}
+        )
+        results.new_node_claims = list(results.new_node_claims) + list(tpu_claims)
+        results.pod_errors.update(tpu_errors)
+        return results
+
+    # -- fast path --------------------------------------------------------
+
+    def _solve_fast(self, pods: List[Pod]) -> Tuple[List[DecodedClaim], Dict[str, object]]:
+        import jax
+
+        groups = enc.build_groups(pods)
+        templates = self.oracle.templates
+        if not templates:
+            return [], {p.uid: "no nodepool matched pod" for p in pods}
+        its_by_pool = {
+            nct.node_pool_name: nct.instance_type_options for nct in templates
+        }
+        snap = enc.encode(
+            groups,
+            templates,
+            its_by_pool,
+            existing_nodes=self.oracle.existing_nodes,
+            daemon_overhead=self.oracle.daemon_overhead,
+            pool_limits=self.pool_limits,
+        )
+        a_tzc = self._offering_availability(snap)
+        nmax = self.config.max_claims or self._estimate_nmax(snap)
+
+        # one transfer, one dispatch, one readback (tunnel round-trips
+        # dominate small solves — see ops/solve.py)
+        args = jax.device_put(
+            (
+                snap.g_count, snap.g_req, snap.g_def, snap.g_neg, snap.g_mask,
+                snap.p_def, snap.p_neg, snap.p_mask, snap.p_daemon,
+                snap.p_limit, snap.p_has_limit, snap.p_tol, snap.p_titype_ok,
+                snap.t_def, snap.t_mask, snap.t_alloc, snap.t_cap,
+                snap.o_avail, snap.o_zone, snap.o_ct,
+                a_tzc,
+                snap.n_def, snap.n_mask, snap.n_avail, snap.n_base, snap.n_tol,
+                snap.well_known,
+            )
+        )
+        while True:
+            out = solve_all(
+                *args, nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid
+            )
+            c_pool, c_tmask, n_open, overflow, exist_fills, claim_fills, unplaced = (
+                np.asarray(x) for x in jax.device_get(out)
+            )
+            if not overflow:
+                break
+            nmax *= 2
+        return self._decode(
+            snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills, unplaced
+        )
+
+    def _estimate_nmax(self, snap: enc.EncodedSnapshot) -> int:
+        """Host-side claim-count bound: pods per node by the best
+        unconstrained fit. Compatibility can only shrink the real fit, so
+        this may undershoot; the overflow retry doubles NMAX in that case."""
+        alloc = snap.t_alloc[None, :, :] - np.min(snap.p_daemon, axis=0)[None, None, :]
+        req = snap.g_req[:, None, :]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(req > 0, np.floor(alloc / np.maximum(req, 1e-9)), np.inf)
+        n_fit = np.min(per, axis=-1)  # [G, T]
+        n_fit = np.where(np.isfinite(n_fit), n_fit, 0)
+        best = np.maximum(n_fit.max(axis=1), 1)
+        return enc._next_pow2(
+            int(np.ceil(snap.g_count / best).sum()) + len(snap.groups) + 8, floor=8
+        )
+
+    def _offering_availability(self, snap: enc.EncodedSnapshot) -> np.ndarray:
+        """A[T, Vz, Vc]: type t has an available offering in (zone z, ct c)."""
+        T, O = snap.o_avail.shape
+        _, V1 = snap.vocab.padded_shape()
+        A = np.zeros((T, V1, V1), dtype=bool)
+        for t in range(T):
+            for o in range(O):
+                if not snap.o_avail[t, o]:
+                    continue
+                z, c = snap.o_zone[t, o], snap.o_ct[t, o]
+                if z >= 0 and c >= 0:
+                    A[t, z, c] = True
+                elif z >= 0:
+                    A[t, z, :] = True
+                elif c >= 0:
+                    A[t, :, c] = True
+                else:
+                    A[t, :, :] = True
+        return A
+
+    # -- decode -----------------------------------------------------------
+
+    def _decode(
+        self,
+        snap: enc.EncodedSnapshot,
+        c_pool: np.ndarray,  # [NMAX]
+        c_tmask: np.ndarray,  # [NMAX, T]
+        n_open: int,
+        exist_fills: np.ndarray,  # [G, N]
+        claim_fills: np.ndarray,  # [G, NMAX]
+        unplaced: np.ndarray,  # [G]
+    ) -> Tuple[List[DecodedClaim], Dict[str, object]]:
+        self._cursors = {}
+
+        # existing-node fills: commit pods + requests onto the oracle's
+        # ExistingNode models so a subsequent oracle pass sees them.
+        # Iterate sparse nonzeros only; group-major order so pod cursors
+        # advance deterministically per group.
+        for gi, ni in zip(*np.nonzero(exist_fills)):
+            g = snap.groups[gi]
+            en = self.oracle.existing_nodes[ni]
+            k = int(exist_fills[gi, ni])
+            pods = g.pods[self._g_cursor(gi) : self._g_cursor(gi) + k]
+            self._advance(gi, k)
+            en.pods.extend(pods)
+            en.requests = res.merge(en.requests, *(p.spec.requests for p in pods))
+            en.requirements.add(*g.requirements.values())
+
+        claims: List[DecodedClaim] = []
+        claim_by_slot: Dict[int, DecodedClaim] = {}
+        type_ids_cache: Dict[bytes, List[cp.InstanceType]] = {}
+        for slot in range(n_open):
+            nct = snap.templates[int(c_pool[slot])]
+            tkey = c_tmask[slot].tobytes()
+            options = type_ids_cache.get(tkey)
+            if options is None:
+                options = [
+                    snap.instance_types[t] for t in np.nonzero(c_tmask[slot])[0]
+                ]
+                type_ids_cache[tkey] = options
+            claim = DecodedClaim(
+                nct, [], options, Requirements(*nct.requirements.values())
+            )
+            claim_by_slot[slot] = claim
+            claims.append(claim)
+        for gi, slot in zip(*np.nonzero(claim_fills)):
+            g = snap.groups[gi]
+            claim = claim_by_slot.get(int(slot))
+            if claim is None:
+                continue
+            k = int(claim_fills[gi, slot])
+            claim.pods.extend(g.pods[self._g_cursor(gi) : self._g_cursor(gi) + k])
+            self._advance(gi, k)
+            claim.requirements.add(*g.requirements.values())
+
+        errors: Dict[str, object] = {}
+        for gi, g in enumerate(snap.groups):
+            n_err = int(unplaced[gi])
+            if n_err:
+                for p in g.pods[self._g_cursor(gi) : self._g_cursor(gi) + n_err]:
+                    errors[p.uid] = "no feasible instance type/template for pod group"
+        return claims, errors
+
+    def _g_cursor(self, gi: int) -> int:
+        return self._cursors.get(gi, 0)
+
+    def _advance(self, gi: int, k: int) -> None:
+        self._cursors[gi] = self._cursors.get(gi, 0) + k

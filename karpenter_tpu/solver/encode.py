@@ -1,0 +1,379 @@
+"""Snapshot encoding: scheduling problem -> dense device arrays.
+
+The TPU formulation departs from the reference's pod-by-pod loop in two ways:
+
+1. **Pod grouping.** Pods with identical requests + requirements +
+   tolerations are one *group* with a count. A 50k-pod deployment becomes a
+   single group; the FFD scan runs over groups, not pods, and places whole
+   groups by water-filling (ops/packing.py).
+2. **Mask algebra.** Requirements become boolean masks over an interned
+   vocabulary (solver/vocab.py) so compatibility is a batched AND/ANY
+   reduction instead of per-key set walks (the vectorization of
+   filterInstanceTypesByRequirements, reference nodeclaim.go:363-426).
+
+Resource quantities are quantized to per-resource integer units that fit
+float32 exactly (cpu: milli, memory-like: MiB ceil-for-requests /
+floor-for-capacity, counts: whole): conservative, never over-packs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as labels_mod
+from ..api import resources as res
+from ..api import taints as taints_mod
+from ..api.objects import Pod
+from ..api.requirements import Requirements, pod_requirements
+from ..cloudprovider import types as cp
+from ..scheduling.template import NodeClaimTemplate
+from .vocab import Vocab, _next_pow2
+
+_MEMORY_LIKE = ("memory", "storage", "hugepages")
+
+
+def _unit_divisor(resource_name: str) -> int:
+    if resource_name == res.CPU:
+        return 1  # milli-cpu
+    if any(tag in resource_name for tag in _MEMORY_LIKE):
+        return 2**20 * res.MILLI  # MiB
+    return res.MILLI  # whole units (pods, gpus, ...)
+
+
+def quantize_requests(rl: res.ResourceList, names: Sequence[str]) -> np.ndarray:
+    """Ceil to units (requests must never be under-counted)."""
+    out = np.zeros(len(names), dtype=np.float32)
+    for i, name in enumerate(names):
+        q = rl.get(name, 0)
+        d = _unit_divisor(name)
+        out[i] = -((-q) // d)
+    return out
+
+def quantize_capacity(rl: res.ResourceList, names: Sequence[str]) -> np.ndarray:
+    """Floor to units (capacity must never be over-counted)."""
+    out = np.zeros(len(names), dtype=np.float32)
+    for i, name in enumerate(names):
+        out[i] = rl.get(name, 0) // _unit_divisor(name)
+    return out
+
+
+@dataclass
+class PodGroup:
+    """An equivalence class of schedulable pods."""
+
+    pods: List[Pod]
+    requirements: Requirements
+    requests: res.ResourceList
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+def group_key(pod: Pod) -> tuple:
+    """Equivalence key from raw spec primitives — no Requirements objects
+    are built per pod (hot for 50k-pod snapshots); the group's Requirements
+    are constructed once in build_groups."""
+    spec = pod.spec
+    affinity_key = ()
+    if spec.node_affinity is not None and spec.node_affinity.required:
+        affinity_key = tuple(
+            (t.key, t.operator, tuple(t.values), t.min_values)
+            for t in spec.node_affinity.required[0]
+        )
+    return (
+        tuple(sorted(spec.requests.items())),
+        tuple(sorted(spec.node_selector.items())),
+        affinity_key,
+        tuple(sorted((t.key, t.operator, t.value, t.effect) for t in spec.tolerations)),
+    )
+
+
+def is_tensorizable(pod: Pod) -> bool:
+    """Pods the TPU fast path handles this round; the rest route to the
+    host oracle (topology/host-port/preference state is sequential)."""
+    spec = pod.spec
+    if spec.topology_spread_constraints or spec.pod_affinity or spec.pod_anti_affinity:
+        return False
+    if spec.preferred_pod_affinity or spec.preferred_pod_anti_affinity:
+        return False
+    if spec.host_ports or spec.volumes:
+        return False
+    if spec.node_affinity is not None:
+        if spec.node_affinity.preferred or len(spec.node_affinity.required) > 1:
+            return False  # relaxation loop is host-side
+        for term in spec.node_affinity.required[:1]:
+            for r in term:
+                if r.min_values is not None:
+                    return False
+                # Gt/Lt carry operator identity the mask algebra can't retain
+                # through intersections (the double-negation exemption
+                # distinguishes NotIn from Gt); rare enough to stay host-side
+                if r.operator in ("Gt", "Lt"):
+                    return False
+    return True
+
+
+@dataclass
+class EncodedSnapshot:
+    """Device-ready arrays for one solve. Shapes:
+    G groups, T types, P templates(pools), N existing nodes, R resources,
+    K keys, V1 value slots (last = overflow), O offerings per type.
+    """
+
+    vocab: Vocab
+    resource_names: List[str]
+    groups: List[PodGroup]
+    templates: List[NodeClaimTemplate]
+    instance_types: List[cp.InstanceType]
+    existing_names: List[str]
+
+    # groups
+    g_count: np.ndarray  # [G] int32
+    g_req: np.ndarray  # [G, R] f32
+    g_def: np.ndarray  # [G, K] bool
+    g_neg: np.ndarray  # [G, K] bool
+    g_mask: np.ndarray  # [G, K, V1] bool
+
+    # instance types
+    t_alloc: np.ndarray  # [T, R] f32
+    t_cap: np.ndarray  # [T, R] f32 (capacity, for limits accounting)
+    t_def: np.ndarray  # [T, K] bool
+    t_mask: np.ndarray  # [T, K, V1] bool
+    t_price: np.ndarray  # [T] f32 cheapest available offering (unconstrained)
+
+    # offerings
+    o_avail: np.ndarray  # [T, O] bool
+    o_zone: np.ndarray  # [T, O] int32 (value id in zone vocab; -1 pad)
+    o_ct: np.ndarray  # [T, O] int32
+    o_price: np.ndarray  # [T, O] f32
+
+    # templates (nodepools, weight-desc order)
+    p_def: np.ndarray  # [P, K] bool
+    p_neg: np.ndarray  # [P, K] bool
+    p_mask: np.ndarray  # [P, K, V1] bool
+    p_daemon: np.ndarray  # [P, R] f32
+    p_limit: np.ndarray  # [P, R] f32 (inf when unlimited)
+    p_has_limit: np.ndarray  # [P] bool
+    p_titype_ok: np.ndarray  # [P, T] bool  template prefilter
+    p_tol: np.ndarray  # [P, G] bool  group tolerates template taints
+
+    # existing nodes (priority order: initialized first, then name)
+    n_avail: np.ndarray  # [N, R] f32 (available to new pods)
+    n_base: np.ndarray  # [N, R] f32 (already-committed daemon remainder)
+    n_def: np.ndarray  # [N, K] bool
+    n_mask: np.ndarray  # [N, K, V1] bool
+    n_tol: np.ndarray  # [N, G] bool
+
+    zone_kid: int
+    ct_kid: int
+    well_known: np.ndarray  # [K] bool
+
+
+def encode(
+    groups: List[PodGroup],
+    templates: List[NodeClaimTemplate],
+    instance_types_by_pool: Dict[str, List[cp.InstanceType]],
+    existing_nodes: Sequence = (),
+    daemon_overhead: Optional[Dict] = None,
+    pool_limits: Optional[Dict[str, res.ResourceList]] = None,
+) -> EncodedSnapshot:
+    vocab = Vocab()
+    # pin the topology keys so ids are stable
+    zone_kid = vocab.key_id(labels_mod.TOPOLOGY_ZONE)
+    ct_kid = vocab.key_id(labels_mod.CAPACITY_TYPE_LABEL_KEY)
+
+    # union of all instance types, stable order, deduped by name
+    seen = {}
+    for its in instance_types_by_pool.values():
+        for it in its:
+            seen.setdefault(it.name, it)
+    instance_types = list(seen.values())
+
+    # Constraint-side entities register values; provider-side entities only
+    # register keys and fall back to the overflow slot (see Vocab.observe) —
+    # this keeps the value axis independent of the instance-type count.
+    for g in groups:
+        vocab.observe(g.requirements)
+    for nct in templates:
+        vocab.observe(nct.requirements)
+    for it in instance_types:
+        vocab.observe_keys(it.requirements)
+        for o in it.offerings:
+            # zone/capacity-type values are indexed by the offering tables
+            z = o.requirements.get(labels_mod.TOPOLOGY_ZONE)
+            c = o.requirements.get(labels_mod.CAPACITY_TYPE_LABEL_KEY)
+            for v in z.values:
+                vocab.value_id(labels_mod.TOPOLOGY_ZONE, v)
+            for v in c.values:
+                vocab.value_id(labels_mod.CAPACITY_TYPE_LABEL_KEY, v)
+    for sn in existing_nodes:
+        vocab.observe_label_keys(sn.labels())
+
+    K, V1 = vocab.padded_shape()
+    resource_names = res.resource_names(
+        [g.requests for g in groups]
+        + [it.capacity for it in instance_types]
+        + ([daemon_overhead[nct] for nct in templates] if daemon_overhead else [])
+    )
+    R = len(resource_names)
+    G, T, P, N = len(groups), len(instance_types), len(templates), len(existing_nodes)
+
+    # -- groups -----------------------------------------------------------
+    g_count = np.array([g.count for g in groups], dtype=np.int32)
+    g_req = np.stack(
+        [quantize_requests(g.requests, resource_names) for g in groups]
+    ) if G else np.zeros((0, R), np.float32)
+    g_def = np.zeros((G, K), bool)
+    g_neg = np.zeros((G, K), bool)
+    g_mask = np.ones((G, K, V1), bool)
+    for i, g in enumerate(groups):
+        g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
+
+    # -- instance types ---------------------------------------------------
+    t_alloc = np.stack(
+        [quantize_capacity(it.allocatable(), resource_names) for it in instance_types]
+    ) if T else np.zeros((0, R), np.float32)
+    t_cap = np.stack(
+        [quantize_capacity(it.capacity, resource_names) for it in instance_types]
+    ) if T else np.zeros((0, R), np.float32)
+    t_def = np.zeros((T, K), bool)
+    t_mask = np.ones((T, K, V1), bool)
+    for i, it in enumerate(instance_types):
+        t_def[i], _, t_mask[i] = vocab.encode(it.requirements, K, V1)
+
+    O = _next_pow2(max((len(it.offerings) for it in instance_types), default=1))
+    o_avail = np.zeros((T, O), bool)
+    o_zone = np.full((T, O), -1, np.int32)
+    o_ct = np.full((T, O), -1, np.int32)
+    o_price = np.full((T, O), np.inf, np.float32)
+    t_price = np.full((T,), np.inf, np.float32)
+    for i, it in enumerate(instance_types):
+        for j, o in enumerate(it.offerings):
+            o_avail[i, j] = o.available
+            o_price[i, j] = o.price
+            z = o.requirements.get(labels_mod.TOPOLOGY_ZONE)
+            c = o.requirements.get(labels_mod.CAPACITY_TYPE_LABEL_KEY)
+            if not z.complement and len(z.values) == 1:
+                o_zone[i, j] = vocab.value_id(
+                    labels_mod.TOPOLOGY_ZONE, next(iter(z.values))
+                )
+            if not c.complement and len(c.values) == 1:
+                o_ct[i, j] = vocab.value_id(
+                    labels_mod.CAPACITY_TYPE_LABEL_KEY, next(iter(c.values))
+                )
+            if o.available and o.price < t_price[i]:
+                t_price[i] = o.price
+
+    # -- templates --------------------------------------------------------
+    p_def = np.zeros((P, K), bool)
+    p_neg = np.zeros((P, K), bool)
+    p_mask = np.ones((P, K, V1), bool)
+    p_daemon = np.zeros((P, R), np.float32)
+    p_limit = np.full((P, R), np.inf, np.float32)
+    p_has_limit = np.zeros((P,), bool)
+    p_titype_ok = np.zeros((P, T), bool)
+    p_tol = np.zeros((P, max(G, 1)), bool)
+    type_index = {it.name: i for i, it in enumerate(instance_types)}
+    for i, nct in enumerate(templates):
+        p_def[i], p_neg[i], p_mask[i] = vocab.encode(nct.requirements, K, V1)
+        if daemon_overhead and nct in daemon_overhead:
+            p_daemon[i] = quantize_requests(daemon_overhead[nct], resource_names)
+        limits = (pool_limits or {}).get(nct.node_pool_name)
+        if limits:
+            p_has_limit[i] = True
+            # remaining-limit accounting is in capacity units (floor)
+            for ri, rn in enumerate(resource_names):
+                if rn in limits:
+                    p_limit[i, ri] = limits[rn] // _unit_divisor(rn)
+        for it in nct.instance_type_options:
+            p_titype_ok[i, type_index[it.name]] = True
+        for gi, g in enumerate(groups):
+            p_tol[i, gi] = (
+                taints_mod.tolerates(nct.taints, g.pods[0].spec.tolerations) is None
+            )
+
+    # -- existing nodes ---------------------------------------------------
+    n_avail = np.zeros((N, R), np.float32)
+    n_base = np.zeros((N, R), np.float32)
+    n_def = np.zeros((N, K), bool)
+    n_mask = np.ones((N, K, V1), bool)
+    n_tol = np.zeros((N, max(G, 1)), bool)
+    existing_names = []
+    for i, en in enumerate(existing_nodes):
+        # `en` is a scheduling.inflight.ExistingNode (carries the remaining
+        # daemon requests and cached availability)
+        existing_names.append(en.name)
+        n_avail[i] = quantize_capacity(en.cached_available, resource_names)
+        n_base[i] = quantize_requests(en.requests, resource_names)
+        n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
+        for gi, g in enumerate(groups):
+            n_tol[i, gi] = (
+                taints_mod.tolerates(en.cached_taints, g.pods[0].spec.tolerations)
+                is None
+            )
+
+    return EncodedSnapshot(
+        vocab=vocab,
+        resource_names=resource_names,
+        groups=groups,
+        templates=templates,
+        instance_types=instance_types,
+        existing_names=existing_names,
+        g_count=g_count,
+        g_req=g_req,
+        g_def=g_def,
+        g_neg=g_neg,
+        g_mask=g_mask,
+        t_alloc=t_alloc,
+        t_cap=t_cap,
+        t_def=t_def,
+        t_mask=t_mask,
+        t_price=t_price,
+        o_avail=o_avail,
+        o_zone=o_zone,
+        o_ct=o_ct,
+        o_price=o_price,
+        p_def=p_def,
+        p_neg=p_neg,
+        p_mask=p_mask,
+        p_daemon=p_daemon,
+        p_limit=p_limit,
+        p_has_limit=p_has_limit,
+        p_titype_ok=p_titype_ok,
+        p_tol=p_tol,
+        n_avail=n_avail,
+        n_base=n_base,
+        n_def=n_def,
+        n_mask=n_mask,
+        n_tol=n_tol,
+        zone_kid=zone_kid,
+        ct_kid=ct_kid,
+        well_known=vocab.well_known_mask(K),
+    )
+
+
+def build_groups(pods: Sequence[Pod]) -> List[PodGroup]:
+    """Group tensorizable pods into equivalence classes, FFD-ordered."""
+    by_key: Dict[tuple, PodGroup] = {}
+    for pod in pods:
+        key = group_key(pod)
+        g = by_key.get(key)
+        if g is None:
+            by_key[key] = PodGroup(
+                [pod], pod_requirements(pod), dict(pod.spec.requests)
+            )
+        else:
+            g.pods.append(pod)
+    groups = list(by_key.values())
+    # FFD order over groups: cpu desc, then memory desc (queue.go:76-112)
+    groups.sort(
+        key=lambda g: (
+            -g.requests.get(res.CPU, 0),
+            -g.requests.get(res.MEMORY, 0),
+        )
+    )
+    return groups

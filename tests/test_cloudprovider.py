@@ -240,3 +240,37 @@ class TestKubeStore:
         client.create(make_claim())
         with pytest.raises(AlreadyExistsError):
             client.create(make_claim())
+
+
+class TestClusterStateRegressions:
+    def test_terminal_pod_releases_usage(self):
+        from karpenter_tpu.controllers.state import Cluster
+        from karpenter_tpu.api.objects import Node, NodeStatus
+        from karpenter_tpu.api import resources as res
+        from helpers import make_pod
+
+        client = Client(TestClock())
+        cluster = Cluster(client)
+        node = Node(metadata=ObjectMeta(name="n1"), provider_id="p://n1")
+        node.status.allocatable = {"cpu": 4000}
+        client.create(node)
+        pod = make_pod(cpu="3", node_name="n1", phase="Running")
+        client.create(pod)
+        sn = cluster.node_for_name("n1")
+        assert sn.available()["cpu"] == 1000
+        pod.status.phase = "Succeeded"
+        client.update(pod)
+        assert sn.available()["cpu"] == 4000
+
+    def test_provider_id_change_drops_synthetic_entry(self):
+        from karpenter_tpu.controllers.state import Cluster
+        from karpenter_tpu.api.objects import Node
+
+        client = Client(TestClock())
+        cluster = Cluster(client)
+        node = Node(metadata=ObjectMeta(name="n2"))
+        client.create(node)
+        assert len(cluster.nodes()) == 1
+        node.provider_id = "gce://n2"
+        client.update(node)
+        assert len(cluster.nodes()) == 1

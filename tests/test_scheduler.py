@@ -458,3 +458,19 @@ class TestResultsTruncation:
     def test_total_price_positive(self):
         results = solve(make_pods(3))
         assert results.total_price() > 0
+
+
+class TestTopologyOwnership:
+    def test_unconstrained_pods_not_bound_by_others_spread(self):
+        # Pods matched by ANOTHER pod's spread selector but carrying no
+        # constraint of their own must not be domain-restricted
+        # (topology.go:513-528: forward groups apply to owners only)
+        app = {"app": "x"}
+        spread_pod = make_pod(
+            labels=app, spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)]
+        )
+        plain = make_pods(4, labels=app, cpu="1")
+        results = solve([spread_pod] + plain)
+        assert results.all_pods_scheduled()
+        # plain pods pack together; only the spread pod is zone-pinned
+        assert results.node_count() <= 2

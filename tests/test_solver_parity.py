@@ -1,0 +1,170 @@
+"""TPU solver vs host oracle parity.
+
+The BASELINE metric is packing-cost delta, so parity is asserted on node
+count and total price (exact-assignment equality is not required — FFD
+tie-breaks differ legitimately; see SURVEY.md §7.4.4).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import TpuSolver
+
+from helpers import make_nodepool, make_pod, make_pods
+
+
+def run_both(pods, node_pools=None, instance_types=None, limits=None):
+    node_pools = node_pools or [make_nodepool(limits=limits)]
+    its = instance_types if instance_types is not None else corpus.generate(20)
+    its_by_pool = {np_.name: list(its) for np_ in node_pools}
+
+    def fresh_topology(pods_):
+        return Topology(Client(TestClock()), [], node_pools, its_by_pool, pods_)
+
+    import copy
+
+    oracle_pods = copy.deepcopy(pods)
+    oracle = Scheduler(node_pools, its_by_pool, fresh_topology(oracle_pods))
+    oracle_results = oracle.solve(oracle_pods)
+
+    solver = TpuSolver(node_pools, its_by_pool, fresh_topology(pods))
+    tpu_results = solver.solve(pods)
+    return oracle_results, tpu_results
+
+
+def assert_parity(oracle_results, tpu_results, cost_tol=0.0):
+    assert len(tpu_results.pod_errors) == len(oracle_results.pod_errors)
+    assert tpu_results.node_count() == oracle_results.node_count()
+    o_cost, t_cost = oracle_results.total_price(), tpu_results.total_price()
+    if o_cost > 0:
+        assert abs(t_cost - o_cost) <= cost_tol * o_cost + 1e-9, (t_cost, o_cost)
+
+
+class TestIdenticalPods:
+    def test_config0_500_identical(self):
+        """BASELINE config[0]: 500 identical pods, 10 types."""
+        oracle_r, tpu_r = run_both(
+            make_pods(500, cpu="1", memory="2Gi"), instance_types=corpus.generate(10)
+        )
+        assert_parity(oracle_r, tpu_r)
+
+    def test_small_batch(self):
+        oracle_r, tpu_r = run_both(make_pods(7, cpu="2", memory="4Gi"))
+        assert_parity(oracle_r, tpu_r)
+
+    def test_single_pod(self):
+        oracle_r, tpu_r = run_both([make_pod()])
+        assert_parity(oracle_r, tpu_r)
+
+
+class TestMixedPods:
+    def test_two_shapes(self):
+        pods = make_pods(20, cpu="1", memory="1Gi") + make_pods(5, cpu="8", memory="16Gi")
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+
+    def test_many_shapes(self, rng):
+        pods = []
+        for _ in range(30):
+            cpu = int(rng.integers(1, 8))
+            mem = int(rng.integers(1, 16))
+            count = int(rng.integers(1, 12))
+            pods += make_pods(count, cpu=str(cpu), memory=f"{mem}Gi")
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+
+    def test_gpu_mix(self):
+        pods = make_pods(10, cpu="1", memory="1Gi") + make_pods(
+            4, cpu="2", memory="8Gi", extra_requests={"nvidia.com/gpu": "1"}
+        )
+        oracle_r, tpu_r = run_both(pods, instance_types=corpus.generate())
+        assert_parity(oracle_r, tpu_r)
+
+
+class TestConstrainedPods:
+    def test_zone_selector(self):
+        pods = make_pods(12, cpu="1", node_selector={labels.TOPOLOGY_ZONE: "test-zone-b"})
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+        for claim in tpu_r.new_node_claims:
+            assert claim.requirements.get(labels.TOPOLOGY_ZONE).values == {"test-zone-b"}
+
+    def test_capacity_type_selector(self):
+        pods = make_pods(
+            6,
+            cpu="1",
+            node_selector={labels.CAPACITY_TYPE_LABEL_KEY: labels.CAPACITY_TYPE_ON_DEMAND},
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+
+    def test_arch_requirement(self):
+        pods = make_pods(
+            5,
+            requirements=[NodeSelectorRequirement(labels.ARCH, "In", ("arm64",))],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+
+    def test_impossible_zone(self):
+        pods = make_pods(3, node_selector={labels.TOPOLOGY_ZONE: "mars"})
+        oracle_r, tpu_r = run_both(pods)
+        assert len(tpu_r.pod_errors) == 3
+        assert_parity(oracle_r, tpu_r)
+
+    def test_oversized(self):
+        oracle_r, tpu_r = run_both([make_pod(cpu="1000")])
+        assert len(tpu_r.pod_errors) == 1
+        assert_parity(oracle_r, tpu_r)
+
+
+class TestNodePoolInteraction:
+    def test_weight_order(self):
+        pools = [make_nodepool("low", weight=1), make_nodepool("high", weight=50)]
+        oracle_r, tpu_r = run_both(make_pods(4), node_pools=pools)
+        assert_parity(oracle_r, tpu_r)
+        for claim in tpu_r.new_node_claims:
+            assert claim.template.node_pool_name == "high"
+
+    def test_limits_cap_claims(self):
+        # cap at 40 cpu; each claim pessimistically debits the largest
+        # option capacity
+        pools = [make_nodepool("limited", limits={"cpu": "40"})]
+        pods = make_pods(200, cpu="1", memory="1Gi")
+        oracle_r, tpu_r = run_both(pods, node_pools=pools)
+        assert_parity(oracle_r, tpu_r)
+        assert len(tpu_r.pod_errors) > 0  # limit prevents scheduling them all
+
+    def test_limits_fall_back(self):
+        pools = [
+            make_nodepool("limited", weight=50, limits={"cpu": "1"}),
+            make_nodepool("open", weight=1),
+        ]
+        oracle_r, tpu_r = run_both(make_pods(3), node_pools=pools)
+        assert_parity(oracle_r, tpu_r)
+        for claim in tpu_r.new_node_claims:
+            assert claim.template.node_pool_name == "open"
+
+
+class TestHybridRouting:
+    def test_spread_pods_fall_back_to_oracle(self):
+        from helpers import spread_constraint
+
+        app = {"app": "x"}
+        pods = make_pods(6, cpu="1") + make_pods(
+            3, labels=app, spread=[spread_constraint(labels.HOSTNAME, labels=app)]
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        # hostname spread forces 3 dedicated nodes via the oracle path
+        assert results.node_count() >= 4

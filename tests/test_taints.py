@@ -76,10 +76,11 @@ class TestEphemeral:
 
 
 class TestKubernetesParity:
-    def test_exists_with_value_never_tolerates(self):
-        # corev1.Toleration.ToleratesTaint: Exists requires empty value
+    def test_exists_with_value_still_tolerates(self):
+        # upstream ToleratesTaint matches unconditionally on Exists; API
+        # validation (not matching) forbids a value with Exists
         t = Toleration(key="k", operator="Exists", value="v", effect=taints.NO_SCHEDULE)
-        assert not taints.tolerates_taint(t, taint())
+        assert taints.tolerates_taint(t, taint())
 
     def test_unknown_operator_never_tolerates(self):
         t = Toleration(key="k", operator="Equals", value="v", effect=taints.NO_SCHEDULE)

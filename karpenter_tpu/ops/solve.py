@@ -18,8 +18,7 @@ from .feasibility import existing_node_feasibility, fresh_claim_feasibility
 from .packing import pack
 
 
-@partial(jax.jit, static_argnames=("nmax", "zone_kid", "ct_kid"))
-def solve_all(
+def solve_core(
     g_count, g_req, g_def, g_neg, g_mask,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
@@ -71,3 +70,6 @@ def solve_all(
         claim_fills,
         unplaced,
     )
+
+
+solve_all = jax.jit(solve_core, static_argnames=("nmax", "zone_kid", "ct_kid"))

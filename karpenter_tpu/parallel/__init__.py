@@ -1,0 +1,3 @@
+from .mesh import make_mesh, sharded_solve_fn, snapshot_shardings
+
+__all__ = ["make_mesh", "sharded_solve_fn", "snapshot_shardings"]

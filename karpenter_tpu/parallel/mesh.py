@@ -1,0 +1,98 @@
+"""Multi-chip sharding for the batch solver.
+
+The scaling axes of this domain map onto a 2-D device mesh:
+
+- ``data`` — pod groups (G). The feasibility tables are embarrassingly
+  parallel over groups; this is the data-parallel axis.
+- ``model`` — instance types (T). The (K x V1) mask reductions and the
+  offering contractions partition over types; this is the tensor-parallel
+  axis. The reference has no distributed backend at all (SURVEY.md §5) —
+  its analog of "scale" is pruning; here the dense tables shard across
+  chips and XLA inserts the all-gathers where the packing scan consumes
+  cross-type reductions over ICI.
+
+The packing scan itself is sequential over groups (the simulation's
+inherent dependence, SURVEY.md §7.4.1); its per-step state is small, so it
+runs effectively replicated while the heavy feasibility math stays sharded.
+GSPMD handles the resharding at the boundary inside one jitted program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None):
+    """Build a ('data', 'model') mesh over the first n devices."""
+    import jax
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = np.asarray(devices[:n])
+    if data is None:
+        # favor the model axis: type-sharding keeps the big masks local
+        data = 1
+        for cand in (2, 4, 8):
+            if n % cand == 0 and cand * cand <= n:
+                data = cand
+    model = n // data
+    return jax.sharding.Mesh(devices.reshape(data, model), ("data", "model"))
+
+
+def snapshot_shardings(mesh) -> Tuple:
+    """in_shardings for solve_core's argument list (ops/solve.py), sharding
+    group-major arrays over 'data' and type-major arrays over 'model'."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    S = lambda *spec: NamedSharding(mesh, P(*spec))
+    rep = S()
+    g = S("data")
+    t = S("model")
+    return (
+        g,  # g_count [G]
+        g,  # g_req [G, R]
+        g,  # g_def [G, K]
+        g,  # g_neg [G, K]
+        g,  # g_mask [G, K, V1]
+        rep,  # p_def
+        rep,  # p_neg
+        rep,  # p_mask
+        rep,  # p_daemon
+        rep,  # p_limit
+        rep,  # p_has_limit
+        S(None, "data"),  # p_tol [P, G]
+        S(None, "model"),  # p_titype_ok [P, T]
+        t,  # t_def [T, K]
+        t,  # t_mask [T, K, V1]
+        t,  # t_alloc [T, R]
+        t,  # t_cap [T, R]
+        t,  # o_avail [T, O]
+        t,  # o_zone [T, O]
+        t,  # o_ct [T, O]
+        t,  # a_tzc [T, V1, V1]
+        rep,  # n_def [N, K]
+        rep,  # n_mask
+        rep,  # n_avail
+        rep,  # n_base
+        S(None, "data"),  # n_tol [N, G]
+        rep,  # well_known [K]
+    )
+
+
+def sharded_solve_fn(mesh, nmax: int, zone_kid: int, ct_kid: int):
+    """The full solve step jitted over the mesh. Group/type-sharded inputs,
+    replicated outputs; XLA/GSPMD inserts the ICI collectives."""
+    import jax
+
+    from ..ops.solve import solve_core
+
+    return jax.jit(
+        partial(solve_core, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid),
+        in_shardings=snapshot_shardings(mesh),
+        out_shardings=jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        ),
+    )

@@ -1,0 +1,79 @@
+"""Self-contained example snapshot builders (no test fixtures needed).
+
+Used by __graft_entry__ (compile checks, multi-chip dryrun) and bench.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..api import resources as res
+from ..api.objects import NodePool, NodePoolSpec, ObjectMeta, Pod, PodSpec
+from ..api.objects import NodeClaimTemplate as NodeClaimTemplateSpec
+from ..cloudprovider import corpus
+from ..kube import Client, TestClock
+from ..scheduling.topology import Topology
+from . import encode as enc
+from .driver import TpuSolver
+
+
+def example_pods(count: int, shapes: int = 1) -> List[Pod]:
+    pods = []
+    for i in range(count):
+        s = i % shapes
+        pods.append(
+            Pod(
+                metadata=ObjectMeta(name=f"pod-{i}"),
+                spec=PodSpec(
+                    requests={
+                        res.CPU: (1 + s % 7) * res.MILLI,
+                        res.MEMORY: (1 + s % 9) * 2**30 * res.MILLI,
+                    }
+                ),
+            )
+        )
+    return pods
+
+
+def example_nodepool(name: str = "default") -> NodePool:
+    return NodePool(metadata=ObjectMeta(name=name), spec=NodePoolSpec())
+
+
+def example_solver(
+    n_pods: int, n_types: int, shapes: int = 1
+) -> Tuple[TpuSolver, List[Pod]]:
+    pods = example_pods(n_pods, shapes)
+    pools = [example_nodepool()]
+    its = {pools[0].name: corpus.generate(n_types)}
+    topology = Topology(Client(TestClock()), [], pools, its, pods)
+    return TpuSolver(pools, its, topology), pods
+
+
+def example_snapshot_arrays(n_pods: int, n_types: int, shapes: int = 1):
+    """Encoded snapshot + static kwargs for solve_core, ready to feed the
+    kernels directly."""
+    solver, pods = example_solver(n_pods, n_types, shapes)
+    groups = enc.build_groups(pods)
+    templates = solver.oracle.templates
+    snap = enc.encode(
+        groups,
+        templates,
+        {t.node_pool_name: t.instance_type_options for t in templates},
+        daemon_overhead=solver.oracle.daemon_overhead,
+    )
+    a_tzc = solver._offering_availability(snap)
+    nmax = solver._estimate_nmax(snap)
+    args = (
+        snap.g_count, snap.g_req, snap.g_def, snap.g_neg, snap.g_mask,
+        snap.p_def, snap.p_neg, snap.p_mask, snap.p_daemon,
+        snap.p_limit, snap.p_has_limit, snap.p_tol, snap.p_titype_ok,
+        snap.t_def, snap.t_mask, snap.t_alloc, snap.t_cap,
+        snap.o_avail, snap.o_zone, snap.o_ct,
+        a_tzc,
+        snap.n_def, snap.n_mask, snap.n_avail, snap.n_base, snap.n_tol,
+        snap.well_known,
+    )
+    statics = dict(nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
+    return args, statics

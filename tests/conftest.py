@@ -6,10 +6,20 @@ Multi-chip hardware is unavailable in CI; sharding tests run over a virtual
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The environment pre-imports jax (sitecustomize) with JAX_PLATFORMS=axon —
+# the tunneled TPU — so env vars alone are too late; the platform must be
+# switched through jax.config. XLA_FLAGS is still read lazily at CPU-backend
+# init, so setting it here gives the virtual 8-device mesh.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Respect an explicit non-axon platform request (e.g. a real multi-chip TPU
+# host); only the tunneled single-chip axon default is overridden.
+if os.environ.get("JAX_PLATFORMS", "axon") == "axon":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

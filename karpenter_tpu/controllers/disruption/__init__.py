@@ -1,0 +1,4 @@
+from .controller import DisruptionController
+from .types import Candidate, Command
+
+__all__ = ["DisruptionController", "Candidate", "Command"]

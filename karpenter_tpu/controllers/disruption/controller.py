@@ -1,0 +1,265 @@
+"""The disruption controller and orchestration queue.
+
+Mirror of the reference's disruption/controller.go:54-323 and
+orchestration/queue.go:57-189: every cycle gates on cluster sync, un-taints
+leftovers, then tries Drift -> Emptiness -> MultiNode -> SingleNode in order,
+stopping at the first command; executeCommand taints candidates, launches
+replacements, and hands the command to the async queue which waits for
+replacements to initialize before deleting the candidates.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...api import labels as labels_mod
+from ...api import taints as taints_mod
+from ...api.objects import (
+    COND_DISRUPTION_REASON,
+    COND_INITIALIZED,
+    Node,
+    NodeClaim,
+    Taint,
+)
+from ...events import Event, Recorder
+from ...kube import Client
+from ...metrics import Counter, Gauge
+from ..state import Cluster
+from .helpers import build_budget_mapping, get_candidates
+from .methods import (
+    Drift,
+    Emptiness,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from .types import Candidate, Command
+
+POLL_INTERVAL = 10.0  # controller.go:68
+QUEUE_BASE_DELAY = 1.0  # orchestration/queue.go:51-55
+QUEUE_MAX_DELAY = 10.0
+QUEUE_TIMEOUT = 600.0
+
+DECISIONS = Counter("disruption_decisions_total", "")
+ELIGIBLE_NODES = Gauge("disruption_eligible_nodes", "")
+ALLOWED_DISRUPTIONS = Gauge("disruption_allowed_disruptions", "")
+
+
+@dataclass
+class DisruptionContext:
+    client: Client
+    cluster: Cluster
+    cloud_provider: object
+    clock: object
+    recorder: Recorder
+    spot_to_spot_enabled: bool = False
+
+
+@dataclass
+class QueueItem:
+    command: Command
+    replacement_names: List[str]
+    added_at: float
+    attempts: int = 0
+    next_try: float = 0.0
+
+
+class OrchestrationQueue:
+    """Async command completion (orchestration/queue.go)."""
+
+    def __init__(self, ctx: DisruptionContext, provisioner=None):
+        self.ctx = ctx
+        self.items: List[QueueItem] = []
+
+    def has_provider_id(self, provider_id: str) -> bool:
+        return any(
+            c.provider_id == provider_id
+            for item in self.items
+            for c in item.command.candidates
+        )
+
+    def add(self, command: Command, replacement_names: List[str]) -> None:
+        self.items.append(
+            QueueItem(command, replacement_names, self.ctx.clock.now())
+        )
+
+    def reconcile(self) -> None:
+        now = self.ctx.clock.now()
+        remaining = []
+        for item in self.items:
+            if now < item.next_try:
+                remaining.append(item)
+                continue
+            done = self._process(item, now)
+            if not done:
+                remaining.append(item)
+        self.items = remaining
+
+    def _process(self, item: QueueItem, now: float) -> bool:
+        if now - item.added_at > QUEUE_TIMEOUT:
+            self._fail(item, "timed out waiting for replacements")
+            return True
+        # all replacements must be Initialized before candidates die
+        for name in item.replacement_names:
+            claim = self.ctx.client.try_get(NodeClaim, name)
+            if claim is None:
+                self._fail(item, f"replacement {name} disappeared")
+                return True
+            if not claim.conds().is_true(COND_INITIALIZED):
+                item.attempts += 1
+                item.next_try = now + min(
+                    QUEUE_BASE_DELAY * 2 ** min(item.attempts, 10), QUEUE_MAX_DELAY
+                )
+                return False
+        for candidate in item.command.candidates:
+            claim = self.ctx.client.try_get(NodeClaim, candidate.node_claim.name)
+            if claim is not None and claim.metadata.deletion_timestamp is None:
+                self.ctx.client.delete(claim)
+            node = self.ctx.client.try_get(Node, candidate.node.name)
+            if node is not None and node.metadata.deletion_timestamp is None:
+                self.ctx.client.delete(node)
+        DECISIONS.inc(
+            labels={
+                "decision": item.command.decision,
+                "reason": item.command.reason.lower() or "unknown",
+            }
+        )
+        return True
+
+    def _fail(self, item: QueueItem, message: str) -> None:
+        """Un-taint candidates and release state marks (queue.go failures)."""
+        for candidate in item.command.candidates:
+            node = self.ctx.client.try_get(Node, candidate.node.name)
+            if node is not None:
+                _remove_disruption_taint(self.ctx.client, node)
+            self.ctx.cluster.unmark_for_deletion(candidate.provider_id)
+            self.ctx.recorder.publish(
+                Event(candidate.node_claim.uid, "Warning", "DisruptionFailed", message)
+            )
+
+
+def _remove_disruption_taint(client: Client, node: Node) -> None:
+    before = len(node.taints)
+    node.taints = [
+        t for t in node.taints if t.key != labels_mod.DISRUPTED_TAINT_KEY
+    ]
+    if len(node.taints) != before:
+        client.update(node)
+
+
+class DisruptionController:
+    def __init__(
+        self,
+        ctx: DisruptionContext,
+        provisioner=None,
+    ):
+        self.ctx = ctx
+        self.provisioner = provisioner
+        self.queue = OrchestrationQueue(ctx)
+        self.methods = [
+            Drift(ctx),
+            Emptiness(ctx.clock),
+            MultiNodeConsolidation(ctx),
+            SingleNodeConsolidation(ctx),
+        ]
+        self._last_run = -POLL_INTERVAL
+
+    def reconcile(self, force: bool = False) -> Optional[Command]:
+        now = self.ctx.clock.now()
+        self.queue.reconcile()
+        if not force and now - self._last_run < POLL_INTERVAL:
+            return None
+        self._last_run = now
+        if not self.ctx.cluster.synced():
+            return None
+        self._untaint_leftovers()
+        for method in self.methods:
+            cmd = self._disrupt(method)
+            if cmd is not None and cmd.decision != "no-op":
+                return cmd
+        return None
+
+    def _untaint_leftovers(self) -> None:
+        """Remove disruption taints from nodes not tracked by the queue
+        (controller.go:124-141) — crash recovery idempotence."""
+        for node in self.ctx.client.list(Node):
+            if node.metadata.deletion_timestamp is not None:
+                continue
+            has_taint = any(
+                t.key == labels_mod.DISRUPTED_TAINT_KEY for t in node.taints
+            )
+            if has_taint and not self.queue.has_provider_id(node.provider_id):
+                _remove_disruption_taint(self.ctx.client, node)
+
+    def _disrupt(self, method) -> Optional[Command]:
+        now = self.ctx.clock.now()
+        candidates = get_candidates(
+            self.ctx.client,
+            self.ctx.cluster,
+            self.ctx.cloud_provider,
+            self.ctx.clock,
+            queue=self.queue,
+        )
+        candidates = [c for c in candidates if method.should_disrupt(c)]
+        ELIGIBLE_NODES.set(float(len(candidates)), labels={"method": method.reason})
+        if not candidates:
+            return None
+        if hasattr(method, "is_consolidated") and method.is_consolidated():
+            return None
+        budgets = build_budget_mapping(
+            self.ctx.client, self.ctx.cluster, method.reason, now
+        )
+        for pool, allowed in budgets.items():
+            ALLOWED_DISRUPTIONS.set(float(allowed), labels={"nodepool": pool})
+        cmd = method.compute_command(candidates, budgets)
+        if cmd.decision == "no-op":
+            if hasattr(method, "mark_consolidated"):
+                method.mark_consolidated()
+            return cmd
+        self.execute(cmd)
+        return cmd
+
+    # -- executeCommand (controller.go:199-247) ---------------------------
+
+    def execute(self, command: Command) -> None:
+        now = self.ctx.clock.now()
+        for candidate in command.candidates:
+            node = self.ctx.client.try_get(Node, candidate.node.name)
+            if node is not None and not any(
+                t.key == labels_mod.DISRUPTED_TAINT_KEY for t in node.taints
+            ):
+                node.taints.append(
+                    Taint(
+                        key=labels_mod.DISRUPTED_TAINT_KEY,
+                        effect=taints_mod.NO_SCHEDULE,
+                    )
+                )
+                self.ctx.client.update(node)
+            candidate.node_claim.conds().set(
+                COND_DISRUPTION_REASON, "True", command.reason, now=now
+            )
+            self.ctx.client.update_status(candidate.node_claim)
+            self.ctx.cluster.mark_for_deletion(candidate.provider_id)
+            self.ctx.recorder.publish(
+                Event(
+                    candidate.node_claim.uid,
+                    "Normal",
+                    "DisruptionLaunching",
+                    f"disrupting node via {command.reason}",
+                )
+            )
+        replacement_names = self._launch_replacements(command)
+        self.queue.add(command, replacement_names)
+
+    def _launch_replacements(self, command: Command) -> List[str]:
+        names = []
+        for claim_model in command.replacements:
+            claim = claim_model.template.to_node_claim(
+                instance_type_options=claim_model.instance_type_options,
+                requirements=claim_model.requirements,
+            )
+            claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
+            self.ctx.client.create(claim)
+            names.append(claim.name)
+        return names

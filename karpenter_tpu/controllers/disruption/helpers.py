@@ -1,0 +1,259 @@
+"""Shared disruption machinery: candidates, budgets, scheduling simulation.
+
+Mirror of the reference's disruption/helpers.go (SimulateScheduling:49-117,
+GetCandidates:148-165, BuildDisruptionBudgetMapping:201-249) and the budget
+windows in nodepool.go:296-367.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+from ...api import labels as labels_mod
+from ...api.objects import (
+    Budget,
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    COND_INITIALIZED,
+    Node,
+    NodeClaim,
+    NodePool,
+    Pod,
+)
+from ...scheduling.scheduler import Results
+from ...scheduling.topology import Topology
+from ...solver.driver import TpuSolver
+from ...utils import pod as pod_utils
+from ...utils.pdb import Limits
+from ..state import Cluster, StateNode
+from .types import Candidate, lifetime_remaining, rescheduling_cost
+
+ALL_REASONS = ("Underutilized", "Empty", "Drifted")
+
+
+def get_candidates(
+    client,
+    cluster: Cluster,
+    cloud_provider,
+    clock,
+    condition: Optional[str] = None,
+    queue=None,
+) -> List[Candidate]:
+    """Disruptable state nodes, optionally gated on a status condition
+    (helpers.go:148-165)."""
+    pdb_limits = Limits.from_client(client)
+    now = clock.now()
+    pools = {np_.name: np_ for np_ in client.list(NodePool)}
+    out = []
+    for sn in cluster.nodes():
+        if queue is not None and queue.has_provider_id(sn.provider_id):
+            continue
+        err = sn.disruptable_error(pdb_limits, now)
+        if err is not None:
+            continue
+        claim = sn.node_claim
+        node = sn.node
+        if claim is None or node is None:
+            continue
+        if not claim.conds().is_true(COND_INITIALIZED):
+            continue
+        if condition is not None and not claim.conds().is_true(condition):
+            continue
+        pool = pools.get(claim.nodepool_name)
+        if pool is None:
+            continue
+        instance_type = _instance_type_of(cloud_provider, pool, claim)
+        price = _candidate_price(instance_type, node)
+        pods = sn.reschedulable_pods()
+        out.append(
+            Candidate(
+                state_node=sn,
+                node=node,
+                node_claim=claim,
+                node_pool=pool,
+                instance_type=instance_type,
+                capacity_type=node.metadata.labels.get(
+                    labels_mod.CAPACITY_TYPE_LABEL_KEY, ""
+                ),
+                zone=node.metadata.labels.get(labels_mod.TOPOLOGY_ZONE, ""),
+                price=price,
+                disruption_cost=rescheduling_cost(pods)
+                * lifetime_remaining(now, claim),
+                reschedulable_pods=pods,
+            )
+        )
+    return out
+
+
+def _instance_type_of(cloud_provider, pool, claim):
+    name = claim.metadata.labels.get(labels_mod.INSTANCE_TYPE)
+    for it in cloud_provider.get_instance_types(pool):
+        if it.name == name:
+            return it
+    return None
+
+
+def _candidate_price(instance_type, node) -> float:
+    if instance_type is None:
+        return 0.0
+    zone = node.metadata.labels.get(labels_mod.TOPOLOGY_ZONE, "")
+    ct = node.metadata.labels.get(labels_mod.CAPACITY_TYPE_LABEL_KEY, "")
+    for o in instance_type.offerings:
+        if o.zone() == zone and o.capacity_type() == ct:
+            return o.price
+    return 0.0
+
+
+def simulate_scheduling(
+    client,
+    cluster: Cluster,
+    cloud_provider,
+    candidates: Sequence[Candidate],
+    solver_config=None,
+) -> Results:
+    """Re-run the scheduler as if the candidates were gone
+    (helpers.go:49-117): state snapshot minus candidates, their
+    reschedulable pods plus pending pods as the workload."""
+    candidate_ids = {c.provider_id for c in candidates}
+    state_nodes = [
+        sn
+        for sn in cluster.nodes()
+        if sn.provider_id not in candidate_ids
+        and not (sn.mark_for_deletion or sn.deleting())
+    ]
+    pods: List[Pod] = []
+    for c in candidates:
+        pods.extend(c.reschedulable_pods)
+    pods += [
+        p for p in client.list(Pod) if pod_utils.is_provisionable(p)
+    ]
+    node_pools = sorted(
+        client.list(NodePool), key=lambda p: (-p.spec.weight, p.name)
+    )
+    instance_types = {
+        np_.name: cloud_provider.get_instance_types(np_) for np_ in node_pools
+    }
+    topology = Topology(
+        client, state_nodes, node_pools, instance_types, pods, cluster=cluster
+    )
+    solver = TpuSolver(
+        node_pools,
+        instance_types,
+        topology,
+        state_nodes=state_nodes,
+        config=solver_config,
+    )
+    return solver.solve(pods)
+
+
+# -- budgets (nodepool.go:296-367, helpers.go:201-249) ---------------------
+
+
+def _parse_budget_nodes(value: str, total: int) -> int:
+    if value.endswith("%"):
+        pct = int(value[:-1])
+        return math.ceil(total * pct / 100.0)
+    return int(value)
+
+
+def _cron_matches(expr: str, t_struct) -> bool:
+    """Minimal 5-field cron matcher (minute hour dom month dow)."""
+    fields = expr.split()
+    if fields and fields[0].startswith("@"):
+        shorthand = {
+            "@yearly": "0 0 1 1 *", "@annually": "0 0 1 1 *",
+            "@monthly": "0 0 1 * *", "@weekly": "0 0 * * 0",
+            "@daily": "0 0 0 * *".replace("0 0 0", "0 0"), "@midnight": "0 0 * * *",
+            "@hourly": "0 * * * *",
+        }
+        fields = shorthand.get(fields[0], "* * * * *").split()
+    if len(fields) != 5:
+        return False
+    values = (
+        t_struct.tm_min,
+        t_struct.tm_hour,
+        t_struct.tm_mday,
+        t_struct.tm_mon,
+        t_struct.tm_wday if t_struct.tm_wday != 6 else 6,  # python: mon=0
+    )
+    # cron dow: 0=sunday; python tm_wday: 0=monday
+    cron_dow = (t_struct.tm_wday + 1) % 7
+    values = values[:4] + (cron_dow,)
+    for field, value in zip(fields, values):
+        if not _cron_field_matches(field, value):
+            return False
+    return True
+
+
+def _cron_field_matches(field: str, value: int) -> bool:
+    for part in field.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", ""):
+            if value % step == 0 or step == 1:
+                return True
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            if int(lo) <= value <= int(hi) and (value - int(lo)) % step == 0:
+                return True
+        elif int(part) == value and step == 1:
+            return True
+    return False
+
+
+def budget_active(budget: Budget, now: float) -> bool:
+    """Is the budget's schedule window active at `now`? Budgets without a
+    schedule are always active; with a schedule, active if the cron matched
+    within the last `duration` seconds."""
+    if budget.schedule is None:
+        return True
+    import time as _time
+
+    duration = budget.duration or 0.0
+    # scan minute marks within the window (duration is bounded in practice)
+    t = int(now - (now % 60))
+    steps = int(duration // 60) + 1
+    for i in range(steps):
+        ts = t - i * 60
+        if _cron_matches(budget.schedule, _time.gmtime(ts)):
+            return True
+    return False
+
+
+def allowed_disruptions(pool: NodePool, cluster_nodes: List[StateNode], reason: str, now: float) -> int:
+    """allowed = min over active budgets of (budget nodes) - (deleting or
+    not-ready nodes in the pool) (helpers.go:201-249)."""
+    pool_nodes = [
+        sn
+        for sn in cluster_nodes
+        if sn.labels().get(labels_mod.NODEPOOL_LABEL_KEY) == pool.name
+        and sn.managed()
+    ]
+    total = len(pool_nodes)
+    disrupting = sum(
+        1
+        for sn in pool_nodes
+        if sn.mark_for_deletion or sn.deleting() or not sn.initialized()
+    )
+    allowed = total  # no budgets -> unbounded within pool size
+    for budget in pool.spec.disruption.budgets:
+        if budget.reasons and reason not in budget.reasons:
+            continue
+        if not budget_active(budget, now):
+            continue
+        allowed = min(allowed, _parse_budget_nodes(budget.nodes, total))
+    return max(0, allowed - disrupting)
+
+
+def build_budget_mapping(
+    client, cluster: Cluster, reason: str, now: float
+) -> Dict[str, int]:
+    nodes = cluster.nodes()
+    return {
+        np_.name: allowed_disruptions(np_, nodes, reason, now)
+        for np_ in client.list(NodePool)
+    }

@@ -1,0 +1,322 @@
+"""Disruption methods: Emptiness, Drift, Multi- and Single-node
+consolidation.
+
+Mirror of the reference's method implementations
+(emptiness.go:33-134, drift.go:37-127, multinodeconsolidation.go:36-222,
+singlenodeconsolidation.go:34-174, consolidation.go:45-326). Each method
+computes a Command; the controller tries them in order and stops at the
+first success. Consolidation's inner oracle is the batch solver, so every
+binary-search probe is one batched Solve.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ...api import labels as labels_mod
+from ...api.objects import (
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    CONSOLIDATION_WHEN_EMPTY,
+    CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,
+)
+from ...api.requirements import Operator, Requirement
+from ...cloudprovider import types as cp
+from .helpers import simulate_scheduling
+from .types import Candidate, Command
+
+MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:36
+SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0  # singlenodeconsolidation.go:34
+MAX_MULTI_NODE_CANDIDATES = 100  # multinodeconsolidation.go:80-82
+MIN_SPOT_TO_SPOT_TYPES = 15  # consolidation.go:48-49
+
+
+class Method:
+    reason = ""
+    consolidation_type = ""
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        raise NotImplementedError
+
+    def compute_command(self, candidates: List[Candidate], budgets: Dict[str, int]) -> Command:
+        raise NotImplementedError
+
+    def class_name(self) -> str:
+        return "graceful"
+
+
+def _budget_filter(candidates: List[Candidate], budgets: Dict[str, int]) -> List[Candidate]:
+    """Take candidates per-pool up to the allowed budget."""
+    taken: Dict[str, int] = {}
+    out = []
+    for c in candidates:
+        pool = c.node_pool.name
+        if taken.get(pool, 0) < budgets.get(pool, 0):
+            taken[pool] = taken.get(pool, 0) + 1
+            out.append(c)
+    return out
+
+
+class Emptiness(Method):
+    """Delete empty consolidatable nodes in bulk (emptiness.go:33-134)."""
+
+    reason = "Empty"
+    consolidation_type = "empty"
+
+    def __init__(self, clock):
+        self.clock = clock
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        if candidate.node_pool.spec.disruption.consolidate_after is None:
+            return False
+        return (
+            candidate.node_claim.conds().is_true(COND_CONSOLIDATABLE)
+            and not candidate.reschedulable_pods
+        )
+
+    def compute_command(self, candidates, budgets) -> Command:
+        empty = [c for c in candidates if not c.reschedulable_pods]
+        empty = _budget_filter(empty, budgets)
+        return Command(candidates=empty, reason=self.reason, consolidation_type=self.consolidation_type)
+
+
+class Drift(Method):
+    """Replace drifted nodes, oldest first (drift.go:37-127)."""
+
+    reason = "Drifted"
+    consolidation_type = ""
+
+    def __init__(self, ctx):
+        self.ctx = ctx  # DisruptionContext
+
+    def class_name(self) -> str:
+        return "eventual"
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        return candidate.node_claim.conds().is_true(COND_DRIFTED)
+
+    def compute_command(self, candidates, budgets) -> Command:
+        candidates = sorted(
+            candidates, key=lambda c: c.node_claim.metadata.creation_timestamp
+        )
+        candidates = _budget_filter(candidates, budgets)
+        # delete all empty drifted nodes in one shot
+        empty = [c for c in candidates if not c.reschedulable_pods]
+        if empty:
+            return Command(candidates=empty, reason=self.reason)
+        # else per-candidate simulate + replace
+        for c in candidates:
+            results = simulate_scheduling(
+                self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, [c]
+            )
+            if results.pod_errors:
+                continue
+            return Command(
+                candidates=[c],
+                replacements=list(results.new_node_claims),
+                reason=self.reason,
+            )
+        return Command(reason=self.reason)
+
+
+class ConsolidationBase(Method):
+    """Shared consolidation logic (consolidation.go:45-326)."""
+
+    reason = "Underutilized"
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._last_consolidation_state = -1.0
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        policy = candidate.node_pool.spec.disruption.consolidation_policy
+        if policy != CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED:
+            return False
+        if candidate.node_pool.spec.disruption.consolidate_after is None:
+            return False
+        return candidate.node_claim.conds().is_true(COND_CONSOLIDATABLE)
+
+    def is_consolidated(self) -> bool:
+        """Cluster-unchanged memoization (consolidation.go:79-86)."""
+        return (
+            self.ctx.cluster.consolidation_state(self.ctx.clock.now())
+            == self._last_consolidation_state
+        )
+
+    def mark_consolidated(self) -> None:
+        self._last_consolidation_state = self.ctx.cluster.mark_consolidated(
+            self.ctx.clock.now()
+        )
+
+    # -- the core replacement computation ------------------------------
+
+    def compute_consolidation(self, candidates: List[Candidate]) -> Command:
+        results = simulate_scheduling(
+            self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, candidates
+        )
+        if results.pod_errors:
+            return Command()
+        if not results.new_node_claims:
+            return Command(candidates=list(candidates), reason=self.reason,
+                           consolidation_type=self.consolidation_type)
+        if len(results.new_node_claims) != 1:
+            return Command()
+
+        replacement = results.new_node_claims[0]
+        candidate_price = sum(c.price for c in candidates)
+        all_spot = all(
+            c.capacity_type == labels_mod.CAPACITY_TYPE_SPOT for c in candidates
+        )
+        replacement.instance_type_options = cp.order_by_price(
+            replacement.instance_type_options, replacement.requirements
+        )
+        if all_spot and replacement.requirements.get(
+            labels_mod.CAPACITY_TYPE_LABEL_KEY
+        ).has(labels_mod.CAPACITY_TYPE_SPOT):
+            return self._spot_to_spot(candidates, replacement, candidate_price)
+
+        if not _remove_types_priced_at_or_above(replacement, candidate_price):
+            return Command()
+
+        # OD -> [OD, spot] replacements must pin spot so a failed spot launch
+        # doesn't produce a pricier on-demand node (consolidation.go:211-219)
+        ct_req = replacement.requirements.get(labels_mod.CAPACITY_TYPE_LABEL_KEY)
+        if ct_req.has(labels_mod.CAPACITY_TYPE_SPOT) and ct_req.has(
+            labels_mod.CAPACITY_TYPE_ON_DEMAND
+        ):
+            replacement.requirements.add(
+                Requirement(
+                    labels_mod.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [labels_mod.CAPACITY_TYPE_SPOT],
+                )
+            )
+        return Command(
+            candidates=list(candidates),
+            replacements=[replacement],
+            reason=self.reason,
+            consolidation_type=self.consolidation_type,
+        )
+
+    def _spot_to_spot(self, candidates, replacement, candidate_price) -> Command:
+        """Spot->spot churn protection (consolidation.go:232-305)."""
+        if not self.ctx.spot_to_spot_enabled:
+            return Command()
+        replacement.requirements.add(
+            Requirement(
+                labels_mod.CAPACITY_TYPE_LABEL_KEY,
+                Operator.IN,
+                [labels_mod.CAPACITY_TYPE_SPOT],
+            )
+        )
+        if not _remove_types_priced_at_or_above(replacement, candidate_price):
+            return Command()
+        if len(candidates) > 1:
+            return Command(
+                candidates=list(candidates),
+                replacements=[replacement],
+                reason=self.reason,
+                consolidation_type=self.consolidation_type,
+            )
+        if len(replacement.instance_type_options) < MIN_SPOT_TO_SPOT_TYPES:
+            return Command()
+        # cap launch flexibility to prevent continual consolidation
+        if replacement.requirements.has_min_values():
+            needed, _ = cp.satisfies_min_values(
+                replacement.instance_type_options, replacement.requirements
+            )
+            cap = max(MIN_SPOT_TO_SPOT_TYPES, needed)
+        else:
+            cap = MIN_SPOT_TO_SPOT_TYPES
+        replacement.instance_type_options = replacement.instance_type_options[:cap]
+        return Command(
+            candidates=list(candidates),
+            replacements=[replacement],
+            reason=self.reason,
+            consolidation_type=self.consolidation_type,
+        )
+
+
+def _remove_types_priced_at_or_above(replacement, max_price: float) -> bool:
+    """Keep strictly cheaper instance types; False if none remain or
+    minValues would break (nodeclaim RemoveInstanceTypeOptionsByPrice...)."""
+    kept = [
+        it
+        for it in replacement.instance_type_options
+        if cp.min_compatible_price(it, replacement.requirements) < max_price
+    ]
+    if replacement.requirements.has_min_values() and kept:
+        _, err = cp.satisfies_min_values(kept, replacement.requirements)
+        if err is not None:
+            return False
+    if not kept:
+        return False
+    replacement.instance_type_options = kept
+    return True
+
+
+class MultiNodeConsolidation(ConsolidationBase):
+    """Binary search for the largest disruptable candidate prefix whose pods
+    fit into <= 1 replacement (multinodeconsolidation.go:112-167)."""
+
+    consolidation_type = "multi"
+
+    def compute_command(self, candidates, budgets) -> Command:
+        candidates = _budget_filter(
+            sorted(candidates, key=lambda c: c.disruption_cost), budgets
+        )
+        candidates = candidates[:MAX_MULTI_NODE_CANDIDATES]
+        if len(candidates) < 2:
+            return Command()
+        deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
+        lo, hi = 1, len(candidates)
+        last_valid = Command()
+        while lo <= hi:
+            if self.ctx.clock.now() >= deadline:
+                break
+            mid = (lo + hi) // 2
+            subset = candidates[:mid]
+            cmd = self.compute_consolidation(subset)
+            # don't replace nodes with the same type we're deleting
+            # (filterOutSameType, multinodeconsolidation.go:185-222)
+            if cmd.decision == "replace":
+                self._filter_out_same_type(cmd, subset)
+                if not cmd.replacements[0].instance_type_options:
+                    cmd = Command()
+            if cmd.decision != "no-op":
+                last_valid = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return last_valid
+
+    def _filter_out_same_type(self, cmd: Command, candidates) -> None:
+        replacement = cmd.replacements[0]
+        deleted_names = {
+            c.instance_type.name for c in candidates if c.instance_type is not None
+        }
+        replacement.instance_type_options = [
+            it
+            for it in replacement.instance_type_options
+            if it.name not in deleted_names
+        ]
+
+
+class SingleNodeConsolidation(ConsolidationBase):
+    """Per-candidate sweep, cheapest-to-disrupt first
+    (singlenodeconsolidation.go:34-122)."""
+
+    consolidation_type = "single"
+
+    def compute_command(self, candidates, budgets) -> Command:
+        candidates = _budget_filter(
+            sorted(candidates, key=lambda c: c.disruption_cost), budgets
+        )
+        deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+        for c in candidates:
+            if self.ctx.clock.now() >= deadline:
+                break
+            cmd = self.compute_consolidation([c])
+            if cmd.decision != "no-op":
+                return cmd
+        return Command()

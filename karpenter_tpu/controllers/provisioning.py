@@ -1,0 +1,247 @@
+"""Provisioning controller: pending pods -> NodeClaim CRs.
+
+Mirror of the reference's pkg/controllers/provisioning (provisioner.go,
+batcher.go): a debounce batcher over pod triggers; each cycle gates on
+cluster sync, snapshots state, builds topology, runs the solver
+(TPU fast path with host-oracle fallback — solver/driver.py), and creates
+NodeClaim CRs for the result. Node binding is the kube-scheduler's job; the
+sim harness (sim/binder.py) stands in for it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..api import labels as labels_mod
+from ..api.objects import DaemonSet, Node, NodeClaim, NodePool, Pod
+from ..api.requirements import Requirements, pod_requirements
+from ..events import Event, Recorder
+from ..kube import Client
+from ..metrics import Counter, Gauge, Histogram
+from ..scheduling.inflight import ExistingNode, InFlightNodeClaim
+from ..scheduling.scheduler import Results
+from ..scheduling.template import MAX_INSTANCE_TYPES
+from ..scheduling.topology import Topology
+from ..solver.driver import SolverConfig, TpuSolver
+from ..utils import pod as pod_utils
+from .state import Cluster
+
+SCHEDULING_DURATION = Histogram("scheduling_duration_seconds", "Solve wall time")
+QUEUE_DEPTH = Gauge("scheduler_queue_depth", "Pods waiting in the batcher")
+PODS_SCHEDULED = Counter("pods_scheduled_total", "Pods placed by the provisioner")
+PODS_UNSCHEDULABLE = Gauge("unschedulable_pods_count", "Pods that failed to schedule")
+NODECLAIMS_CREATED = Counter("nodeclaims_created_total", "NodeClaims created")
+
+
+class Batcher:
+    """Debounce window over triggers (reference: batcher.go:33-110): starts
+    on the first trigger, extends while triggers keep arriving within
+    idle_duration, capped at max_duration."""
+
+    def __init__(self, clock, idle_duration: float = 1.0, max_duration: float = 10.0):
+        self._clock = clock
+        self.idle_duration = idle_duration
+        self.max_duration = max_duration
+        self._window_start: Optional[float] = None
+        self._last_trigger: Optional[float] = None
+        self._triggered: set = set()
+
+    def trigger(self, uid: str) -> None:
+        now = self._clock.now()
+        if self._window_start is None:
+            self._window_start = now
+        self._last_trigger = now
+        self._triggered.add(uid)
+
+    def ready(self) -> bool:
+        if self._window_start is None:
+            return False
+        now = self._clock.now()
+        if now - self._window_start >= self.max_duration:
+            return True
+        return now - self._last_trigger >= self.idle_duration
+
+    def reset(self) -> None:
+        self._window_start = None
+        self._last_trigger = None
+        self._triggered = set()
+
+    def __len__(self) -> int:
+        return len(self._triggered)
+
+
+class Provisioner:
+    """The singleton provisioning reconciler (provisioner.go:72-139)."""
+
+    def __init__(
+        self,
+        client: Client,
+        cloud_provider,
+        cluster: Cluster,
+        recorder: Optional[Recorder] = None,
+        solver_config: Optional[SolverConfig] = None,
+        batch_idle_duration: float = 1.0,
+        batch_max_duration: float = 10.0,
+    ):
+        self.client = client
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.clock = client.clock
+        self.recorder = recorder or Recorder(self.clock)
+        self.solver_config = solver_config
+        self.batcher = Batcher(self.clock, batch_idle_duration, batch_max_duration)
+        client.watch(self._on_event)
+
+    # -- triggers (provisioning/controller.go:44-119) ---------------------
+
+    def _on_event(self, event) -> None:
+        if event.kind == "Pod" and event.type in ("ADDED", "MODIFIED"):
+            if pod_utils.is_provisionable(event.object):
+                self.trigger(event.object.uid)
+
+    def trigger(self, uid: str) -> None:
+        self.batcher.trigger(uid)
+        QUEUE_DEPTH.set(float(len(self.batcher)))
+
+    # -- the reconcile cycle ----------------------------------------------
+
+    def reconcile(self, force: bool = False) -> Optional[Results]:
+        """One pass: returns Results if a solve ran, else None."""
+        if not force and not self.batcher.ready():
+            return None
+        self.batcher.reset()
+        QUEUE_DEPTH.set(0.0)
+        if not self.cluster.synced():
+            return None
+        pods = self.get_pending_pods()
+        pods += self.get_deleting_node_pods()
+        if not pods:
+            return None
+        results = self.schedule(pods)
+        self.create_node_claims(results)
+        self.nominate(results)
+        return results
+
+    def get_pending_pods(self) -> List[Pod]:
+        return [
+            p
+            for p in self.client.list(Pod)
+            if pod_utils.is_provisionable(p) and self._validate(p)
+        ]
+
+    def get_deleting_node_pods(self) -> List[Pod]:
+        """Reschedulable pods on draining nodes (provisioner.go:158-177)."""
+        out = []
+        for sn in self.cluster.nodes():
+            if sn.mark_for_deletion or sn.deleting():
+                out.extend(p for p in sn.pods if pod_utils.is_reschedulable(p))
+        return out
+
+    def _validate(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name == "default-scheduler"
+
+    # -- scheduling (provisioner.go:216-359) ------------------------------
+
+    def schedule(self, pods: List[Pod]) -> Results:
+        t0 = self.clock.now()
+        state_nodes = [
+            sn
+            for sn in self.cluster.nodes()
+            if not (sn.mark_for_deletion or sn.deleting())
+        ]
+        node_pools = self._ready_node_pools()
+        instance_types = {
+            np_.name: self.cloud_provider.get_instance_types(np_) for np_ in node_pools
+        }
+        daemonset_pods = self._daemonset_pods()
+        topology = Topology(
+            self.client, state_nodes, node_pools, instance_types, pods,
+            cluster=self.cluster,
+        )
+        solver = TpuSolver(
+            node_pools,
+            instance_types,
+            topology,
+            state_nodes=state_nodes,
+            daemonset_pods=daemonset_pods,
+            config=self.solver_config,
+        )
+        results = solver.solve(pods)
+        results.truncate_instance_types(MAX_INSTANCE_TYPES)
+        SCHEDULING_DURATION.observe(max(self.clock.now() - t0, 0.0))
+        PODS_UNSCHEDULABLE.set(float(len(results.pod_errors)))
+        scheduled = len(pods) - len(results.pod_errors)
+        if scheduled:
+            PODS_SCHEDULED.inc(value=scheduled)
+        return results
+
+    def _ready_node_pools(self) -> List[NodePool]:
+        pools = []
+        for np_ in self.client.list(NodePool):
+            if np_.metadata.deletion_timestamp is not None:
+                continue
+            pools.append(np_)
+        return sorted(pools, key=lambda p: (-p.spec.weight, p.name))
+
+    def _daemonset_pods(self) -> List[Pod]:
+        """Synthetic pods for each daemonset template
+        (provisioner.go:429-454)."""
+        out = []
+        for ds in self.client.list(DaemonSet):
+            pod = Pod(spec=ds.pod_spec)
+            pod.metadata.name = f"daemon-{ds.name}"
+            pod.metadata.owner_uids = [ds.metadata.uid]
+            out.append(pod)
+        return out
+
+    # -- claim creation (provisioner.go:374-412) --------------------------
+
+    def create_node_claims(self, results: Results) -> List[NodeClaim]:
+        created = []
+        for claim_model in results.new_node_claims:
+            claim = claim_model.template.to_node_claim(
+                instance_type_options=claim_model.instance_type_options,
+                requirements=claim_model.requirements,
+            )
+            claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
+            self.client.create(claim)
+            NODECLAIMS_CREATED.inc(
+                labels={"nodepool": claim_model.template.node_pool_name}
+            )
+            created.append(claim)
+            claim_model.created_name = claim.name  # type: ignore[attr-defined]
+        return created
+
+    def nominate(self, results: Results) -> None:
+        """Nominate existing nodes that received pods so disruption leaves
+        them alone (provisioner.go + cluster.go:229-247)."""
+        now = self.clock.now()
+        for existing in results.existing_nodes:
+            if existing.pods:
+                self.cluster.nominate_node(existing.name, now)
+                for pod in existing.pods:
+                    self.recorder.publish(
+                        Event(
+                            object_uid=pod.uid,
+                            type="Normal",
+                            reason="Nominated",
+                            message=f"should schedule on node {existing.name}",
+                        )
+                    )
+
+
+def _requirements_to_selectors(reqs: Requirements):
+    from ..api.objects import NodeSelectorRequirement
+
+    out = []
+    for r in reqs:
+        out.append(
+            NodeSelectorRequirement(
+                r.key,
+                r.operator().value,
+                tuple(r.values_list()),
+                min_values=r.min_values,
+            )
+        )
+    return out

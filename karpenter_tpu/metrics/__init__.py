@@ -1,0 +1,3 @@
+from .registry import Counter, Gauge, Histogram, Registry, REGISTRY
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY"]

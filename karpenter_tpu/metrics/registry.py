@@ -1,0 +1,139 @@
+"""Minimal labeled metrics registry.
+
+Plays the role of the reference's prometheus metrics (pkg/metrics,
+namespace "karpenter" — constants.go:27). Dependency-free: a dict-backed
+registry with counters/gauges/histograms, a text exposition dump, and full
+introspection for tests (the reference asserts metrics in its suites too).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter_tpu"
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60
+)
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, help_text: str = "", registry: "Registry" = None):
+        self.name = f"{NAMESPACE}_{name}" if not name.startswith(NAMESPACE) else name
+        self.help = help_text
+        self._lock = threading.Lock()
+        (registry or REGISTRY).register(self)
+
+
+class Counter(Metric):
+    def __init__(self, name, help_text="", registry=None):
+        super().__init__(name, help_text, registry)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self):
+        return [("counter", self.name, dict(k), v) for k, v in self._values.items()]
+
+
+class Gauge(Metric):
+    def __init__(self, name, help_text="", registry=None):
+        super().__init__(name, help_text, registry)
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def delete(self, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values.pop(_label_key(labels), None)
+
+    def delete_partial(self, labels: Dict[str, str]) -> None:
+        """Drop every series whose labels are a superset (prometheus
+        DeletePartialMatch)."""
+        items = set(labels.items())
+        with self._lock:
+            for key in [k for k in self._values if items.issubset(set(k))]:
+                del self._values[key]
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self):
+        return [("gauge", self.name, dict(k), v) for k, v in self._values.items()]
+
+
+class Histogram(Metric):
+    def __init__(self, name, help_text="", buckets: Iterable[float] = _DEFAULT_BUCKETS, registry=None):
+        super().__init__(name, help_text, registry)
+        self.buckets = sorted(buckets)
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._totals: Dict[tuple, int] = {}
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect.bisect_left(self.buckets, value)
+            for i in range(idx, len(self.buckets)):
+                counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sums.get(_label_key(labels), 0.0)
+
+    def collect(self):
+        return [
+            ("histogram", self.name, dict(k), {"count": self._totals[k], "sum": self._sums[k]})
+            for k in self._totals
+        ]
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            self._metrics.append(metric)
+
+    def collect(self):
+        out = []
+        for m in self._metrics:
+            out.extend(m.collect())
+        return out
+
+    def exposition(self) -> str:
+        """Prometheus text format (for a /metrics endpoint)."""
+        lines = []
+        for kind, name, labels, value in self.collect():
+            label_str = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            label_str = f"{{{label_str}}}" if label_str else ""
+            if kind == "histogram":
+                lines.append(f"{name}_count{label_str} {value['count']}")
+                lines.append(f"{name}_sum{label_str} {value['sum']}")
+            else:
+                lines.append(f"{name}{label_str} {value}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

@@ -1,0 +1,110 @@
+"""Operator: wires the controller roster over one store + provider.
+
+Plays the role of pkg/operator + pkg/controllers/controllers.go:62-113: one
+object owns the store, state cache, and every controller; ``step()`` runs one
+level-triggered reconcile pass (the in-process analog of controller-runtime's
+requeue loop), and ``run(until)`` advances simulated time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .controllers.disruption import DisruptionController
+from .controllers.disruption.controller import DisruptionContext
+from .controllers.housekeeping import (
+    ConsistencyController,
+    ExpirationController,
+    GarbageCollectionController,
+    HealthController,
+    NodePoolStatusController,
+)
+from .controllers.lifecycle import LifecycleController
+from .controllers.nodeclaim_disruption import (
+    NodeClaimDisruptionController,
+    PodEventsController,
+)
+from .controllers.provisioning import Provisioner
+from .controllers.state import Cluster
+from .controllers.termination import TerminationController
+from .events import Recorder
+from .kube import Client, Clock, RealClock
+from .solver.driver import SolverConfig
+
+
+@dataclass
+class OperatorOptions:
+    batch_idle_duration: float = 1.0  # options.go:100-101
+    batch_max_duration: float = 10.0
+    spot_to_spot_consolidation: bool = False  # feature gate
+    node_repair: bool = False  # feature gate
+    solver_config: Optional[SolverConfig] = None
+
+
+class Operator:
+    def __init__(
+        self,
+        client: Client,
+        cloud_provider,
+        options: Optional[OperatorOptions] = None,
+    ):
+        self.options = options or OperatorOptions()
+        self.client = client
+        self.clock = client.clock
+        self.cloud_provider = cloud_provider
+        self.recorder = Recorder(self.clock)
+        self.cluster = Cluster(client)
+
+        self.provisioner = Provisioner(
+            client,
+            cloud_provider,
+            self.cluster,
+            recorder=self.recorder,
+            solver_config=self.options.solver_config,
+            batch_idle_duration=self.options.batch_idle_duration,
+            batch_max_duration=self.options.batch_max_duration,
+        )
+        self.lifecycle = LifecycleController(client, cloud_provider, self.recorder)
+        self.termination = TerminationController(client, cloud_provider, self.recorder)
+        self.nodeclaim_disruption = NodeClaimDisruptionController(client, cloud_provider)
+        self.podevents = PodEventsController(client)
+        self.disruption = DisruptionController(
+            DisruptionContext(
+                client=client,
+                cluster=self.cluster,
+                cloud_provider=cloud_provider,
+                clock=self.clock,
+                recorder=self.recorder,
+                spot_to_spot_enabled=self.options.spot_to_spot_consolidation,
+            ),
+            provisioner=self.provisioner,
+        )
+        self.expiration = ExpirationController(client, self.recorder)
+        self.garbage_collection = GarbageCollectionController(client, cloud_provider)
+        self.health = HealthController(client, cloud_provider, self.cluster)
+        self.consistency = ConsistencyController(client, self.recorder)
+        self.nodepool_status = NodePoolStatusController(client, self.cluster)
+
+    def step(self, force_provision: bool = False, force_disruption: bool = False) -> None:
+        """One reconcile pass over the roster."""
+        if hasattr(self.cloud_provider, "process_registrations"):
+            self.cloud_provider.process_registrations()
+        self.provisioner.reconcile(force=force_provision)
+        self.lifecycle.reconcile_all()
+        self.termination.reconcile_all()
+        self.nodeclaim_disruption.reconcile_all()
+        self.nodepool_status.reconcile_all()
+        self.expiration.reconcile_all()
+        self.garbage_collection.reconcile()
+        if self.options.node_repair:
+            self.health.reconcile_all()
+        self.consistency.reconcile_all()
+        self.disruption.reconcile(force=force_disruption)
+
+    def run(self, duration: float, tick: float = 1.0) -> None:
+        """Advance simulated time, stepping each tick (TestClock only)."""
+        end = self.clock.now() + duration
+        while self.clock.now() < end:
+            self.step()
+            self.clock.sleep(tick)

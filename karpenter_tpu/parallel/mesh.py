@@ -31,6 +31,10 @@ def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None):
 
     devices = jax.devices()
     n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"requested {n} devices but only {len(devices)} available"
+        )
     devices = np.asarray(devices[:n])
     if data is None:
         # favor the model axis: type-sharding keeps the big masks local

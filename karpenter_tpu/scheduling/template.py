@@ -43,18 +43,37 @@ class NodeClaimTemplate:
         )
         self.requirements.add(*Requirements.from_labels(self.labels).values())
 
-    def to_node_claim(self) -> NodeClaim:
+    def to_node_claim(
+        self, instance_type_options=None, requirements=None
+    ) -> NodeClaim:
         """Materialize a NodeClaim CR, truncating instance types by price
-        (nodeclaimtemplate.go:71-97)."""
-        ordered = cp.order_by_price(self.instance_type_options, self.requirements)[
-            :MAX_INSTANCE_TYPES
-        ]
-        self.requirements.add(
+        (nodeclaimtemplate.go:71-97).
+
+        Callers pass the claim's OWN narrowed options/requirements (the
+        reference embeds a per-claim template copy; this template object is
+        shared, so the narrowing travels explicitly).
+        """
+        options = (
+            instance_type_options
+            if instance_type_options is not None
+            else self.instance_type_options
+        )
+        reqs = Requirements(
+            *(
+                r
+                for r in (requirements if requirements is not None else self.requirements)
+                # the scheduling hostname placeholder must not reach the CR
+                # (reference FinalizeScheduling, nodeclaim.go:242-258)
+                if r.key != labels_mod.HOSTNAME
+            )
+        )
+        ordered = cp.order_by_price(options, reqs)[:MAX_INSTANCE_TYPES]
+        reqs.add(
             Requirement(
                 labels_mod.INSTANCE_TYPE,
                 Operator.IN,
                 [it.name for it in ordered],
-                min_values=self.requirements.get(labels_mod.INSTANCE_TYPE).min_values,
+                min_values=reqs.get(labels_mod.INSTANCE_TYPE).min_values,
             )
         )
         name = f"{self.node_pool_name}-{new_uid()[:8]}"
@@ -66,7 +85,7 @@ class NodeClaimTemplate:
                     tuple(r.values_list()),
                     min_values=r.min_values,
                 )
-                for r in self.requirements
+                for r in reqs
             ],
             taints=list(self.taints),
             startup_taints=list(self.startup_taints),

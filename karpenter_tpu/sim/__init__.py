@@ -1,0 +1,3 @@
+from .binder import Binder
+
+__all__ = ["Binder"]

@@ -1,0 +1,66 @@
+"""kube-scheduler stand-in: binds pending pods onto ready nodes.
+
+The reference never binds pods itself — kube-scheduler does. In-process, the
+test/simulation harness needs a binder (the role the reference's
+ExpectProvisioned test helper plays, expectations.go:295-352): pending pods
+bind onto nodes with capacity whose labels/taints admit them.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import labels as labels_mod
+from ..api import resources as res
+from ..api import taints as taints_mod
+from ..api.objects import Node, Pod
+from ..api.requirements import Requirements, pod_requirements
+from ..kube import Client
+from ..utils import pod as pod_utils
+
+
+class Binder:
+    def __init__(self, client: Client):
+        self.client = client
+
+    def bind_all(self) -> List[Pod]:
+        """One binding pass; returns newly bound pods."""
+        nodes = [n for n in self.client.list(Node) if n.metadata.deletion_timestamp is None]
+        bound = []
+        used = {
+            n.name: res.merge(
+                *(
+                    p.spec.requests
+                    for p in self.client.list(Pod)
+                    if p.spec.node_name == n.name and pod_utils.is_active(p)
+                )
+            )
+            if any(p.spec.node_name == n.name for p in self.client.list(Pod))
+            else {}
+            for n in nodes
+        }
+        for pod in self.client.list(Pod):
+            if not pod_utils.is_provisionable(pod):
+                continue
+            node = self._find_node(pod, nodes, used)
+            if node is not None:
+                pod.spec.node_name = node.name
+                used[node.name] = res.merge(used[node.name], pod.spec.requests)
+                self.client.update(pod)
+                bound.append(pod)
+        return bound
+
+    def _find_node(self, pod: Pod, nodes: List[Node], used) -> Optional[Node]:
+        for node in nodes:
+            if node.unschedulable or not node.status.ready:
+                continue
+            if taints_mod.tolerates_pod(node.taints, pod) is not None:
+                continue
+            node_reqs = Requirements.from_labels(node.metadata.labels)
+            if node_reqs.compatible(pod_requirements(pod)) is not None:
+                continue
+            requests = res.merge(used.get(node.name, {}), pod.spec.requests)
+            if not res.fits(requests, node.status.allocatable):
+                continue
+            return node
+        return None

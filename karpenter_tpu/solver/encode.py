@@ -172,6 +172,21 @@ class EncodedSnapshot:
     ct_kid: int
     well_known: np.ndarray  # [K] bool
 
+    def solve_args(self, a_tzc: np.ndarray) -> tuple:
+        """The positional argument tuple for ops/solve.py:solve_core — the
+        single authority on that ordering (driver, examples, and the
+        multi-chip padding all build from this)."""
+        return (
+            self.g_count, self.g_req, self.g_def, self.g_neg, self.g_mask,
+            self.p_def, self.p_neg, self.p_mask, self.p_daemon,
+            self.p_limit, self.p_has_limit, self.p_tol, self.p_titype_ok,
+            self.t_def, self.t_mask, self.t_alloc, self.t_cap,
+            self.o_avail, self.o_zone, self.o_ct,
+            a_tzc,
+            self.n_def, self.n_mask, self.n_avail, self.n_base, self.n_tol,
+            self.well_known,
+        )
+
 
 def encode(
     groups: List[PodGroup],
@@ -210,8 +225,10 @@ def encode(
                 vocab.value_id(labels_mod.TOPOLOGY_ZONE, v)
             for v in c.values:
                 vocab.value_id(labels_mod.CAPACITY_TYPE_LABEL_KEY, v)
-    for sn in existing_nodes:
-        vocab.observe_label_keys(sn.labels())
+    for en in existing_nodes:
+        # ExistingNode models (scheduling/inflight.py); their requirement
+        # keys come from concrete node labels
+        vocab.observe_keys(en.requirements)
 
     K, V1 = vocab.padded_shape()
     resource_names = res.resource_names(

@@ -65,15 +65,5 @@ def example_snapshot_arrays(n_pods: int, n_types: int, shapes: int = 1):
     )
     a_tzc = solver._offering_availability(snap)
     nmax = solver._estimate_nmax(snap)
-    args = (
-        snap.g_count, snap.g_req, snap.g_def, snap.g_neg, snap.g_mask,
-        snap.p_def, snap.p_neg, snap.p_mask, snap.p_daemon,
-        snap.p_limit, snap.p_has_limit, snap.p_tol, snap.p_titype_ok,
-        snap.t_def, snap.t_mask, snap.t_alloc, snap.t_cap,
-        snap.o_avail, snap.o_zone, snap.o_ct,
-        a_tzc,
-        snap.n_def, snap.n_mask, snap.n_avail, snap.n_base, snap.n_tol,
-        snap.well_known,
-    )
     statics = dict(nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
-    return args, statics
+    return snap.solve_args(a_tzc), statics

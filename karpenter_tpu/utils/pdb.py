@@ -1,0 +1,52 @@
+"""PodDisruptionBudget limits (reference: pkg/utils/pdb/limits.go)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api.objects import Pod, PodDisruptionBudget
+
+
+def _parse_int_or_percent(value: str, total: int, round_up: bool) -> int:
+    if value.endswith("%"):
+        pct = int(value[:-1])
+        raw = total * pct / 100.0
+        return -int(-raw // 1) if round_up else int(raw)
+    return int(value)
+
+
+class Limits:
+    """Evictability check across all PDBs in the cluster."""
+
+    def __init__(self, pdbs: List[PodDisruptionBudget], pods_by_selector=None):
+        self._pdbs = pdbs
+
+    @classmethod
+    def from_client(cls, client) -> "Limits":
+        return cls(client.list(PodDisruptionBudget))
+
+    def matching(self, pod: Pod) -> List[PodDisruptionBudget]:
+        return [
+            pdb
+            for pdb in self._pdbs
+            if pdb.metadata.namespace == pod.metadata.namespace
+            and pdb.selector.matches(pod.metadata.labels)
+        ]
+
+    def can_evict_pods(self, pods: List[Pod]) -> Optional[str]:
+        """Error if evicting any of the pods would violate a PDB; also flags
+        pods covered by multiple PDBs (the eviction API refuses those)."""
+        for pod in pods:
+            matching = self.matching(pod)
+            if len(matching) > 1:
+                return (
+                    f"pod {pod.metadata.namespace}/{pod.name} matches multiple PDBs"
+                )
+            if matching:
+                pdb = matching[0]
+                if pdb.disruptions_allowed <= 0:
+                    return (
+                        f"PDB {pdb.metadata.namespace}/{pdb.metadata.name} "
+                        f"prevents eviction of pod {pod.name}"
+                    )
+        return None

@@ -1,0 +1,348 @@
+"""Control-plane behavior tests: provisioning, lifecycle, termination,
+disruption conditions, expiration, GC, housekeeping, and the full
+pending-pod -> running-node -> consolidation loop through the Operator.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import (
+    Budget,
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    Node,
+    NodeClaim,
+    NodePool,
+    Pod,
+)
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.operator import Operator, OperatorOptions
+from karpenter_tpu.sim import Binder
+
+from helpers import make_nodepool, make_pod, make_pods
+
+
+@pytest.fixture
+def env():
+    clock = TestClock()
+    client = Client(clock)
+    provider = KwokCloudProvider(client, corpus.generate(20))
+    operator = Operator(client, provider)
+    binder = Binder(client)
+    return clock, client, provider, operator, binder
+
+
+def provision_cycle(env, n_steps=6):
+    clock, client, provider, operator, binder = env
+    for _ in range(n_steps):
+        operator.step(force_provision=True)
+        binder.bind_all()
+        clock.step(1)
+
+
+class TestProvisioningCycle:
+    def test_pending_pod_to_running_node(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        pods = make_pods(5, cpu="1", memory="2Gi")
+        for p in pods:
+            client.create(p)
+        provision_cycle(env)
+        claims = client.list(NodeClaim)
+        assert len(claims) == 1
+        claim = claims[0]
+        assert claim.conds().is_true(COND_LAUNCHED)
+        assert claim.conds().is_true(COND_REGISTERED)
+        assert claim.conds().is_true(COND_INITIALIZED)
+        nodes = client.list(Node)
+        assert len(nodes) == 1
+        for p in pods:
+            assert p.spec.node_name == nodes[0].name
+
+    def test_batcher_debounce(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        # within idle window: not ready
+        assert operator.provisioner.reconcile() is None
+        clock.step(1.1)  # idle window elapsed
+        results = operator.provisioner.reconcile()
+        assert results is not None and results.node_count() == 1
+
+    def test_no_pods_no_claims(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        provision_cycle(env)
+        assert client.list(NodeClaim) == []
+
+    def test_unschedulable_pod_reported(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod(cpu="9999"))
+        clock.step(1.1)
+        results = operator.provisioner.reconcile()
+        assert results is not None and len(results.pod_errors) == 1
+        assert client.list(NodeClaim) == []
+
+
+class TestLifecycle:
+    def test_insufficient_capacity_deletes_claim(self, env):
+        clock, client, provider, operator, binder = env
+        from karpenter_tpu.api.objects import NodeClaimSpec, NodeSelectorRequirement, ObjectMeta
+
+        claim = NodeClaim(
+            metadata=ObjectMeta(name="bad", labels={labels.NODEPOOL_LABEL_KEY: "default"}),
+            spec=NodeClaimSpec(
+                requirements=[NodeSelectorRequirement(labels.TOPOLOGY_ZONE, "In", ("mars",))]
+            ),
+        )
+        claim.metadata.finalizers.append(labels.TERMINATION_FINALIZER)
+        client.create(claim)
+        operator.lifecycle.reconcile_all()
+        assert client.try_get(NodeClaim, "bad") is None
+
+    def test_liveness_deletes_unregistered(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        clock.step(1.1)
+        operator.provisioner.reconcile()
+        # block registration by never processing provider registrations
+        provider._registration_delay = 10**9
+        provider._pending = [(clock.now() + 10**9, i) for _, i in provider._pending]
+        operator.lifecycle.reconcile_all()  # launch
+        clock.step(16 * 60)
+        operator.lifecycle.reconcile_all()  # liveness fires
+        assert client.list(NodeClaim) == []
+
+
+class TestTermination:
+    def test_node_delete_drains_and_removes(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        for p in make_pods(3):
+            client.create(p)
+        provision_cycle(env)
+        node = client.list(Node)[0]
+        node.metadata.finalizers.append(labels.TERMINATION_FINALIZER)
+        client.delete(node)
+        for _ in range(5):
+            operator.step()
+            clock.step(1)
+        assert client.list(Node) == []
+        assert client.list(NodeClaim) == []
+        # pods evicted
+        assert all(not p.spec.node_name or p.metadata.deletion_timestamp
+                   for p in client.list(Pod))
+
+
+class TestConditions:
+    def test_consolidatable_after_quiet_period(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 30.0
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        claim = client.list(NodeClaim)[0]
+        assert not claim.conds().is_true(COND_CONSOLIDATABLE)
+        clock.step(31)
+        operator.nodeclaim_disruption.reconcile_all()
+        assert claim.conds().is_true(COND_CONSOLIDATABLE)
+
+    def test_drift_on_nodepool_change(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        claim = client.list(NodeClaim)[0]
+        # stamp the current hash, then change the pool template
+        from karpenter_tpu.controllers.nodeclaim_disruption import nodepool_hash
+
+        claim.metadata.annotations[labels.NODEPOOL_HASH_ANNOTATION_KEY] = nodepool_hash(pool)
+        operator.nodeclaim_disruption.reconcile_all()
+        assert not claim.conds().is_true(COND_DRIFTED)
+        pool.spec.template.labels["team"] = "new"
+        client.update(pool)
+        operator.nodeclaim_disruption.reconcile_all()
+        assert claim.conds().is_true(COND_DRIFTED)
+
+
+class TestExpiration:
+    def test_claims_expire(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.template.spec.expire_after = 3600.0
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        assert len(client.list(NodeClaim)) == 1
+        clock.step(3601)
+        operator.expiration.reconcile_all()
+        claim = client.list(NodeClaim)[0]
+        assert claim.metadata.deletion_timestamp is not None
+
+
+class TestGarbageCollection:
+    def test_leaked_instance_collected(self, env):
+        clock, client, provider, operator, binder = env
+        from karpenter_tpu.api.objects import ObjectMeta, NodeClaimSpec
+
+        leaked = NodeClaim(metadata=ObjectMeta(name="leak"), spec=NodeClaimSpec())
+        provider.create(leaked)  # instance exists, no NodeClaim CR
+        assert len(provider.list()) == 1
+        operator.garbage_collection.reconcile()
+        assert provider.list() == []
+
+
+class TestEmptinessConsolidation:
+    def test_empty_node_deleted(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 30.0
+        client.create(pool)
+        pod = make_pod()
+        client.create(pod)
+        provision_cycle(env)
+        assert len(client.list(Node)) == 1
+        # pod goes away; node becomes empty and consolidatable
+        pod.status.phase = "Succeeded"
+        client.update(pod)
+        clock.step(31)
+        operator.nodeclaim_disruption.reconcile_all()
+        cmd = operator.disruption.reconcile(force=True)
+        assert cmd is not None and cmd.decision == "delete"
+        assert cmd.reason == "Empty"
+        # queue completes the deletion (no replacements to wait for)
+        operator.disruption.queue.reconcile()
+        for _ in range(4):
+            operator.step()
+            clock.step(1)
+        assert client.list(Node) == []
+
+
+class TestBudgets:
+    def test_zero_budget_blocks_disruption(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 30.0
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        client.create(pool)
+        pod = make_pod()
+        client.create(pod)
+        provision_cycle(env)
+        pod.status.phase = "Succeeded"
+        client.update(pod)
+        clock.step(31)
+        operator.nodeclaim_disruption.reconcile_all()
+        cmd = operator.disruption.reconcile(force=True)
+        assert cmd is None or cmd.decision == "no-op"
+        assert len(client.list(Node)) == 1
+
+
+class TestMultiNodeConsolidation:
+    def test_spot_consolidation_gated_off_by_default(self, env):
+        # both nodes are spot; with the SpotToSpotConsolidation gate off the
+        # reference refuses to consolidate (consolidation.go:232-238)
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 10.0
+        client.create(pool)
+        for _ in range(2):
+            client.create(make_pod(cpu="1", memory="1Gi"))
+            provision_cycle(env)
+        assert len(client.list(Node)) == 2
+        clock.step(11)
+        operator.nodeclaim_disruption.reconcile_all()
+        cmd = operator.disruption.reconcile(force=True)
+        assert cmd is None or cmd.decision == "no-op"
+
+    def test_underutilized_nodes_consolidate_after_pods_complete(self, env):
+        # Two nodes sized for 2x750m pods each; one pod per node completes,
+        # leaving each node underutilized. Multi-node consolidation packs the
+        # two leftovers onto one cheaper replacement.
+        clock, client, provider, operator, binder = env
+        operator.disruption.ctx.spot_to_spot_enabled = True
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 10.0
+        client.create(pool)
+        rounds = []
+        for _ in range(2):
+            batch = [make_pod(cpu="750m", memory="1Gi") for _ in range(2)]
+            for p in batch:
+                client.create(p)
+            provision_cycle(env)
+            rounds.append(batch)
+        assert len(client.list(Node)) == 2
+        # one pod per node completes
+        for batch in rounds:
+            batch[0].status.phase = "Succeeded"
+            client.update(batch[0])
+        # past consolidate_after AND the 20s pod-nomination window
+        clock.step(25)
+        operator.nodeclaim_disruption.reconcile_all()
+        cmd = operator.disruption.reconcile(force=True)
+        # either outcome shrinks the cluster: delete a node whose leftover pod
+        # fits on the other's free capacity, or replace both with one cheaper
+        assert cmd is not None and cmd.decision in ("delete", "replace")
+        if cmd.decision == "replace":
+            from karpenter_tpu.cloudprovider import types as cp
+
+            rep = cmd.replacements[0]
+            rep_price = min(
+                cp.min_compatible_price(it, rep.requirements)
+                for it in rep.instance_type_options
+            )
+            assert rep_price < sum(c.price for c in cmd.candidates)
+
+    def test_consolidation_completes_via_queue(self, env):
+        clock, client, provider, operator, binder = env
+        operator.disruption.ctx.spot_to_spot_enabled = True
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 10.0
+        client.create(pool)
+        rounds = []
+        for _ in range(2):
+            batch = [make_pod(cpu="750m", memory="1Gi") for _ in range(2)]
+            for p in batch:
+                client.create(p)
+            provision_cycle(env)
+            rounds.append(batch)
+        for batch in rounds:
+            batch[0].status.phase = "Succeeded"
+            client.update(batch[0])
+        clock.step(25)
+        operator.nodeclaim_disruption.reconcile_all()
+        cmd = operator.disruption.reconcile(force=True)
+        assert cmd is not None and cmd.decision in ("delete", "replace")
+        # run the world until the command completes and candidates die
+        for _ in range(10):
+            operator.step()
+            binder.bind_all()
+            clock.step(2)
+        nodes = client.list(Node)
+        assert len(nodes) == 1
+        # surviving (non-terminal) pods landed on the replacement
+        for p in client.list(Pod):
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            if p.spec.node_name:
+                assert p.spec.node_name == nodes[0].name
+
+
+class TestClaimCRHygiene:
+    def test_no_hostname_requirement_in_created_claims(self, env):
+        # reference FinalizeScheduling strips the scheduling hostname
+        # placeholder before launch (nodeclaim.go:242-258)
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        provision_cycle(env)
+        claim = client.list(NodeClaim)[0]
+        assert all(r.key != labels.HOSTNAME for r in claim.spec.requirements)

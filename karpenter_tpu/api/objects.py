@@ -449,9 +449,32 @@ class PersistentVolumeClaim:
 
 
 @dataclass
+class PersistentVolume:
+    """A bound volume; ``zones`` mirrors the PV's node-affinity zone terms and
+    ``driver`` the CSI driver that provisioned it (reference:
+    volumetopology.go getPersistentVolumeTopology / volumeusage.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    zones: Tuple[str, ...] = ()
+    driver: str = ""
+    storage_class_name: Optional[str] = None
+
+
+@dataclass
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     zones: Tuple[str, ...] = ()  # allowed topologies
+    provisioner: str = ""  # CSI driver name
+
+
+@dataclass
+class CSINode:
+    """Per-node CSI driver attach limits (reference: volumeusage.go reads
+    CSINode.spec.drivers[].allocatable.count). ``metadata.name`` is the node
+    name."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    driver_limits: Dict[str, int] = field(default_factory=dict)  # driver -> max volumes
 
 
 @dataclass

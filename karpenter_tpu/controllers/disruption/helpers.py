@@ -7,6 +7,7 @@ windows in nodepool.go:296-367.
 
 from __future__ import annotations
 
+import copy
 import math
 from typing import Dict, List, Optional, Sequence
 
@@ -23,6 +24,8 @@ from ...api.objects import (
 )
 from ...scheduling.scheduler import Results
 from ...scheduling.topology import Topology
+from ...scheduling.volumetopology import VolumeTopology
+from ...scheduling.volumeusage import VolumeResolver
 from ...solver.driver import TpuSolver
 from ...utils import pod as pod_utils
 from ...utils.pdb import Limits
@@ -128,6 +131,13 @@ def simulate_scheduling(
     pods += [
         p for p in client.list(Pod) if pod_utils.is_provisionable(p)
     ]
+    # zonal-volume constraints apply in simulation exactly as in provisioning
+    # (the reference reuses Provisioner.NewScheduler, helpers.go:82-102)
+    volume_topology = VolumeTopology(client)
+    pods = [copy.deepcopy(p) if p.spec.volumes else p for p in pods]
+    for p in pods:
+        if p.spec.volumes:
+            volume_topology.inject(p)
     node_pools = sorted(
         client.list(NodePool), key=lambda p: (-p.spec.weight, p.name)
     )
@@ -143,6 +153,7 @@ def simulate_scheduling(
         topology,
         state_nodes=state_nodes,
         config=solver_config,
+        volume_resolver=VolumeResolver(client),
     )
     return solver.solve(pods)
 

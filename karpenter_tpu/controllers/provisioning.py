@@ -10,6 +10,7 @@ sim harness (sim/binder.py) stands in for it.
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -23,6 +24,8 @@ from ..scheduling.inflight import ExistingNode, InFlightNodeClaim
 from ..scheduling.scheduler import Results
 from ..scheduling.template import MAX_INSTANCE_TYPES
 from ..scheduling.topology import Topology
+from ..scheduling.volumetopology import VolumeTopology
+from ..scheduling.volumeusage import VolumeResolver
 from ..solver.driver import SolverConfig, TpuSolver
 from ..utils import pod as pod_utils
 from .state import Cluster
@@ -91,6 +94,8 @@ class Provisioner:
         self.recorder = recorder or Recorder(self.clock)
         self.solver_config = solver_config
         self.batcher = Batcher(self.clock, batch_idle_duration, batch_max_duration)
+        self.volume_topology = VolumeTopology(client)
+        self.volume_resolver = VolumeResolver(client)
         client.watch(self._on_event)
 
     # -- triggers (provisioning/controller.go:44-119) ---------------------
@@ -139,12 +144,34 @@ class Provisioner:
         return out
 
     def _validate(self, pod: Pod) -> bool:
-        return pod.spec.scheduler_name == "default-scheduler"
+        if pod.spec.scheduler_name != "default-scheduler":
+            return False
+        # pods with missing PVCs/StorageClasses are ignored, matching
+        # provisioner.go:456-463 + volumetopology.go:152-199
+        if pod.spec.volumes:
+            err = self.volume_topology.validate_persistent_volume_claims(pod)
+            if err is not None:
+                self.recorder.publish(
+                    Event(
+                        object_uid=pod.uid,
+                        type="Warning",
+                        reason="FailedScheduling",
+                        message=err,
+                    )
+                )
+                return False
+        return True
 
     # -- scheduling (provisioner.go:216-359) ------------------------------
 
     def schedule(self, pods: List[Pod]) -> Results:
         t0 = self.clock.now()
+        # zonal-volume requirement injection (volumetopology.go:42-78); copy
+        # volume-bearing pods so the store objects stay unmutated
+        pods = [copy.deepcopy(p) if p.spec.volumes else p for p in pods]
+        for p in pods:
+            if p.spec.volumes:
+                self.volume_topology.inject(p)
         state_nodes = [
             sn
             for sn in self.cluster.nodes()
@@ -166,6 +193,7 @@ class Provisioner:
             state_nodes=state_nodes,
             daemonset_pods=daemonset_pods,
             config=self.solver_config,
+            volume_resolver=self.volume_resolver,
         )
         results = solver.solve(pods)
         results.truncate_instance_types(MAX_INSTANCE_TYPES)

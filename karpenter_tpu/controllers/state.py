@@ -19,6 +19,7 @@ from ..api.objects import (
     COND_CONSOLIDATABLE,
     COND_INITIALIZED,
     COND_REGISTERED,
+    CSINode,
     DaemonSet,
     Node,
     NodeClaim,
@@ -29,6 +30,7 @@ from ..api.objects import (
 from ..kube import Client, Event
 from ..kube.store import ADDED, DELETED, MODIFIED
 from ..scheduling.hostports import HostPortUsage
+from ..scheduling.volumeusage import VolumeResolver, VolumeUsage
 
 
 class StateNode:
@@ -39,6 +41,8 @@ class StateNode:
         self.node_claim = node_claim
         self.pods: List[Pod] = []
         self.hostport_usage = HostPortUsage()
+        self.volume_usage = VolumeUsage()
+        self.volume_limits: Dict[str, int] = {}  # csi driver -> max volumes
         self.pod_requests: Dict[str, res.ResourceList] = {}
         self.daemonset_requests: Dict[str, res.ResourceList] = {}
         self.mark_for_deletion = False
@@ -175,7 +179,7 @@ class StateNode:
 
     # -- pod bookkeeping --------------------------------------------------
 
-    def update_pod(self, pod: Pod, is_daemon: bool) -> None:
+    def update_pod(self, pod: Pod, is_daemon: bool, resolved_volumes=None) -> None:
         if pod.uid not in self.pod_requests:
             self.pods.append(pod)
         else:
@@ -184,12 +188,15 @@ class StateNode:
         if is_daemon:
             self.daemonset_requests[pod.uid] = dict(pod.spec.requests)
         self.hostport_usage.add(pod)
+        if resolved_volumes:
+            self.volume_usage.add(pod, resolved_volumes)
 
     def remove_pod(self, uid: str) -> None:
         self.pods = [p for p in self.pods if p.uid != uid]
         self.pod_requests.pop(uid, None)
         self.daemonset_requests.pop(uid, None)
         self.hostport_usage.delete_pod(uid)
+        self.volume_usage.delete_pod(uid)
 
     def deep_copy(self) -> "StateNode":
         out = StateNode(self.node, self.node_claim)
@@ -197,6 +204,8 @@ class StateNode:
         out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
         out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}
         out.hostport_usage = self.hostport_usage.copy()
+        out.volume_usage = self.volume_usage.copy()
+        out.volume_limits = dict(self.volume_limits)
         out.mark_for_deletion = self.mark_for_deletion
         out.nominated_until = self.nominated_until
         return out
@@ -218,6 +227,7 @@ class Cluster:
         self._anti_affinity_pods: Set[str] = set()
         self._unconsolidated_at: float = 0.0
         self._consolidated_at: float = 0.0
+        self._volume_resolver = VolumeResolver(client)
         client.watch(self._on_event)
         self._synced_once = False
 
@@ -317,6 +327,10 @@ class Cluster:
             "NodeClaim": self._handle_node_claim,
             "Pod": self._handle_pod,
             "DaemonSet": self._handle_daemonset,
+            "CSINode": self._handle_csinode,
+            "PersistentVolumeClaim": self._handle_volume_object,
+            "PersistentVolume": self._handle_volume_object,
+            "StorageClass": self._handle_volume_object,
         }.get(event.kind)
         if handler is not None:
             with self._lock:
@@ -402,7 +416,41 @@ class Cluster:
             self._bindings[pod.uid] = pod.spec.node_name
             sn = self._state_node_by_name(pod.spec.node_name)
             if sn is not None:
-                sn.update_pod(pod, is_daemon=self._is_daemon_pod(pod))
+                resolved, _ = self._volume_resolver.resolve(pod)
+                sn.update_pod(
+                    pod, is_daemon=self._is_daemon_pod(pod), resolved_volumes=resolved
+                )
+
+    def _handle_volume_object(self, event: Event) -> None:
+        """PVC/PV/StorageClass changes shift volume identities (an unbound
+        claim binding to a PV renames ns/claim -> pv-name), so re-resolve
+        every bound volume-bearing pod; VolumeUsage.add retracts the stale
+        resolution."""
+        for uid, node_name in list(self._bindings.items()):
+            try:
+                pod = self._client.get_by_uid(uid)
+            except KeyError:
+                continue
+            if not pod.spec.volumes:
+                continue
+            sn = self._state_node_by_name(node_name)
+            if sn is None:
+                continue
+            resolved, err = self._volume_resolver.resolve(pod)
+            if err is None:
+                sn.volume_usage.add(pod, resolved)
+
+    def _handle_csinode(self, event: Event) -> None:
+        """CSINode attach limits feed StateNode.volume_limits
+        (volumeusage.go reads CSINode.spec.drivers[].allocatable.count)."""
+        csinode = event.object
+        sn = self._state_node_by_name(csinode.metadata.name)
+        if sn is None:
+            return
+        if event.type == DELETED:
+            sn.volume_limits = {}
+        else:
+            sn.volume_limits = dict(csinode.driver_limits)
 
     def _handle_daemonset(self, event: Event) -> None:
         ds: DaemonSet = event.object
@@ -423,10 +471,17 @@ class Cluster:
         sn.pod_requests = {}
         sn.daemonset_requests = {}
         sn.hostport_usage = HostPortUsage()
+        sn.volume_usage = VolumeUsage()
+        csinode = self._client.try_get(CSINode, node_name)
+        if csinode is not None:
+            sn.volume_limits = dict(csinode.driver_limits)
         for pod in self._client.list(Pod):
             if pod.spec.node_name == node_name and pod.status.phase not in (
                 "Succeeded",
                 "Failed",
             ):
                 self._bindings[pod.uid] = node_name
-                sn.update_pod(pod, is_daemon=self._is_daemon_pod(pod))
+                resolved, _ = self._volume_resolver.resolve(pod)
+                sn.update_pod(
+                    pod, is_daemon=self._is_daemon_pod(pod), resolved_volumes=resolved
+                )

@@ -50,6 +50,7 @@ class EvictionQueue:
                 self.client.delete(pod)
             except KeyError:
                 pass
+            limits.record_eviction(pod)
         return blocked
 
 

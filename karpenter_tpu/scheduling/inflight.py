@@ -32,14 +32,31 @@ class ReservedOfferingError(Exception):
 
 
 class PodData:
-    """Cached per-pod scheduling data (reference: scheduler.go:136-141)."""
+    """Cached per-pod scheduling data (reference: scheduler.go:136-141).
+    Volume resolution is pod-scoped and node-independent, so it's cached
+    here rather than re-walked per ExistingNode attempt."""
 
-    __slots__ = ("requests", "requirements", "strict_requirements")
+    __slots__ = (
+        "requests",
+        "requirements",
+        "strict_requirements",
+        "resolved_volumes",
+        "volume_error",
+    )
 
-    def __init__(self, requests, requirements, strict_requirements):
+    def __init__(
+        self,
+        requests,
+        requirements,
+        strict_requirements,
+        resolved_volumes=(),
+        volume_error=None,
+    ):
         self.requests = requests
         self.requirements = requirements
         self.strict_requirements = strict_requirements
+        self.resolved_volumes = resolved_volumes
+        self.volume_error = volume_error
 
 
 def filter_instance_types(
@@ -262,6 +279,9 @@ class ExistingNode:
         self.topology = topology
         self.cached_taints = taints
         self.cached_available = state_node.available()
+        self.volume_usage = getattr(state_node, "volume_usage", None)
+        self.volume_usage = self.volume_usage.copy() if self.volume_usage else None
+        self.volume_limits = dict(getattr(state_node, "volume_limits", {}) or {})
         # daemon resources not already scheduled to the node, floored at 0
         remaining_daemons = res.subtract(
             daemon_resources, state_node.daemonset_request_total()
@@ -289,6 +309,14 @@ class ExistingNode:
         err = self.hostport_usage.conflicts(pod)
         if err is not None:
             return err
+        # csi volume attach limits (existingnode.go volume check)
+        resolved_volumes = pod_data.resolved_volumes
+        if pod.spec.volumes and self.volume_usage is not None:
+            if pod_data.volume_error is not None:
+                return pod_data.volume_error
+            err = self.volume_usage.validate(resolved_volumes, self.volume_limits)
+            if err is not None:
+                return err
         requests = res.merge(self.requests, pod_data.requests)
         if not res.fits(requests, self.cached_available):
             return "exceeds node resources"
@@ -314,4 +342,6 @@ class ExistingNode:
         self.requirements = node_requirements
         self.topology.record(pod, self.cached_taints, node_requirements)
         self.hostport_usage.add(pod)
+        if resolved_volumes and self.volume_usage is not None:
+            self.volume_usage.add(pod, resolved_volumes)
         return None

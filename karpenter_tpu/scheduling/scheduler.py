@@ -122,7 +122,9 @@ class Scheduler:
         reserved_offering_mode: str = RESERVED_OFFERING_MODE_FALLBACK,
         reserved_capacity_enabled: bool = False,
         clock=None,
+        volume_resolver=None,
     ):
+        self.volume_resolver = volume_resolver
         # tolerate PreferNoSchedule during relaxation if any pool taints with it
         tolerate_pns = any(
             t.effect == taints_mod.PREFER_NO_SCHEDULE
@@ -192,14 +194,25 @@ class Scheduler:
         strict = requirements
         if has_preferred_node_affinity(pod):
             strict = strict_pod_requirements(pod)
+        resolved_volumes, volume_error = (), None
+        if pod.spec.volumes and self.volume_resolver is not None:
+            resolved_volumes, volume_error = self.volume_resolver.resolve(pod)
         self.cached_pod_data[pod.uid] = PodData(
             requests=dict(pod.spec.requests),
             requirements=requirements,
             strict_requirements=strict,
+            resolved_volumes=resolved_volumes,
+            volume_error=volume_error,
         )
 
     def _add(self, pod: Pod) -> Optional[AddError]:
         pod_data = self.cached_pod_data[pod.uid]
+        # a pod whose PVC can't be resolved can never run anywhere — fail it
+        # instead of launching capacity for it (volumetopology.go:152-199;
+        # matters for disruption simulations, which bypass Provisioner
+        # validation)
+        if pod_data.volume_error is not None:
+            return AddError([pod_data.volume_error])
         # 1. existing nodes, initialized first
         for node in self.existing_nodes:
             if node.add(pod, pod_data) is None:

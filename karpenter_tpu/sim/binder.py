@@ -8,49 +8,73 @@ bind onto nodes with capacity whose labels/taints admit them.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import copy
+from typing import Dict, List, Optional
 
 from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api import taints as taints_mod
-from ..api.objects import Node, Pod
+from ..api.objects import CSINode, Node, Pod
 from ..api.requirements import Requirements, pod_requirements
 from ..kube import Client
+from ..scheduling.volumetopology import VolumeTopology
+from ..scheduling.volumeusage import VolumeUsage
 from ..utils import pod as pod_utils
 
 
 class Binder:
     def __init__(self, client: Client):
         self.client = client
+        self.volume_topology = VolumeTopology(client)
 
     def bind_all(self) -> List[Pod]:
         """One binding pass; returns newly bound pods."""
         nodes = [n for n in self.client.list(Node) if n.metadata.deletion_timestamp is None]
         bound = []
+        all_pods = self.client.list(Pod)
         used = {
             n.name: res.merge(
                 *(
                     p.spec.requests
-                    for p in self.client.list(Pod)
+                    for p in all_pods
                     if p.spec.node_name == n.name and pod_utils.is_active(p)
                 )
             )
-            if any(p.spec.node_name == n.name for p in self.client.list(Pod))
+            if any(p.spec.node_name == n.name for p in all_pods)
             else {}
             for n in nodes
         }
-        for pod in self.client.list(Pod):
+        volume_usage = self._build_volume_usage(nodes, all_pods)
+        for pod in all_pods:
             if not pod_utils.is_provisionable(pod):
                 continue
-            node = self._find_node(pod, nodes, used)
+            node = self._find_node(pod, nodes, used, volume_usage)
             if node is not None:
                 pod.spec.node_name = node.name
                 used[node.name] = res.merge(used[node.name], pod.spec.requests)
+                if pod.spec.volumes:
+                    resolved, _ = self.volume_topology.resolver.resolve(pod)
+                    volume_usage.setdefault(node.name, VolumeUsage()).add(pod, resolved)
                 self.client.update(pod)
                 bound.append(pod)
         return bound
 
-    def _find_node(self, pod: Pod, nodes: List[Node], used) -> Optional[Node]:
+    def _build_volume_usage(self, nodes, all_pods) -> Dict[str, VolumeUsage]:
+        usage: Dict[str, VolumeUsage] = {}
+        for p in all_pods:
+            if p.spec.volumes and p.spec.node_name and pod_utils.is_active(p):
+                resolved, _ = self.volume_topology.resolver.resolve(p)
+                usage.setdefault(p.spec.node_name, VolumeUsage()).add(p, resolved)
+        return usage
+
+    def _find_node(
+        self, pod: Pod, nodes: List[Node], used, volume_usage
+    ) -> Optional[Node]:
+        # the kube-scheduler's volume plugins see zonal PV constraints and
+        # CSI attach limits; mirror both so sim bindings match provisioning
+        if pod.spec.volumes:
+            pod = copy.deepcopy(pod)
+            self.volume_topology.inject(pod)
         for node in nodes:
             if node.unschedulable or not node.status.ready:
                 continue
@@ -62,5 +86,17 @@ class Binder:
             requests = res.merge(used.get(node.name, {}), pod.spec.requests)
             if not res.fits(requests, node.status.allocatable):
                 continue
+            if pod.spec.volumes and not self._volumes_fit(pod, node, volume_usage):
+                continue
             return node
         return None
+
+    def _volumes_fit(self, pod: Pod, node: Node, volume_usage) -> bool:
+        csinode = self.client.try_get(CSINode, node.name)
+        if csinode is None or not csinode.driver_limits:
+            return True
+        resolved, err = self.volume_topology.resolver.resolve(pod)
+        if err is not None:
+            return False
+        usage = volume_usage.setdefault(node.name, VolumeUsage())
+        return usage.validate(resolved, csinode.driver_limits) is None

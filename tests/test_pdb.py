@@ -1,0 +1,124 @@
+"""PodDisruptionBudget enforcement (reference: pkg/utils/pdb/limits.go and
+the eviction API's 429 handling in terminator/eviction.go:117-226)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    Node,
+    NodeClaim,
+    ObjectMeta,
+    PodDisruptionBudget,
+)
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.sim import Binder
+from karpenter_tpu.utils.pdb import Limits
+
+from helpers import make_nodepool, make_pod
+
+
+def pdb(name="pdb", labels=None, min_available=None, max_unavailable=None):
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name),
+        selector=LabelSelector(match_labels=dict(labels or {"app": "web"})),
+        min_available=min_available,
+        max_unavailable=max_unavailable,
+    )
+
+
+def bound_pods(n, labels=None):
+    pods = []
+    for i in range(n):
+        p = make_pod(labels=dict(labels or {"app": "web"}), node_name=f"node-{i % 3}")
+        p.status.phase = "Running"
+        pods.append(p)
+    return pods
+
+
+class TestLimitsComputation:
+    def test_min_available_absolute(self):
+        pods = bound_pods(5)
+        limits = Limits([pdb(min_available="3")], pods)
+        # 5 healthy - 3 required = 2 evictions allowed
+        assert limits.can_evict_pods(pods[:2]) is None
+        assert limits.can_evict_pods(pods[:3]) is not None
+
+    def test_min_available_percent_rounds_up(self):
+        pods = bound_pods(5)
+        # 50% of 5 rounds up to 3 -> 2 allowed
+        limits = Limits([pdb(min_available="50%")], pods)
+        assert limits.can_evict_pods(pods[:2]) is None
+        assert limits.can_evict_pods(pods[:3]) is not None
+
+    def test_max_unavailable(self):
+        pods = bound_pods(4)
+        limits = Limits([pdb(max_unavailable="1")], pods)
+        assert limits.can_evict_pods(pods[:1]) is None
+        assert limits.can_evict_pods(pods[:2]) is not None
+
+    def test_zero_allowance_blocks_all(self):
+        pods = bound_pods(2)
+        limits = Limits([pdb(max_unavailable="0")], pods)
+        assert limits.can_evict_pods(pods[:1]) is not None
+
+    def test_non_matching_pods_unaffected(self):
+        pods = bound_pods(3)
+        other = make_pod(labels={"app": "db"}, node_name="node-0")
+        limits = Limits([pdb(max_unavailable="0")], pods)
+        assert limits.can_evict_pods([other]) is None
+
+    def test_multiple_pdbs_refuse(self):
+        pods = bound_pods(3)
+        limits = Limits(
+            [pdb("a", min_available="1"), pdb("b", max_unavailable="1")], pods
+        )
+        assert "multiple PDBs" in limits.can_evict_pods(pods[:1])
+
+    def test_record_eviction_consumes_allowance(self):
+        pods = bound_pods(4)
+        limits = Limits([pdb(max_unavailable="2")], pods)
+        assert limits.can_evict_pods(pods[:2]) is None
+        limits.record_eviction(pods[0])
+        limits.record_eviction(pods[1])
+        assert limits.can_evict_pods(pods[2:3]) is not None
+
+
+class TestDrainHonorsPdb:
+    @pytest.fixture
+    def env(self):
+        clock = TestClock()
+        client = Client(clock)
+        provider = KwokCloudProvider(client, corpus.generate(20))
+        operator = Operator(client, provider)
+        binder = Binder(client)
+        return clock, client, provider, operator, binder
+
+    def test_drain_stops_at_budget(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        for _ in range(4):
+            client.create(make_pod(cpu="1", memory="1Gi", labels={"app": "web"}))
+        client.create(pdb(max_unavailable="1"))
+        for _ in range(6):
+            operator.step(force_provision=True)
+            binder.bind_all()
+            clock.step(1)
+        nodes = client.list(Node)
+        assert nodes
+        from karpenter_tpu.api.objects import Pod as PodKind
+
+        node = nodes[0]
+        on_node = [
+            p for p in client.list(PodKind) if p.spec.node_name == node.name
+        ]
+        assert len(on_node) >= 2
+        # drain the node: only 1 eviction is allowed by the budget
+        client.delete(node)
+        operator.termination.reconcile_all()
+        remaining = [
+            p for p in client.list(PodKind) if p.spec.node_name == node.name
+        ]
+        assert len(remaining) == len(on_node) - 1

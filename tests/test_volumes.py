@@ -1,0 +1,257 @@
+"""Volume topology + CSI volume-limit behavior.
+
+Mirrors the reference's volumetopology.go / volumeusage.go test coverage:
+zonal PVCs constrain pods to the volume's zone, missing PVCs exclude pods
+from provisioning, and CSI attach limits cap pods per existing node.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import (
+    CSINode,
+    Node,
+    NodeClaim,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimRef,
+    StorageClass,
+)
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling.volumetopology import VolumeTopology
+from karpenter_tpu.scheduling.volumeusage import VolumeResolver, VolumeUsage
+from karpenter_tpu.sim import Binder
+
+from helpers import make_nodepool, make_pod
+
+
+@pytest.fixture
+def env():
+    clock = TestClock()
+    client = Client(clock)
+    provider = KwokCloudProvider(client, corpus.generate(20))
+    operator = Operator(client, provider)
+    binder = Binder(client)
+    return clock, client, provider, operator, binder
+
+
+def provision_cycle(env, n_steps=6):
+    clock, client, provider, operator, binder = env
+    for _ in range(n_steps):
+        operator.step(force_provision=True)
+        binder.bind_all()
+        clock.step(1)
+
+
+def pod_with_claim(claim_name, **kwargs):
+    pod = make_pod(**kwargs)
+    pod.spec.volumes = [PersistentVolumeClaimRef(claim_name=claim_name)]
+    return pod
+
+
+class TestVolumeTopologyInjection:
+    def test_bound_pvc_zone_injected(self, env):
+        _, client, *_ = env
+        client.create(
+            PersistentVolume(
+                metadata=ObjectMeta(name="pv-1"), zones=("zone-2",), driver="csi.test"
+            )
+        )
+        client.create(
+            PersistentVolumeClaim(metadata=ObjectMeta(name="claim-1"), volume_name="pv-1")
+        )
+        pod = pod_with_claim("claim-1")
+        VolumeTopology(client).inject(pod)
+        reqs = [
+            r
+            for term in pod.spec.node_affinity.required
+            for r in term
+            if r.key == labels.TOPOLOGY_ZONE
+        ]
+        assert reqs and reqs[0].values == ("zone-2",)
+
+    def test_storage_class_zones_injected_for_unbound_pvc(self, env):
+        _, client, *_ = env
+        client.create(
+            StorageClass(
+                metadata=ObjectMeta(name="fast"),
+                zones=("zone-1", "zone-3"),
+                provisioner="csi.test",
+            )
+        )
+        client.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="claim-1"), storage_class_name="fast"
+            )
+        )
+        pod = pod_with_claim("claim-1")
+        VolumeTopology(client).inject(pod)
+        reqs = [
+            r
+            for term in pod.spec.node_affinity.required
+            for r in term
+            if r.key == labels.TOPOLOGY_ZONE
+        ]
+        assert reqs and set(reqs[0].values) == {"zone-1", "zone-3"}
+
+    def test_existing_affinity_terms_each_get_zone(self, env):
+        _, client, *_ = env
+        client.create(
+            PersistentVolume(metadata=ObjectMeta(name="pv-1"), zones=("zone-1",))
+        )
+        client.create(
+            PersistentVolumeClaim(metadata=ObjectMeta(name="claim-1"), volume_name="pv-1")
+        )
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        pod = pod_with_claim(
+            "claim-1",
+            requirements=[
+                NodeSelectorRequirement(labels.ARCH, "In", ("c",))
+            ],
+        )
+        VolumeTopology(client).inject(pod)
+        for term in pod.spec.node_affinity.required:
+            assert any(r.key == labels.TOPOLOGY_ZONE for r in term)
+
+    def test_pod_scheduled_into_volume_zone(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(
+            PersistentVolume(metadata=ObjectMeta(name="pv-1"), zones=("test-zone-b",))
+        )
+        client.create(
+            PersistentVolumeClaim(metadata=ObjectMeta(name="claim-1"), volume_name="pv-1")
+        )
+        client.create(pod_with_claim("claim-1", cpu="1", memory="1Gi"))
+        provision_cycle(env)
+        claims = client.list(NodeClaim)
+        assert len(claims) == 1
+        zone_req = [
+            r for r in claims[0].spec.requirements if r.key == labels.TOPOLOGY_ZONE
+        ]
+        assert zone_req and set(zone_req[0].values) <= {"test-zone-b"}
+
+    def test_missing_pvc_excludes_pod(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(pod_with_claim("no-such-claim"))
+        provision_cycle(env)
+        assert client.list(NodeClaim) == []
+
+    def test_missing_storage_class_excludes_pod(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="claim-1"), storage_class_name="no-such-sc"
+            )
+        )
+        client.create(pod_with_claim("claim-1"))
+        provision_cycle(env)
+        assert client.list(NodeClaim) == []
+
+
+class TestVolumeUsage:
+    def test_limit_enforced(self):
+        usage = VolumeUsage()
+        limits = {"csi.test": 2}
+        p1 = make_pod()
+        usage.add(p1, [("csi.test", "vol-1"), ("csi.test", "vol-2")])
+        assert usage.validate([("csi.test", "vol-3")], limits) is not None
+        # an already-attached volume doesn't count again
+        assert usage.validate([("csi.test", "vol-2")], limits) is None
+        # other drivers are unaffected
+        assert usage.validate([("csi.other", "vol-9")], limits) is None
+
+    def test_delete_pod_releases_unshared_volumes(self):
+        usage = VolumeUsage()
+        p1, p2 = make_pod(), make_pod()
+        usage.add(p1, [("d", "shared"), ("d", "own-1")])
+        usage.add(p2, [("d", "shared")])
+        usage.delete_pod(p1.uid)
+        assert usage.validate([("d", "own-1")], {"d": 2}) is None  # re-addable
+        # shared volume is still attached via p2
+        assert usage.validate([("d", "x"), ("d", "y")], {"d": 2}) is not None
+
+    def test_existing_node_respects_csi_limit(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(
+            StorageClass(metadata=ObjectMeta(name="fast"), provisioner="csi.test")
+        )
+        for i in range(3):
+            client.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=f"claim-{i}"), storage_class_name="fast"
+                )
+            )
+        # first pod lands on a fresh node
+        client.create(pod_with_claim("claim-0", cpu="1", memory="1Gi"))
+        provision_cycle(env)
+        nodes = client.list(Node)
+        assert len(nodes) == 1
+        # driver allows only 1 volume on this node
+        client.create(
+            CSINode(
+                metadata=ObjectMeta(name=nodes[0].name),
+                driver_limits={"csi.test": 1},
+            )
+        )
+        # second volume pod can't fit on the node despite free cpu/memory
+        client.create(pod_with_claim("claim-1", cpu="1", memory="1Gi"))
+        provision_cycle(env)
+        assert len(client.list(Node)) == 2
+
+    def test_resolver_missing_pvc_errors(self, env):
+        _, client, *_ = env
+        resolver = VolumeResolver(client)
+        _, err = resolver.resolve(pod_with_claim("absent"))
+        assert err is not None
+
+    def test_rebind_retracts_previous_volume_identity(self):
+        # a PVC binding changes its volume id from ns/claim to the PV name;
+        # re-adding the pod must not leak the old id into the driver count
+        usage = VolumeUsage()
+        pod = make_pod()
+        usage.add(pod, [("d", "default/claim-1")])
+        usage.add(pod, [("d", "pv-1")])  # PVC bound
+        assert usage.validate([("d", "pv-2")], {"d": 2}) is None
+
+    def test_cluster_scoped_lookup_ignores_pod_namespace(self, env):
+        # PV/SC are cluster-scoped: a pod in another namespace still resolves
+        _, client, *_ = env
+        client.create(
+            StorageClass(
+                metadata=ObjectMeta(name="fast"),
+                zones=("zone-9",),
+                provisioner="csi.test",
+            )
+        )
+        client.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="claim-1", namespace="prod"),
+                storage_class_name="fast",
+            )
+        )
+        pod = pod_with_claim("claim-1")
+        pod.metadata.namespace = "prod"
+        vt = VolumeTopology(client)
+        assert vt.validate_persistent_volume_claims(pod) is None
+        vt.inject(pod)
+        reqs = [
+            r
+            for term in pod.spec.node_affinity.required
+            for r in term
+            if r.key == labels.TOPOLOGY_ZONE
+        ]
+        assert reqs and reqs[0].values == ("zone-9",)
+        resolved, err = VolumeResolver(client).resolve(pod)
+        assert err is None and len(resolved) == 1
+        assert resolved[0].driver == "csi.test"
+        assert resolved[0].volume_id == "prod/claim-1"
+        assert resolved[0].zones == ("zone-9",)

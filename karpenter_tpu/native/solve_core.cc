@@ -1,0 +1,489 @@
+// Native host solver core: the C++ twin of ops/solve.py::solve_core.
+//
+// Implements the same decision problem as the JAX kernel — fused feasibility
+// tables (ops/feasibility.py) + grouped first-fit-decreasing packing
+// (ops/packing.py) — over the identical dense snapshot arrays, with the same
+// tie-breaking (greedy prefix fill over existing nodes, integer water-fill
+// over open claims, highest-weight-template-first for new claims). The
+// reference's runtime is a compiled (Go) binary; this is the TPU build's
+// native runtime path: used as the host fallback when no accelerator is
+// attached, and as an independent implementation the JAX kernel is
+// parity-tested against (tests/test_native.py).
+//
+// Scalar float math is done in float32 to match XLA's element types so the
+// two implementations agree bit-for-bit on fits counts.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <cmath>
+#include <limits>
+
+namespace {
+
+using std::int32_t;
+using std::uint8_t;
+
+constexpr float kInf = std::numeric_limits<float>::infinity();
+constexpr int32_t kBigFit = 1 << 30;
+
+// fits_count (ops/feasibility.py:68-80): identical float32 semantics.
+inline int32_t fits_count(const float* alloc, const float* base, const float* req,
+                          int R) {
+  bool ok_zero = true;
+  float n = kInf;
+  for (int r = 0; r < R; ++r) {
+    float headroom = alloc[r] - base[r];
+    if (!(req[r] > 0.0f) && !(headroom >= 0.0f)) ok_zero = false;
+    float per = (req[r] > 0.0f)
+                    ? std::floor(headroom / std::max(req[r], 1e-9f))
+                    : kInf;
+    n = std::min(n, per);
+  }
+  if (std::isinf(n)) n = static_cast<float>(kBigFit);
+  if (!ok_zero) return 0;
+  return static_cast<int32_t>(std::max(n, 0.0f));
+}
+
+struct Dims {
+  int G, T, P, N, R, K, V1, O, NMAX, zone_kid, ct_kid;
+};
+
+// Requirements.Intersects over one (K,V1) mask pair
+// (ops/feasibility.py:19-30).
+inline bool req_intersect(const uint8_t* a_def, const uint8_t* a_neg,
+                          const uint8_t* a_mask, const uint8_t* b_def,
+                          const uint8_t* b_neg, const uint8_t* b_mask, int K,
+                          int V1) {
+  for (int k = 0; k < K; ++k) {
+    bool overlap = false;
+    const uint8_t* am = a_mask + k * V1;
+    const uint8_t* bm = b_mask + k * V1;
+    for (int v = 0; v < V1; ++v)
+      if (am[v] && bm[v]) {
+        overlap = true;
+        break;
+      }
+    bool exempt = a_neg[k] && b_neg[k];
+    bool both = a_def[k] && b_def[k];
+    if (!(overlap || exempt || !both)) return false;
+  }
+  return true;
+}
+
+// Requirements.Compatible with the well-known allowance
+// (ops/feasibility.py:33-42).
+inline bool req_compatible(const uint8_t* n_def, const uint8_t* n_neg,
+                           const uint8_t* n_mask, const uint8_t* p_def,
+                           const uint8_t* p_neg, const uint8_t* p_mask,
+                           const uint8_t* well_known, int K, int V1) {
+  for (int k = 0; k < K; ++k) {
+    bool wk = well_known ? well_known[k] : false;
+    if (p_def[k] && !wk && !n_def[k] && !p_neg[k]) return false;
+  }
+  return req_intersect(n_def, n_neg, n_mask, p_def, p_neg, p_mask, K, V1);
+}
+
+// greedy_prefix_fill (ops/packing.py:37-40)
+inline void greedy_prefix_fill(const std::vector<int32_t>& cap, int32_t n,
+                               std::vector<int32_t>& fill) {
+  int32_t before = 0;
+  for (size_t i = 0; i < cap.size(); ++i) {
+    int32_t f = n - before;
+    if (f < 0) f = 0;
+    if (f > cap[i]) f = cap[i];
+    fill[i] = f;
+    before += cap[i];
+  }
+}
+
+// waterfill (ops/packing.py:43-72): identical level/deficit semantics.
+inline void waterfill(const std::vector<int32_t>& npods,
+                      const std::vector<int32_t>& cap, int32_t n,
+                      std::vector<int32_t>& fills) {
+  int64_t total_cap = 0;
+  for (int32_t c : cap) total_cap += c;
+  if (n > total_cap) n = static_cast<int32_t>(total_cap);
+  auto f = [&](int64_t level) {
+    int64_t s = 0;
+    for (size_t i = 0; i < cap.size(); ++i) {
+      int64_t v = level - npods[i];
+      if (v < 0) v = 0;
+      if (v > cap[i]) v = cap[i];
+      s += v;
+    }
+    return s;
+  };
+  int64_t hi = 1;
+  for (size_t i = 0; i < cap.size(); ++i)
+    hi = std::max<int64_t>(hi, static_cast<int64_t>(npods[i]) + cap[i] + 1);
+  int64_t lo = 0;
+  while (lo + 1 < hi) {  // smallest level with f(level) >= n
+    int64_t mid = (lo + hi) / 2;
+    if (f(mid) >= n)
+      hi = mid;
+    else
+      lo = mid;
+  }
+  int64_t level = (f(0) >= n) ? 0 : hi;
+  int64_t deficit = n;
+  std::vector<uint8_t> elig(cap.size(), 0);
+  for (size_t i = 0; i < cap.size(); ++i) {
+    int64_t base = (level - 1) - npods[i];
+    if (base < 0) base = 0;
+    if (base > cap[i]) base = cap[i];
+    fills[i] = static_cast<int32_t>(base);
+    deficit -= base;
+    elig[i] = (base < cap[i]) && (npods[i] <= level - 1);
+  }
+  int64_t rank = 0;
+  for (size_t i = 0; i < cap.size(); ++i) {
+    if (elig[i]) {
+      ++rank;
+      if (rank <= deficit) fills[i] += 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, 1 when NMAX overflowed (caller doubles and retries,
+// matching the JAX driver's overflow loop).
+int kt_solve(
+    // dims
+    int G, int T, int P, int N, int R, int K, int V1, int O, int NMAX,
+    int zone_kid, int ct_kid,
+    // groups (FFD order)
+    const int32_t* g_count, const float* g_req, const uint8_t* g_def,
+    const uint8_t* g_neg, const uint8_t* g_mask,
+    // templates
+    const uint8_t* p_def, const uint8_t* p_neg, const uint8_t* p_mask,
+    const float* p_daemon, const float* p_limit, const uint8_t* p_has_limit,
+    const uint8_t* p_tol, const uint8_t* p_titype_ok,
+    // instance types
+    const uint8_t* t_def, const uint8_t* t_mask, const float* t_alloc,
+    const float* t_cap,
+    // offerings
+    const uint8_t* o_avail, const int32_t* o_zone, const int32_t* o_ct,
+    const uint8_t* a_tzc,  // [T, V1, V1]
+    // existing nodes
+    const uint8_t* n_def, const uint8_t* n_mask, const float* n_avail,
+    const float* n_base, const uint8_t* n_tol,
+    const uint8_t* well_known,
+    // outputs
+    int32_t* out_c_pool,      // [NMAX]
+    uint8_t* out_c_tmask,     // [NMAX, T]
+    int32_t* out_n_open,      // [1]
+    uint8_t* out_overflow,    // [1]
+    int32_t* out_exist_fills, // [G, N]
+    int32_t* out_claim_fills, // [G, NMAX]
+    int32_t* out_unplaced     // [G]
+) {
+  const int KV = K * V1;
+
+  // ---- feasibility tables (ops/feasibility.py) ------------------------
+  // compat_pg [P,G], type_ok_pgt [P,G,T], n_fit_pgt [P,G,T]
+  std::vector<uint8_t> compat_pg(P * G);
+  std::vector<uint8_t> type_ok_pgt(static_cast<size_t>(P) * G * T);
+  std::vector<int32_t> n_fit_pgt(static_cast<size_t>(P) * G * T);
+  // merged claim requirement state per (p,g)
+  std::vector<uint8_t> c_def_pg(K), c_neg_pg(K), c_mask_pg(KV);
+  std::vector<uint8_t> t_neg_zero(K, 0);
+
+  for (int p = 0; p < P; ++p) {
+    for (int g = 0; g < G; ++g) {
+      bool compat =
+          p_tol[p * G + g] &&
+          req_compatible(p_def + p * K, p_neg + p * K, p_mask + p * KV,
+                         g_def + g * K, g_neg + g * K, g_mask + g * KV,
+                         well_known, K, V1);
+      compat_pg[p * G + g] = compat;
+      // merged = template ∪ group (merge_requirements)
+      for (int k = 0; k < K; ++k) {
+        c_def_pg[k] = p_def[p * K + k] || g_def[g * K + k];
+        c_neg_pg[k] = p_neg[p * K + k] && g_neg[g * K + k];
+        for (int v = 0; v < V1; ++v)
+          c_mask_pg[k * V1 + v] =
+              p_mask[p * KV + k * V1 + v] && g_mask[g * KV + k * V1 + v];
+      }
+      for (int t = 0; t < T; ++t) {
+        size_t idx = (static_cast<size_t>(p) * G + g) * T + t;
+        int32_t nf = fits_count(t_alloc + t * R, p_daemon + p * R,
+                                g_req + g * R, R);
+        n_fit_pgt[idx] = nf;
+        bool tc = req_intersect(t_def + t * K, t_neg_zero.data(),
+                                t_mask + t * KV, c_def_pg.data(),
+                                c_neg_pg.data(), c_mask_pg.data(), K, V1);
+        // offering_ok against merged zone/ct masks
+        bool off = false;
+        for (int o = 0; o < O && !off; ++o) {
+          if (!o_avail[t * O + o]) continue;
+          int32_t z = o_zone[t * O + o], c = o_ct[t * O + o];
+          bool z_ok = (z < 0) || c_mask_pg[zone_kid * V1 + z];
+          bool c_ok = (c < 0) || c_mask_pg[ct_kid * V1 + c];
+          off = z_ok && c_ok;
+        }
+        type_ok_pgt[idx] = tc && off && (nf >= 1) &&
+                           p_titype_ok[p * T + t] && compat;
+      }
+    }
+  }
+
+  // cap_ng [N, G] (existing_node_feasibility; strict compatibility)
+  std::vector<int32_t> cap_ng(static_cast<size_t>(N) * G, 0);
+  std::vector<uint8_t> n_neg_zero(K, 0);
+  for (int n = 0; n < N; ++n) {
+    for (int g = 0; g < G; ++g) {
+      if (!n_tol[n * G + g]) continue;
+      if (!req_compatible(n_def + n * K, n_neg_zero.data(), n_mask + n * KV,
+                          g_def + g * K, g_neg + g * K, g_mask + g * KV,
+                          nullptr, K, V1))
+        continue;
+      cap_ng[static_cast<size_t>(n) * G + g] =
+          fits_count(n_avail + n * R, n_base + n * R, g_req + g * R, R);
+    }
+  }
+
+  // ---- pack state ------------------------------------------------------
+  std::vector<float> exist_used(n_base, n_base + static_cast<size_t>(N) * R);
+  std::vector<float> c_used(static_cast<size_t>(NMAX) * R, 0.0f);
+  std::vector<int32_t> c_npods(NMAX, 0);
+  std::vector<uint8_t> c_active(NMAX, 0);
+  std::vector<int32_t> c_pool(NMAX, 0);
+  std::vector<uint8_t> c_tmask(static_cast<size_t>(NMAX) * T, 0);
+  std::vector<uint8_t> c_def(static_cast<size_t>(NMAX) * K, 0);
+  std::vector<uint8_t> c_neg(static_cast<size_t>(NMAX) * K, 0);
+  std::vector<uint8_t> c_mask(static_cast<size_t>(NMAX) * KV, 1);
+  std::vector<float> pool_rem(p_limit, p_limit + static_cast<size_t>(P) * R);
+  int32_t n_open = 0;
+  bool overflow = false;
+
+  std::memset(out_exist_fills, 0, sizeof(int32_t) * G * N);
+  std::memset(out_claim_fills, 0, sizeof(int32_t) * G * NMAX);
+  std::memset(out_unplaced, 0, sizeof(int32_t) * G);
+
+  std::vector<int32_t> exist_cap(N), exist_fill(N);
+  std::vector<int32_t> claim_cap(NMAX), claim_fill(NMAX);
+
+  for (int gi = 0; gi < G; ++gi) {
+    int32_t count = g_count[gi];
+    const float* req = g_req + gi * R;
+    const uint8_t* gdef = g_def + gi * K;
+    const uint8_t* gneg = g_neg + gi * K;
+    const uint8_t* gmask = g_mask + gi * KV;
+
+    // ---- 1. existing nodes, fixed priority order ----
+    for (int n = 0; n < N; ++n) {
+      exist_cap[n] =
+          (cap_ng[static_cast<size_t>(n) * G + gi] > 0)
+              ? fits_count(n_avail + n * R, exist_used.data() + n * R, req, R)
+              : 0;
+    }
+    greedy_prefix_fill(exist_cap, count, exist_fill);
+    int32_t rem = count;
+    for (int n = 0; n < N; ++n) {
+      if (exist_fill[n] > 0) {
+        for (int r = 0; r < R; ++r)
+          exist_used[static_cast<size_t>(n) * R + r] += exist_fill[n] * req[r];
+        out_exist_fills[static_cast<size_t>(gi) * N + n] = exist_fill[n];
+        rem -= exist_fill[n];
+      }
+    }
+
+    // ---- 2. open claims, least-loaded first ----
+    std::vector<uint8_t> got(NMAX, 0);
+    for (int s = 0; s < NMAX; ++s) {
+      claim_cap[s] = 0;
+      claim_fill[s] = 0;
+      if (!c_active[s]) continue;
+      // claim-vs-group key compatibility (overlap | exempt | not both
+      // defined) + custom-label rule + template tolerance/compat
+      bool compat = true;
+      const uint8_t* sm = c_mask.data() + static_cast<size_t>(s) * KV;
+      const uint8_t* sd = c_def.data() + static_cast<size_t>(s) * K;
+      const uint8_t* sn = c_neg.data() + static_cast<size_t>(s) * K;
+      for (int k = 0; k < K && compat; ++k) {
+        bool overlap = false;
+        for (int v = 0; v < V1; ++v)
+          if (sm[k * V1 + v] && gmask[k * V1 + v]) {
+            overlap = true;
+            break;
+          }
+        bool exempt = sn[k] && gneg[k];
+        if (!(overlap || exempt || !(sd[k] && gdef[k]))) compat = false;
+        if (gdef[k] && !well_known[k] && !sd[k] && !gneg[k]) compat = false;
+      }
+      int pp = c_pool[s];
+      compat = compat && p_tol[pp * G + gi] && compat_pg[pp * G + gi];
+      if (!compat) continue;
+      // per-type: options ∧ template-group table ∧ fits under load ∧
+      // offering under merged masks
+      int32_t best = 0;
+      for (int t = 0; t < T; ++t) {
+        if (!c_tmask[static_cast<size_t>(s) * T + t]) continue;
+        if (!type_ok_pgt[(static_cast<size_t>(pp) * G + gi) * T + t]) continue;
+        int32_t add = fits_count(t_alloc + t * R,
+                                 c_used.data() + static_cast<size_t>(s) * R,
+                                 req, R);
+        if (add < 1) continue;
+        // offering over merged zone/ct masks via a_tzc
+        bool off = false;
+        const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
+        for (int z = 0; z < V1 && !off; ++z) {
+          if (!(sm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z])) continue;
+          for (int c = 0; c < V1; ++c) {
+            if (az[z * V1 + c] && sm[ct_kid * V1 + c] &&
+                gmask[ct_kid * V1 + c]) {
+              off = true;
+              break;
+            }
+          }
+        }
+        if (off && add > best) best = add;
+      }
+      claim_cap[s] = best;
+    }
+    waterfill(c_npods, claim_cap, rem, claim_fill);
+    for (int s = 0; s < NMAX; ++s) {
+      if (claim_fill[s] <= 0) continue;
+      got[s] = 1;
+      rem -= claim_fill[s];
+      c_npods[s] += claim_fill[s];
+      for (int r = 0; r < R; ++r)
+        c_used[static_cast<size_t>(s) * R + r] += claim_fill[s] * req[r];
+      out_claim_fills[static_cast<size_t>(gi) * NMAX + s] = claim_fill[s];
+    }
+    // commit claim requirement/type-mask mutations for claims that got pods
+    for (int s = 0; s < NMAX; ++s) {
+      if (!got[s]) continue;
+      uint8_t* sm = c_mask.data() + static_cast<size_t>(s) * KV;
+      uint8_t* sd = c_def.data() + static_cast<size_t>(s) * K;
+      uint8_t* sn = c_neg.data() + static_cast<size_t>(s) * K;
+      int pp = c_pool[s];
+      for (int k = 0; k < K; ++k) {
+        sd[k] = sd[k] || gdef[k];
+        sn[k] = sn[k] && gneg[k];
+        for (int v = 0; v < V1; ++v) sm[k * V1 + v] = sm[k * V1 + v] && gmask[k * V1 + v];
+      }
+      for (int t = 0; t < T; ++t) {
+        if (!c_tmask[static_cast<size_t>(s) * T + t]) continue;
+        bool keep = type_ok_pgt[(static_cast<size_t>(pp) * G + gi) * T + t];
+        if (keep) {
+          // offering under the (now merged) masks
+          bool off = false;
+          const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
+          for (int z = 0; z < V1 && !off; ++z) {
+            if (!sm[zone_kid * V1 + z]) continue;
+            for (int c = 0; c < V1; ++c)
+              if (az[z * V1 + c] && sm[ct_kid * V1 + c]) {
+                off = true;
+                break;
+              }
+          }
+          keep = off;
+        }
+        if (keep) {
+          for (int r = 0; r < R; ++r)
+            if (t_alloc[t * R + r] < c_used[static_cast<size_t>(s) * R + r]) {
+              keep = false;
+              break;
+            }
+        }
+        c_tmask[static_cast<size_t>(s) * T + t] = keep;
+      }
+    }
+
+    // ---- 3. new claims from highest-weight feasible template ----
+    while (rem > 0 && !overflow) {
+      int p_star = -1;
+      for (int p = 0; p < P && p_star < 0; ++p) {
+        for (int t = 0; t < T; ++t) {
+          if (!type_ok_pgt[(static_cast<size_t>(p) * G + gi) * T + t]) continue;
+          if (p_has_limit[p]) {
+            bool within = true;
+            for (int r = 0; r < R; ++r)
+              if (t_cap[t * R + r] > pool_rem[static_cast<size_t>(p) * R + r]) {
+                within = false;
+                break;
+              }
+            if (!within) continue;
+          }
+          p_star = p;
+          break;
+        }
+      }
+      if (p_star < 0) break;  // unplaceable remainder
+      int32_t n_per = 0;
+      for (int t = 0; t < T; ++t) {
+        if (!type_ok_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t])
+          continue;
+        if (p_has_limit[p_star]) {
+          bool within = true;
+          for (int r = 0; r < R; ++r)
+            if (t_cap[t * R + r] >
+                pool_rem[static_cast<size_t>(p_star) * R + r]) {
+              within = false;
+              break;
+            }
+          if (!within) continue;
+        }
+        n_per = std::max(
+            n_per, n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t]);
+      }
+      int32_t n_take = std::min(rem, n_per);
+      if (n_take <= 0) break;
+      if (n_open >= NMAX) {
+        overflow = true;
+        break;
+      }
+      int slot = n_open++;
+      c_active[slot] = 1;
+      c_pool[slot] = p_star;
+      c_npods[slot] = n_take;
+      for (int r = 0; r < R; ++r)
+        c_used[static_cast<size_t>(slot) * R + r] =
+            p_daemon[static_cast<size_t>(p_star) * R + r] + n_take * req[r];
+      std::vector<float> debit(R, 0.0f);
+      for (int t = 0; t < T; ++t) {
+        bool avail =
+            type_ok_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t];
+        if (avail && p_has_limit[p_star]) {
+          bool within = true;
+          for (int r = 0; r < R; ++r)
+            if (t_cap[t * R + r] >
+                pool_rem[static_cast<size_t>(p_star) * R + r]) {
+              within = false;
+              break;
+            }
+          avail = within;
+        }
+        c_tmask[static_cast<size_t>(slot) * T + t] =
+            avail &&
+            (n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t] >=
+             n_take);
+        if (avail)
+          for (int r = 0; r < R; ++r)
+            debit[r] = std::max(debit[r], t_cap[t * R + r]);
+      }
+      std::memcpy(c_def.data() + static_cast<size_t>(slot) * K, gdef, K);
+      std::memcpy(c_neg.data() + static_cast<size_t>(slot) * K, gneg, K);
+      std::memcpy(c_mask.data() + static_cast<size_t>(slot) * KV, gmask, KV);
+      if (p_has_limit[p_star])
+        for (int r = 0; r < R; ++r)
+          pool_rem[static_cast<size_t>(p_star) * R + r] -= debit[r];
+      out_claim_fills[static_cast<size_t>(gi) * NMAX + slot] = n_take;
+      rem -= n_take;
+    }
+    out_unplaced[gi] = rem;
+  }
+
+  std::memcpy(out_c_pool, c_pool.data(), sizeof(int32_t) * NMAX);
+  std::memcpy(out_c_tmask, c_tmask.data(), sizeof(uint8_t) * NMAX * T);
+  out_n_open[0] = n_open;
+  out_overflow[0] = overflow ? 1 : 0;
+  return overflow ? 1 : 0;
+}
+
+}  // extern "C"

@@ -27,7 +27,6 @@ from ..api import resources as res
 from ..api.objects import NodePool, Pod
 from ..api.requirements import Requirements
 from ..cloudprovider import types as cp
-from ..ops.solve import solve_all
 from ..scheduling.scheduler import Results, Scheduler
 from ..scheduling.template import NodeClaimTemplate
 from ..scheduling.topology import Topology
@@ -38,6 +37,9 @@ from . import encode as enc
 class SolverConfig:
     max_claims: Optional[int] = None  # NMAX override; default auto-estimated
     force_oracle: bool = False  # route everything host-side (debugging)
+    # "tpu": jitted JAX kernel (ops/solve.py). "native": the C++ host core
+    # (native/solve_core.cc) — same contract, no accelerator needed.
+    backend: str = "tpu"
 
 
 @dataclass
@@ -108,8 +110,6 @@ class TpuSolver:
     # -- fast path --------------------------------------------------------
 
     def _solve_fast(self, pods: List[Pod]) -> Tuple[List[DecodedClaim], Dict[str, object]]:
-        import jax
-
         groups = enc.build_groups(pods)
         templates = self.oracle.templates
         if not templates:
@@ -127,17 +127,39 @@ class TpuSolver:
         )
         a_tzc = self._offering_availability(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap)
+        statics = dict(zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
+        args = snap.solve_args(a_tzc)
 
-        # one transfer, one dispatch, one readback (tunnel round-trips
-        # dominate small solves — see ops/solve.py)
-        args = jax.device_put(snap.solve_args(a_tzc))
+        if self.config.backend == "native":
+            from .. import native
+
+            def call(nmax):
+                return native.solve_core_native(*args, nmax=nmax, **statics)
+
+        elif self.config.backend == "tpu":
+            # imported lazily so backend="native" serves accelerator-less
+            # (and jax-less) hosts
+            import jax
+
+            from ..ops.solve import solve_all
+
+            # one transfer, one dispatch, one readback (tunnel round-trips
+            # dominate small solves — see ops/solve.py)
+            device_args = jax.device_put(args)
+
+            def call(nmax):
+                out = solve_all(*device_args, nmax=nmax, **statics)
+                return [np.asarray(x) for x in jax.device_get(out)]
+
+        else:
+            raise ValueError(
+                f"unknown solver backend {self.config.backend!r}"
+                " (expected 'tpu' or 'native')"
+            )
+
         while True:
-            out = solve_all(
-                *args, nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid
-            )
-            c_pool, c_tmask, n_open, overflow, exist_fills, claim_fills, unplaced = (
-                np.asarray(x) for x in jax.device_get(out)
-            )
+            (c_pool, c_tmask, n_open, overflow,
+             exist_fills, claim_fills, unplaced) = call(nmax)
             if not overflow:
                 break
             nmax *= 2

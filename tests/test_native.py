@@ -1,0 +1,75 @@
+"""Parity: the C++ host solver core vs the JAX kernel.
+
+The native core (native/solve_core.cc) implements the identical decision
+problem as ops/solve.py::solve_core; these tests assert exact agreement on
+the packing outputs over a range of snapshot shapes, then drive the full
+TpuSolver with backend='native' and compare end-to-end Results.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import native
+from karpenter_tpu.solver.driver import SolverConfig
+from karpenter_tpu.solver.example import example_snapshot_arrays, example_solver
+
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+@requires_native
+class TestKernelParity:
+    @pytest.mark.parametrize(
+        "n_pods,n_types,shapes",
+        [(16, 4, 1), (64, 16, 4), (200, 40, 8), (500, 10, 1), (1000, 60, 25)],
+    )
+    def test_exact_output_parity(self, n_pods, n_types, shapes):
+        import jax
+
+        from karpenter_tpu.ops.solve import solve_all
+
+        args, statics = example_snapshot_arrays(n_pods, n_types, shapes)
+        jout = [np.asarray(x) for x in jax.device_get(solve_all(*args, **statics))]
+        nout = native.solve_core_native(*args, **statics)
+
+        j_pool, j_tmask, j_open, j_over = jout[0], jout[1], int(jout[2]), bool(jout[3])
+        n_pool, n_tmask, n_open, n_over = nout[0], nout[1], int(nout[2]), nout[3]
+        assert n_over == j_over
+        assert n_open == j_open
+        np.testing.assert_array_equal(n_pool[:n_open], j_pool[:j_open])
+        np.testing.assert_array_equal(
+            n_tmask[:n_open], j_tmask[:j_open].astype(bool)
+        )
+        np.testing.assert_array_equal(nout[4], jout[4])  # exist_fills
+        np.testing.assert_array_equal(nout[5], jout[5])  # claim_fills
+        np.testing.assert_array_equal(nout[6], jout[6])  # unplaced
+
+
+@requires_native
+class TestDriverBackend:
+    def test_native_backend_matches_tpu_backend(self):
+        solver_t, pods = example_solver(300, 30, 6)
+        results_t = solver_t.solve(pods)
+
+        solver_n, pods_n = example_solver(300, 30, 6)
+        solver_n.config = SolverConfig(backend="native")
+        results_n = solver_n.solve(pods_n)
+
+        assert results_n.node_count() == results_t.node_count()
+        assert results_n.total_price() == pytest.approx(results_t.total_price())
+        assert len(results_n.pod_errors) == len(results_t.pod_errors)
+
+    def test_unknown_backend_rejected(self):
+        solver, pods = example_solver(16, 4, 1)
+        solver.config = SolverConfig(backend="cpu")
+        with pytest.raises(ValueError, match="unknown solver backend"):
+            solver.solve(pods)
+
+    def test_native_backend_all_pods_placed(self):
+        solver, pods = example_solver(500, 10, 1)
+        solver.config = SolverConfig(backend="native")
+        results = solver.solve(pods)
+        assert not results.pod_errors
+        assert sum(len(c.pods) for c in results.new_node_claims) == 500

@@ -86,6 +86,7 @@ class Provisioner:
         solver_config: Optional[SolverConfig] = None,
         batch_idle_duration: float = 1.0,
         batch_max_duration: float = 10.0,
+        reserved_capacity_enabled: bool = False,
     ):
         self.client = client
         self.cloud_provider = cloud_provider
@@ -93,6 +94,7 @@ class Provisioner:
         self.clock = client.clock
         self.recorder = recorder or Recorder(self.clock)
         self.solver_config = solver_config
+        self.reserved_capacity_enabled = reserved_capacity_enabled
         self.batcher = Batcher(self.clock, batch_idle_duration, batch_max_duration)
         self.volume_topology = VolumeTopology(client)
         self.volume_resolver = VolumeResolver(client)
@@ -103,6 +105,8 @@ class Provisioner:
     def _on_event(self, event) -> None:
         if event.kind == "Pod" and event.type in ("ADDED", "MODIFIED"):
             if pod_utils.is_provisionable(event.object):
+                # ACK for scheduling-latency metrics (controller.go:63-66)
+                self.cluster.ack_pods(event.object.uid)
                 self.trigger(event.object.uid)
 
     def trigger(self, uid: str) -> None:
@@ -124,6 +128,12 @@ class Provisioner:
         if not pods:
             return None
         results = self.schedule(pods)
+        scheduled_uids = [
+            p.uid for p in pods if p.uid not in results.pod_errors
+        ]
+        self.cluster.mark_pod_scheduling_decisions(
+            results.pod_errors, *scheduled_uids
+        )
         self.create_node_claims(results)
         self.nominate(results)
         return results
@@ -194,6 +204,7 @@ class Provisioner:
             daemonset_pods=daemonset_pods,
             config=self.solver_config,
             volume_resolver=self.volume_resolver,
+            reserved_capacity_enabled=self.reserved_capacity_enabled,
         )
         results = solver.solve(pods)
         results.truncate_instance_types(MAX_INSTANCE_TYPES)

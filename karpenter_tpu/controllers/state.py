@@ -228,6 +228,10 @@ class Cluster:
         self._unconsolidated_at: float = 0.0
         self._consolidated_at: float = 0.0
         self._volume_resolver = VolumeResolver(client)
+        # pod scheduling-latency bookkeeping (cluster.go:61-64, 352-435)
+        self._pod_acks: Dict[str, float] = {}  # uid -> first provisioner sight
+        self._pods_schedulable_times: Dict[str, float] = {}  # uid -> success time
+        self._pods_scheduling_attempted: Dict[str, float] = {}  # uid -> first attempt
         client.watch(self._on_event)
         self._synced_once = False
 
@@ -306,6 +310,41 @@ class Cluster:
         sn = self.node_for_name(node_name)
         if sn is not None:
             sn.nominate(now)
+
+    # -- pod scheduling-latency bookkeeping (cluster.go:352-435) ----------
+
+    def ack_pods(self, *uids: str) -> None:
+        """Stamp the first time the provisioner saw each pod (AckPods)."""
+        now = self._client.clock.now()
+        with self._lock:
+            for uid in uids:
+                self._pod_acks.setdefault(uid, now)
+
+    def pod_ack_time(self, uid: str) -> Optional[float]:
+        with self._lock:
+            return self._pod_acks.get(uid)
+
+    def mark_pod_scheduling_decisions(
+        self, errors: Dict[str, object], *scheduled_uids: str
+    ) -> None:
+        """Record the outcome of one scheduling round
+        (MarkPodSchedulingDecisions, cluster.go:382-407)."""
+        now = self._client.clock.now()
+        with self._lock:
+            for uid in scheduled_uids:
+                self._pods_scheduling_attempted.setdefault(uid, now)
+                self._pods_schedulable_times.setdefault(uid, now)
+            for uid in errors:
+                self._pods_scheduling_attempted.setdefault(uid, now)
+                self._pods_schedulable_times.pop(uid, None)
+
+    def pod_scheduling_decision_time(self, uid: str) -> Optional[float]:
+        with self._lock:
+            return self._pods_scheduling_attempted.get(uid)
+
+    def pod_scheduling_success_time(self, uid: str) -> Optional[float]:
+        with self._lock:
+            return self._pods_schedulable_times.get(uid)
 
     def mark_for_deletion(self, *provider_ids: str) -> None:
         with self._lock:
@@ -391,6 +430,9 @@ class Cluster:
         pod: Pod = event.object
         if event.type == DELETED:
             self._anti_affinity_pods.discard(pod.uid)
+            self._pod_acks.pop(pod.uid, None)
+            self._pods_schedulable_times.pop(pod.uid, None)
+            self._pods_scheduling_attempted.pop(pod.uid, None)
             node_name = self._bindings.pop(pod.uid, None)
             if node_name is not None:
                 sn = self._state_node_by_name(node_name)

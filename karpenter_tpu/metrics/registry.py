@@ -44,6 +44,10 @@ class Counter(Metric):
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
     def collect(self):
         return [("counter", self.name, dict(k), v) for k, v in self._values.items()]
 
@@ -72,6 +76,10 @@ class Gauge(Metric):
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
     def collect(self):
         return [("gauge", self.name, dict(k), v) for k, v in self._values.items()]
 
@@ -99,6 +107,12 @@ class Histogram(Metric):
 
     def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._sums.get(_label_key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
 
     def collect(self):
         return [

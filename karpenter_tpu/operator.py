@@ -21,6 +21,11 @@ from .controllers.housekeeping import (
     NodePoolStatusController,
 )
 from .controllers.lifecycle import LifecycleController
+from .controllers.metrics_controllers import (
+    NodeMetricsController,
+    NodePoolMetricsController,
+    PodMetricsController,
+)
 from .controllers.nodeclaim_disruption import (
     NodeClaimDisruptionController,
     PodEventsController,
@@ -30,6 +35,7 @@ from .controllers.state import Cluster
 from .controllers.termination import TerminationController
 from .events import Recorder
 from .kube import Client, Clock, RealClock
+from .options import Options
 from .solver.driver import SolverConfig
 
 
@@ -39,7 +45,19 @@ class OperatorOptions:
     batch_max_duration: float = 10.0
     spot_to_spot_consolidation: bool = False  # feature gate
     node_repair: bool = False  # feature gate
+    reserved_capacity: bool = False  # feature gate
     solver_config: Optional[SolverConfig] = None
+
+    @classmethod
+    def from_options(cls, opts: "Options") -> "OperatorOptions":
+        """Map parsed CLI/env Options (options.py) onto the operator knobs."""
+        return cls(
+            batch_idle_duration=opts.batch_idle_duration,
+            batch_max_duration=opts.batch_max_duration,
+            spot_to_spot_consolidation=opts.feature_gates.spot_to_spot_consolidation,
+            node_repair=opts.feature_gates.node_repair,
+            reserved_capacity=opts.feature_gates.reserved_capacity,
+        )
 
 
 class Operator:
@@ -64,6 +82,7 @@ class Operator:
             solver_config=self.options.solver_config,
             batch_idle_duration=self.options.batch_idle_duration,
             batch_max_duration=self.options.batch_max_duration,
+            reserved_capacity_enabled=self.options.reserved_capacity,
         )
         self.lifecycle = LifecycleController(client, cloud_provider, self.recorder)
         self.termination = TerminationController(client, cloud_provider, self.recorder)
@@ -85,6 +104,9 @@ class Operator:
         self.health = HealthController(client, cloud_provider, self.cluster)
         self.consistency = ConsistencyController(client, self.recorder)
         self.nodepool_status = NodePoolStatusController(client, self.cluster)
+        self.node_metrics = NodeMetricsController(client, self.cluster)
+        self.nodepool_metrics = NodePoolMetricsController(client)
+        self.pod_metrics = PodMetricsController(client, self.cluster)
 
     def step(self, force_provision: bool = False, force_disruption: bool = False) -> None:
         """One reconcile pass over the roster."""
@@ -101,6 +123,9 @@ class Operator:
             self.health.reconcile_all()
         self.consistency.reconcile_all()
         self.disruption.reconcile(force=force_disruption)
+        self.node_metrics.reconcile_all()
+        self.nodepool_metrics.reconcile_all()
+        self.pod_metrics.reconcile_all()
 
     def run(self, duration: float, tick: float = 1.0) -> None:
         """Advance simulated time, stepping each tick (TestClock only)."""

@@ -1,0 +1,170 @@
+"""Per-object metrics controller tests (metrics/{node,nodepool,pod} shape)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import Node, NodeClaim, NodePool
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.metrics_controllers import (
+    CLUSTER_STATE_NODE_COUNT,
+    CLUSTER_STATE_SYNCED,
+    NODE_ALLOCATABLE,
+    NODE_TOTAL_POD_REQUESTS,
+    NODE_UTILIZATION,
+    NODEPOOL_LIMIT,
+    NODEPOOL_USAGE,
+    POD_BOUND_DURATION,
+    POD_PROV_BOUND_DURATION,
+    POD_SCHEDULING_UNDECIDED_TIME,
+    POD_STARTUP_DURATION,
+    POD_STATE,
+    POD_UNBOUND_TIME,
+)
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.sim import Binder
+
+from helpers import make_nodepool, make_pod, make_pods
+
+
+@pytest.fixture
+def env():
+    clock = TestClock()
+    client = Client(clock)
+    provider = KwokCloudProvider(client, corpus.generate(20))
+    operator = Operator(client, provider)
+    binder = Binder(client)
+    return clock, client, provider, operator, binder
+
+
+def provision_cycle(env, n_steps=6):
+    clock, client, provider, operator, binder = env
+    for _ in range(n_steps):
+        operator.step(force_provision=True)
+        binder.bind_all()
+        clock.step(1)
+
+
+def _series(gauge, **labels):
+    """All collected series whose labels include the given subset."""
+    want = set(labels.items())
+    return [
+        (lbls, v)
+        for kind, name, lbls, v in gauge.collect()
+        if want.issubset(set(lbls.items()))
+    ]
+
+
+class TestNodeMetrics:
+    def test_allocatable_and_requests_published(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        for p in make_pods(3, cpu="1", memory="2Gi"):
+            client.create(p)
+        provision_cycle(env)
+        node = client.list(Node)[0]
+        alloc = _series(NODE_ALLOCATABLE, node_name=node.name, resource_type="cpu")
+        assert len(alloc) == 1
+        assert alloc[0][1] > 0
+        reqs = _series(
+            NODE_TOTAL_POD_REQUESTS, node_name=node.name, resource_type="cpu")
+        assert len(reqs) == 1
+        assert reqs[0][1] == pytest.approx(3.0)  # 3 pods x 1 cpu
+
+    def test_utilization_percent(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod(cpu="1"))
+        provision_cycle(env)
+        node = client.list(Node)[0]
+        util = _series(NODE_UTILIZATION, node_name=node.name, resource_type="cpu")
+        assert len(util) == 1
+        cpu_alloc = _series(
+            NODE_ALLOCATABLE, node_name=node.name, resource_type="cpu")[0][1]
+        assert util[0][1] == pytest.approx(100.0 / cpu_alloc)
+
+    def test_series_dropped_after_node_deleted(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        provision_cycle(env)
+        node = client.list(Node)[0]
+        assert _series(NODE_ALLOCATABLE, node_name=node.name)
+        for claim in client.list(NodeClaim):
+            client.delete(claim)
+        provision_cycle(env)
+        assert not _series(NODE_ALLOCATABLE, node_name=node.name)
+
+    def test_cluster_state_gauges(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        provision_cycle(env)
+        assert CLUSTER_STATE_NODE_COUNT.value() == 1.0
+        assert CLUSTER_STATE_SYNCED.value() == 1.0
+
+
+class TestNodePoolMetrics:
+    def test_limit_and_usage(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool(name="limited", limits={"cpu": "100"}))
+        client.create(make_pod())
+        provision_cycle(env)
+        lim = _series(NODEPOOL_LIMIT, nodepool="limited", resource_type="cpu")
+        assert lim and lim[0][1] == pytest.approx(100.0)
+        usage = _series(NODEPOOL_USAGE, nodepool="limited", resource_type="cpu")
+        assert usage and usage[0][1] > 0
+
+
+class TestPodMetrics:
+    def test_pod_state_phase(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        pod = make_pod()
+        client.create(pod)
+        operator.step(force_provision=True)
+        states = _series(POD_STATE, name=pod.name)
+        assert states and states[0][0]["phase"] == "Pending"
+        provision_cycle(env)
+        pod.status.phase = "Running"
+        client.update(pod)
+        operator.step()
+        states = _series(POD_STATE, name=pod.name)
+        assert states and states[0][0]["phase"] == "Running"
+
+    def test_bound_and_startup_durations_observed(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        pod = make_pod()
+        client.create(pod)
+        before_bound = POD_BOUND_DURATION.count()
+        before_start = POD_STARTUP_DURATION.count()
+        provision_cycle(env)
+        assert POD_BOUND_DURATION.count() == before_bound + 1
+        pod.status.phase = "Running"
+        client.update(pod)
+        operator.step()
+        assert POD_STARTUP_DURATION.count() == before_start + 1
+
+    def test_unbound_time_while_pending(self, env):
+        clock, client, provider, operator, binder = env
+        # no nodepool: the pod can never schedule
+        pod = make_pod()
+        client.create(pod)
+        clock.step(5)
+        operator.step(force_provision=True)
+        unbound = _series(POD_UNBOUND_TIME, name=pod.name)
+        assert unbound and unbound[0][1] >= 5.0
+
+    def test_provisioning_latency_series(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        pod = make_pod()
+        before = POD_PROV_BOUND_DURATION.count()
+        client.create(pod)  # watch event ACKs the pod
+        assert operator.cluster.pod_ack_time(pod.uid) is not None
+        provision_cycle(env)
+        assert POD_PROV_BOUND_DURATION.count() == before + 1
+        # decision recorded -> undecided gauge has no series for this pod
+        assert operator.cluster.pod_scheduling_success_time(pod.uid) is not None
+        assert not _series(POD_SCHEDULING_UNDECIDED_TIME, name=pod.name)

@@ -1,0 +1,134 @@
+"""Options/flag-system tests (pkg/operator/options/options_test.go shape)."""
+
+import pytest
+
+from karpenter_tpu.options import (
+    FeatureGates,
+    Options,
+    parse_duration,
+    parse_options,
+)
+from karpenter_tpu.operator import OperatorOptions
+
+
+class TestParseDuration:
+    def test_simple(self):
+        assert parse_duration("10s") == 10.0
+        assert parse_duration("1s") == 1.0
+        assert parse_duration("100ms") == pytest.approx(0.1)
+
+    def test_compound(self):
+        assert parse_duration("1m30s") == 90.0
+        assert parse_duration("1h1m1s") == 3661.0
+
+    def test_fractional(self):
+        assert parse_duration("1.5s") == 1.5
+
+    def test_negative(self):
+        assert parse_duration("-10s") == -10.0
+
+    def test_invalid(self):
+        for bad in ("", "10", "abc", "10x", "s10"):
+            with pytest.raises(ValueError):
+                parse_duration(bad)
+
+
+class TestFeatureGates:
+    def test_defaults_false(self):
+        g = FeatureGates.parse("")
+        assert not g.node_repair
+        assert not g.reserved_capacity
+        assert not g.spot_to_spot_consolidation
+
+    def test_parse_all(self):
+        g = FeatureGates.parse(
+            "NodeRepair=true,ReservedCapacity=true,SpotToSpotConsolidation=true"
+        )
+        assert g.node_repair and g.reserved_capacity and g.spot_to_spot_consolidation
+
+    def test_partial(self):
+        g = FeatureGates.parse("SpotToSpotConsolidation=true")
+        assert g.spot_to_spot_consolidation
+        assert not g.node_repair
+
+    def test_unknown_gate_tolerated(self):
+        g = FeatureGates.parse("FutureGate=true,NodeRepair=true")
+        assert g.node_repair
+
+    def test_malformed(self):
+        with pytest.raises(ValueError):
+            FeatureGates.parse("NodeRepair")
+        with pytest.raises(ValueError):
+            FeatureGates.parse("NodeRepair=yes")
+
+
+class TestOptions:
+    def test_defaults(self):
+        o = parse_options([])
+        assert o.metrics_port == 8080
+        assert o.health_probe_port == 8081
+        assert o.kube_client_qps == 200
+        assert o.kube_client_burst == 300
+        assert o.batch_max_duration == 10.0
+        assert o.batch_idle_duration == 1.0
+        assert o.log_level == "info"
+        assert not o.feature_gates.node_repair
+
+    def test_flags_override(self):
+        o = parse_options(
+            [
+                "--metrics-port", "9999",
+                "--batch-max-duration", "30s",
+                "--batch-idle-duration", "500ms",
+                "--feature-gates", "NodeRepair=true",
+                "--log-level", "debug",
+            ]
+        )
+        assert o.metrics_port == 9999
+        assert o.batch_max_duration == 30.0
+        assert o.batch_idle_duration == pytest.approx(0.5)
+        assert o.feature_gates.node_repair
+        assert o.log_level == "debug"
+
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("METRICS_PORT", "7070")
+        monkeypatch.setenv("BATCH_MAX_DURATION", "20s")
+        monkeypatch.setenv("FEATURE_GATES", "SpotToSpotConsolidation=true")
+        o = parse_options([])
+        assert o.metrics_port == 7070
+        assert o.batch_max_duration == 20.0
+        assert o.feature_gates.spot_to_spot_consolidation
+
+    def test_flag_beats_env(self, monkeypatch):
+        monkeypatch.setenv("METRICS_PORT", "7070")
+        o = parse_options(["--metrics-port", "6060"])
+        assert o.metrics_port == 6060
+
+    def test_invalid_log_level(self):
+        with pytest.raises(ValueError):
+            parse_options(["--log-level", "verbose"])
+
+    def test_enable_profiling_bool_env(self, monkeypatch):
+        monkeypatch.setenv("ENABLE_PROFILING", "true")
+        assert parse_options([]).enable_profiling
+        monkeypatch.setenv("ENABLE_PROFILING", "maybe")
+        with pytest.raises(ValueError):
+            parse_options([])
+
+
+class TestOperatorOptionsBridge:
+    def test_from_options(self):
+        o = parse_options(
+            [
+                "--batch-max-duration", "5s",
+                "--batch-idle-duration", "2s",
+                "--feature-gates",
+                "NodeRepair=true,ReservedCapacity=true,SpotToSpotConsolidation=true",
+            ]
+        )
+        oo = OperatorOptions.from_options(o)
+        assert oo.batch_max_duration == 5.0
+        assert oo.batch_idle_duration == 2.0
+        assert oo.node_repair
+        assert oo.reserved_capacity
+        assert oo.spot_to_spot_consolidation

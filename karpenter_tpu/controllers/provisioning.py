@@ -127,6 +127,9 @@ class Provisioner:
         pods += self.get_deleting_node_pods()
         if not pods:
             return None
+        # ACK the whole batch: covers pods that were already pending before
+        # this Provisioner was constructed (no watch replay on restart)
+        self.cluster.ack_pods(*(p.uid for p in pods))
         results = self.schedule(pods)
         scheduled_uids = [
             p.uid for p in pods if p.uid not in results.pod_errors

@@ -112,7 +112,9 @@ class FeatureGates:
 
 @dataclass
 class Options:
-    """All operator knobs (options.go:50-67), env-fallback defaults applied lazily."""
+    """All operator knobs (options.go:50-67). These dataclass defaults are
+    the single source of truth; build_parser() reads them, then env vars
+    override defaults and explicit flags override env vars."""
 
     service_name: str = ""
     metrics_port: int = 8080
@@ -145,53 +147,61 @@ class Options:
 
 
 def build_parser() -> argparse.ArgumentParser:
-    """Flag set with env fallback for every flag (FlagSet, options.go:69-103)."""
+    """Flag set with env fallback for every flag (FlagSet, options.go:69-103).
+    Defaults come from the Options dataclass so they are defined once."""
+    d = Options()
     p = argparse.ArgumentParser(prog="karpenter-tpu", add_help=True)
     p.add_argument("--karpenter-service", dest="service_name",
-                   default=_env_str("KARPENTER_SERVICE", ""))
+                   default=_env_str("KARPENTER_SERVICE", d.service_name))
     p.add_argument("--metrics-port", dest="metrics_port", type=int,
-                   default=_env_int("METRICS_PORT", 8080))
+                   default=_env_int("METRICS_PORT", d.metrics_port))
     p.add_argument("--health-probe-port", dest="health_probe_port", type=int,
-                   default=_env_int("HEALTH_PROBE_PORT", 8081))
+                   default=_env_int("HEALTH_PROBE_PORT", d.health_probe_port))
     p.add_argument("--kube-client-qps", dest="kube_client_qps", type=int,
-                   default=_env_int("KUBE_CLIENT_QPS", 200))
+                   default=_env_int("KUBE_CLIENT_QPS", d.kube_client_qps))
     p.add_argument("--kube-client-burst", dest="kube_client_burst", type=int,
-                   default=_env_int("KUBE_CLIENT_BURST", 300))
+                   default=_env_int("KUBE_CLIENT_BURST", d.kube_client_burst))
     p.add_argument("--enable-profiling", dest="enable_profiling",
                    choices=("true", "false"),
-                   default=str(_env_bool("ENABLE_PROFILING", False)).lower())
+                   default=str(_env_bool("ENABLE_PROFILING", d.enable_profiling)).lower())
     p.add_argument("--disable-leader-election", dest="disable_leader_election",
                    choices=("true", "false"),
-                   default=str(_env_bool("DISABLE_LEADER_ELECTION", False)).lower())
+                   default=str(_env_bool(
+                       "DISABLE_LEADER_ELECTION", d.disable_leader_election)).lower())
     p.add_argument("--leader-election-name", dest="leader_election_name",
-                   default=_env_str("LEADER_ELECTION_NAME", "karpenter-leader-election"))
+                   default=_env_str("LEADER_ELECTION_NAME", d.leader_election_name))
     p.add_argument("--leader-election-namespace", dest="leader_election_namespace",
-                   default=_env_str("LEADER_ELECTION_NAMESPACE", ""))
+                   default=_env_str(
+                       "LEADER_ELECTION_NAMESPACE", d.leader_election_namespace))
     p.add_argument("--memory-limit", dest="memory_limit", type=int,
-                   default=_env_int("MEMORY_LIMIT", -1))
+                   default=_env_int("MEMORY_LIMIT", d.memory_limit))
     p.add_argument("--log-level", dest="log_level",
-                   default=_env_str("LOG_LEVEL", "info"))
+                   default=_env_str("LOG_LEVEL", d.log_level))
     p.add_argument("--log-output-paths", dest="log_output_paths",
-                   default=_env_str("LOG_OUTPUT_PATHS", "stdout"))
+                   default=_env_str("LOG_OUTPUT_PATHS", d.log_output_paths))
     p.add_argument("--log-error-output-paths", dest="log_error_output_paths",
-                   default=_env_str("LOG_ERROR_OUTPUT_PATHS", "stderr"))
+                   default=_env_str("LOG_ERROR_OUTPUT_PATHS", d.log_error_output_paths))
     p.add_argument("--batch-max-duration", dest="batch_max_duration",
-                   default=os.environ.get("BATCH_MAX_DURATION", "10s"))
+                   default=os.environ.get(
+                       "BATCH_MAX_DURATION", f"{d.batch_max_duration}s"))
     p.add_argument("--batch-idle-duration", dest="batch_idle_duration",
-                   default=os.environ.get("BATCH_IDLE_DURATION", "1s"))
+                   default=os.environ.get(
+                       "BATCH_IDLE_DURATION", f"{d.batch_idle_duration}s"))
     p.add_argument("--feature-gates", dest="feature_gates",
                    default=_env_str(
                        "FEATURE_GATES",
                        "NodeRepair=false,ReservedCapacity=false,SpotToSpotConsolidation=false",
                    ))
     p.add_argument("--instance-types-file-path", dest="instance_types_file_path",
-                   default=_env_str("INSTANCE_TYPES_FILE_PATH", ""))
+                   default=_env_str(
+                       "INSTANCE_TYPES_FILE_PATH", d.instance_types_file_path))
     return p
 
 
 def parse_options(argv: Optional[List[str]] = None) -> Options:
-    """Parse argv (default: no args → env/defaults only) into validated Options."""
-    ns = build_parser().parse_args(argv if argv is not None else [])
+    """Parse argv into validated Options; None means sys.argv[1:] (standard
+    argparse convention)."""
+    ns = build_parser().parse_args(argv)
     opts = Options(
         service_name=ns.service_name,
         metrics_port=ns.metrics_port,

@@ -1,0 +1,168 @@
+"""Solver sidecar: gRPC service exposing batch Solve over the wire codec.
+
+The TPU-native deployment splits the control plane from the solver: the
+controller process (Go-shaped, level-triggered) ships snapshots over DCN to
+this sidecar, which runs the fused feasibility/packing kernels on its local
+TPU slice and returns packed claims (SURVEY.md §5, BASELINE.json
+north-star). In-process callers keep using TpuSolver directly; RemoteSolver
+is the same seam behind a channel.
+
+The service is defined with grpc generic handlers over the msgpack codec in
+wire.py — no generated stubs, one method:
+
+    /karpenter_tpu.solver.v1.Solver/Solve   (unary-unary, bytes in/out)
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from ..api.objects import NodePool, Pod
+from ..cloudprovider import types as cp
+from ..kube import Client, TestClock
+from ..scheduling.scheduler import Results
+from ..scheduling.topology import Topology
+from . import wire
+from .driver import DecodedClaim, SolverConfig, TpuSolver
+
+SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
+SOLVE_METHOD = f"/{SERVICE_NAME}/Solve"
+
+
+def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
+    snap = wire.decode_solve_request(data)
+    pods: List[Pod] = snap["pods"]
+    node_pools: List[NodePool] = snap["node_pools"]
+    instance_types = snap["instance_types"]
+    daemonset_pods = snap["daemonset_pods"]
+    # the sidecar solves against an empty cluster view: existing-node
+    # placement stays with the controller, which holds the live state cache
+    scratch = Client(TestClock())
+    topology = Topology(scratch, [], node_pools, instance_types, pods)
+    solver = TpuSolver(
+        node_pools,
+        instance_types,
+        topology,
+        state_nodes=[],
+        daemonset_pods=daemonset_pods,
+        config=config,
+        # behavior knobs travel in the snapshot so controller and sidecar
+        # can never disagree on gate-dependent packing
+        reserved_capacity_enabled=bool(
+            snap["solver_options"].get("reserved_capacity_enabled", False)
+        ),
+    )
+    results = solver.solve(pods)
+    return wire.encode_solve_response(results)
+
+
+class SolverService(grpc.GenericRpcHandler):
+    """Generic unary handler for the Solve method."""
+
+    def __init__(self, config: Optional[SolverConfig] = None):
+        self.config = config
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != SOLVE_METHOD:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            lambda request, context: _solve_snapshot(request, self.config),
+            request_deserializer=None,  # raw bytes
+            response_serializer=None,
+        )
+
+
+def serve(
+    address: str = "127.0.0.1:0",
+    config: Optional[SolverConfig] = None,
+    max_workers: int = 4,
+) -> "grpc.Server":
+    """Start a solver sidecar; returns the started server. The bound port is
+    available via server._bound_port (set here) when address ends in :0."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((SolverService(config),))
+    port = server.add_insecure_port(address)
+    server._bound_port = port
+    server.start()
+    return server
+
+
+class RemoteSolver:
+    """Client-side seam: same solve(pods) contract as TpuSolver, but the
+    packing runs in the sidecar. Claims come back as instance-type names and
+    pod uids and are reassembled against the local objects."""
+
+    def __init__(
+        self,
+        target: str,
+        node_pools: Sequence[NodePool],
+        instance_types: Dict[str, List[cp.InstanceType]],
+        daemonset_pods: Sequence[Pod] = (),
+        channel: Optional["grpc.Channel"] = None,
+        timeout: float = 30.0,
+        reserved_capacity_enabled: bool = False,
+    ):
+        self._channel = channel or grpc.insecure_channel(target)
+        self._solve = self._channel.unary_unary(SOLVE_METHOD)
+        self.timeout = timeout
+        self.reserved_capacity_enabled = reserved_capacity_enabled
+        self.node_pools = list(node_pools)
+        self.instance_types = instance_types
+        self.daemonset_pods = list(daemonset_pods)
+        self._pools_by_name = {np_.name: np_ for np_ in self.node_pools}
+        self._types_by_pool = {
+            pool: {it.name: it for it in its}
+            for pool, its in instance_types.items()
+        }
+
+    def solve(self, pods: Sequence[Pod]) -> Results:
+        from ..scheduling.template import NodeClaimTemplate
+
+        request = wire.encode_solve_request(
+            pods,
+            self.node_pools,
+            self.instance_types,
+            self.daemonset_pods,
+            solver_options={
+                "reserved_capacity_enabled": self.reserved_capacity_enabled
+            },
+        )
+        response = wire.decode_solve_response(
+            self._solve(request, timeout=self.timeout)
+        )
+        pods_by_uid = {p.uid: p for p in pods}
+        claims: List[DecodedClaim] = []
+        for c in response["claims"]:
+            pool = self._pools_by_name[c["pool"]]
+            by_name = self._types_by_pool.get(c["pool"], {})
+            missing = [n for n in c["instance_types"] if n not in by_name]
+            if missing:
+                # catalog skew between controller and sidecar must be loud:
+                # a claim without options would persist unlaunchable
+                raise RuntimeError(
+                    f"solver returned unknown instance types for pool "
+                    f"{c['pool']!r}: {missing[:5]} — controller/sidecar "
+                    "instance-type catalogs are out of sync"
+                )
+            claims.append(
+                DecodedClaim(
+                    template=NodeClaimTemplate(pool),
+                    pods=[pods_by_uid[uid] for uid in c["pod_uids"]],
+                    instance_type_options=[by_name[n] for n in c["instance_types"]],
+                    requirements=c["requirements"],
+                )
+            )
+        return Results(
+            new_node_claims=claims,
+            existing_nodes=[],
+            pod_errors=dict(response["pod_errors"]),
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+__all__ = ["SOLVE_METHOD", "SolverService", "serve", "RemoteSolver"]

@@ -1,0 +1,205 @@
+"""Wire codec for the solver service boundary.
+
+The reference has no RPC seam — its scheduler is in-process — but the
+TPU-native design places the batch solver in a sidecar reached over the
+datacenter network (SURVEY.md §5 "Distributed communication backend"): the
+controller ships a pod/instance-type snapshot, the sidecar returns packed
+NodeClaims. This module is the snapshot codec: a tagged, msgpack-encoded
+tree over the API dataclasses, plus explicit codecs for the slotted
+Requirement/Requirements set-algebra types.
+
+Objects are serialized structurally ("__t" type tags), so the format is
+self-describing and language-neutral (any peer that can emit the same tags
+can drive the solver).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional
+
+import msgpack
+
+from ..api import objects as obj
+from ..api import resources as res
+from ..api.requirements import Requirement, Requirements
+from ..cloudprovider import types as cp
+
+# Every dataclass that may appear in a snapshot. Reconstruction looks the
+# class up by tag and calls it with decoded fields.
+_CLASSES = {
+    cls.__name__: cls
+    for cls in (
+        obj.ObjectMeta,
+        obj.Taint,
+        obj.Toleration,
+        obj.NodeSelectorRequirement,
+        obj.PreferredSchedulingTerm,
+        obj.NodeAffinity,
+        obj.LabelSelector,
+        obj.LabelSelectorRequirement,
+        obj.PodAffinityTerm,
+        obj.WeightedPodAffinityTerm,
+        obj.TopologySpreadConstraint,
+        obj.HostPort,
+        obj.PersistentVolumeClaimRef,
+        obj.PodSpec,
+        obj.PodCondition,
+        obj.PodStatus,
+        obj.Pod,
+        obj.NodeClassRef,
+        obj.NodeClaimSpec,
+        obj.NodeClaimTemplate,
+        obj.Budget,
+        obj.Disruption,
+        obj.NodePoolSpec,
+        obj.NodePoolStatus,
+        obj.NodePool,
+        obj.DaemonSet,
+        cp.Offering,
+        cp.InstanceTypeOverhead,
+        cp.InstanceType,
+    )
+}
+
+
+def to_wire(value: Any) -> Any:
+    """Recursively convert an API object tree to msgpack-able primitives."""
+    if value is None or isinstance(value, (bool, int, float, str, bytes)):
+        return value
+    if isinstance(value, enum.Enum):
+        return value.value
+    if isinstance(value, Requirement):
+        return {
+            "__t": "Requirement",
+            "key": value.key,
+            "complement": value.complement,
+            "values": sorted(value.values),
+            "greater_than": value.greater_than,
+            "less_than": value.less_than,
+            "min_values": value.min_values,
+        }
+    if isinstance(value, Requirements):
+        return {"__t": "Requirements", "items": [to_wire(r) for r in value]}
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out: Dict[str, Any] = {"__t": type(value).__name__}
+        for f in dataclasses.fields(value):
+            if f.name.startswith("_"):
+                continue  # memoized/private fields are rebuilt on the far side
+            out[f.name] = to_wire(getattr(value, f.name))
+        return out
+    if isinstance(value, dict):
+        return {k: to_wire(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [to_wire(v) for v in value]
+    raise TypeError(f"cannot serialize {type(value).__name__} for the wire")
+
+
+def from_wire(value: Any) -> Any:
+    """Inverse of to_wire."""
+    if isinstance(value, dict):
+        tag = value.get("__t")
+        if tag == "Requirement":
+            return Requirement._raw(
+                key=value["key"],
+                complement=value["complement"],
+                values=set(value["values"]),
+                greater_than=value["greater_than"],
+                less_than=value["less_than"],
+                min_values=value["min_values"],
+            )
+        if tag == "Requirements":
+            return Requirements(*(from_wire(r) for r in value["items"]))
+        if tag is not None:
+            cls = _CLASSES.get(tag)
+            if cls is None:
+                raise TypeError(f"unknown wire tag {tag!r}")
+            fields = {
+                k: from_wire(v) for k, v in value.items() if k != "__t"
+            }
+            return cls(**fields)
+        return {k: from_wire(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [from_wire(v) for v in value]
+    return value
+
+
+# -- snapshot / result envelopes -------------------------------------------
+
+
+def encode_solve_request(
+    pods,
+    node_pools,
+    instance_types: Dict[str, List[cp.InstanceType]],
+    daemonset_pods=(),
+    solver_options: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """solver_options carries behavior knobs (feature gates) that must match
+    between controller and sidecar — e.g. reserved_capacity_enabled."""
+    return msgpack.packb(
+        {
+            "pods": [to_wire(p) for p in pods],
+            "node_pools": [to_wire(np_) for np_ in node_pools],
+            "instance_types": {
+                pool: [to_wire(it) for it in its]
+                for pool, its in instance_types.items()
+            },
+            "daemonset_pods": [to_wire(p) for p in daemonset_pods],
+            "solver_options": dict(solver_options or {}),
+        },
+        use_bin_type=True,
+    )
+
+
+def decode_solve_request(data: bytes) -> Dict[str, Any]:
+    raw = msgpack.unpackb(data, raw=False)
+    return {
+        "pods": [from_wire(p) for p in raw["pods"]],
+        "node_pools": [from_wire(np_) for np_ in raw["node_pools"]],
+        "instance_types": {
+            pool: [from_wire(it) for it in its]
+            for pool, its in raw["instance_types"].items()
+        },
+        "daemonset_pods": [from_wire(p) for p in raw.get("daemonset_pods", [])],
+        "solver_options": raw.get("solver_options", {}),
+    }
+
+
+def encode_solve_response(results) -> bytes:
+    """Results → wire. Claims reference instance types by name and pods by
+    uid; the caller reassembles against its own objects."""
+    claims = []
+    for claim in results.new_node_claims:
+        claims.append(
+            {
+                "pool": claim.template.node_pool_name,
+                "instance_types": [it.name for it in claim.instance_type_options],
+                "pod_uids": [p.uid for p in claim.pods],
+                "requirements": to_wire(claim.requirements),
+            }
+        )
+    return msgpack.packb(
+        {
+            "claims": claims,
+            "pod_errors": {uid: str(err) for uid, err in results.pod_errors.items()},
+        },
+        use_bin_type=True,
+    )
+
+
+def decode_solve_response(data: bytes) -> Dict[str, Any]:
+    raw = msgpack.unpackb(data, raw=False)
+    for claim in raw["claims"]:
+        claim["requirements"] = from_wire(claim["requirements"])
+    return raw
+
+
+__all__ = [
+    "to_wire",
+    "from_wire",
+    "encode_solve_request",
+    "decode_solve_request",
+    "encode_solve_response",
+    "decode_solve_response",
+]

@@ -156,6 +156,16 @@ class TestPodMetrics:
         unbound = _series(POD_UNBOUND_TIME, name=pod.name)
         assert unbound and unbound[0][1] >= 5.0
 
+    def test_pods_pending_before_restart_are_acked(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        pod = make_pod()
+        client.create(pod)
+        # a fresh operator (restart) never saw the pod's watch event
+        operator2 = Operator(client, provider)
+        operator2.step(force_provision=True)
+        assert operator2.cluster.pod_ack_time(pod.uid) is not None
+
     def test_provisioning_latency_series(self, env):
         clock, client, provider, operator, binder = env
         client.create(make_nodepool())

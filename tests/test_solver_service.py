@@ -1,0 +1,147 @@
+"""Solver service seam tests: wire codec round-trip + gRPC solve parity."""
+
+import pytest
+
+from karpenter_tpu.api import resources as res
+from karpenter_tpu.api.objects import NodeSelectorRequirement, Toleration
+from karpenter_tpu.api.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import wire
+from karpenter_tpu.solver.service import RemoteSolver, serve
+
+from helpers import make_nodepool, make_pod, make_pods, spread_constraint
+
+
+class TestWireCodec:
+    def test_pod_round_trip(self):
+        pod = make_pod(
+            cpu="2", memory="4Gi",
+            labels={"app": "web"},
+            node_selector={"zone": "a"},
+            tolerations=[Toleration(key="gpu", operator="Exists")],
+            spread=[spread_constraint("topology.kubernetes.io/zone",
+                                      labels={"app": "web"})],
+        )
+        back = wire.from_wire(wire.to_wire(pod))
+        assert back.uid == pod.uid
+        assert back.spec.requests == pod.spec.requests
+        assert back.spec.node_selector == pod.spec.node_selector
+        assert back.spec.tolerations[0].key == "gpu"
+        assert back.spec.topology_spread_constraints[0].topology_key == (
+            "topology.kubernetes.io/zone")
+        assert back.metadata.labels == {"app": "web"}
+
+    def test_requirement_round_trip(self):
+        for r in (
+            Requirement("k", Operator.IN, ["a", "b"]),
+            Requirement("k", Operator.NOT_IN, ["c"]),
+            Requirement("k", Operator.EXISTS),
+            Requirement("k", Operator.DOES_NOT_EXIST),
+            Requirement("k", Operator.GT, ["5"]),
+            Requirement("k", Operator.IN, ["a", "b", "c"], min_values=2),
+        ):
+            back = wire.from_wire(wire.to_wire(r))
+            assert back == r, r
+
+    def test_requirements_round_trip(self):
+        reqs = Requirements(
+            Requirement("a", Operator.IN, ["x"]),
+            Requirement("b", Operator.NOT_IN, ["y"]),
+        )
+        back = wire.from_wire(wire.to_wire(reqs))
+        assert back == reqs
+
+    def test_nodepool_round_trip(self):
+        pool = make_nodepool(
+            name="p", weight=7, limits={"cpu": "100"},
+            requirements=[NodeSelectorRequirement(
+                "karpenter.sh/capacity-type", "In", ["on-demand"])],
+        )
+        back = wire.from_wire(wire.to_wire(pool))
+        assert back.name == "p"
+        assert back.spec.weight == 7
+        assert back.spec.limits == {"cpu": res.parse_quantity("100")}
+        assert back.spec.template.spec.requirements[0].values == ["on-demand"]
+
+    def test_instance_type_round_trip(self):
+        it = corpus.generate(3)[0]
+        back = wire.from_wire(wire.to_wire(it))
+        assert back.name == it.name
+        assert back.capacity == it.capacity
+        assert back.requirements == it.requirements
+        assert len(back.offerings) == len(it.offerings)
+        assert back.offerings[0].price == it.offerings[0].price
+        assert back.allocatable() == it.allocatable()
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    server = serve("127.0.0.1:0")
+    yield f"127.0.0.1:{server._bound_port}"
+    server.stop(0)
+
+
+class TestSolverService:
+    def _local_results(self, pods, pools, types):
+        client = Client(TestClock())
+        topology = Topology(client, [], pools, types, pods)
+        return Scheduler(pools, types, topology).solve(pods)
+
+    def test_remote_matches_local(self, sidecar):
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(12)}
+        pods = make_pods(20, cpu="1", memory="2Gi")
+        remote = RemoteSolver(sidecar, pools, types)
+        got = remote.solve(pods)
+        want = self._local_results(pods, pools, types)
+        assert not got.pod_errors
+        assert len(got.new_node_claims) == len(want.new_node_claims)
+        got_counts = sorted(len(c.pods) for c in got.new_node_claims)
+        want_counts = sorted(len(c.pods) for c in want.new_node_claims)
+        assert got_counts == want_counts
+        remote.close()
+
+    def test_remote_claims_reference_local_objects(self, sidecar):
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(8)}
+        pods = make_pods(5)
+        remote = RemoteSolver(sidecar, pools, types)
+        results = remote.solve(pods)
+        local_types = set(map(id, types["default"]))
+        for claim in results.new_node_claims:
+            for it in claim.instance_type_options:
+                assert id(it) in local_types  # reassembled, not copies
+            for p in claim.pods:
+                assert p in pods
+        remote.close()
+
+    def test_unschedulable_pod_error_travels(self, sidecar):
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(4)}
+        giant = make_pod(cpu="10000")
+        remote = RemoteSolver(sidecar, pools, types)
+        results = remote.solve([giant])
+        assert giant.uid in results.pod_errors
+        assert not results.new_node_claims
+        remote.close()
+
+    def test_constrained_pods(self, sidecar):
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(12)}
+        pods = [
+            make_pod(
+                requirements=[NodeSelectorRequirement(
+                    "topology.kubernetes.io/zone", "In", ["test-zone-a"])],
+            )
+            for _ in range(4)
+        ]
+        remote = RemoteSolver(sidecar, pools, types)
+        results = remote.solve(pods)
+        assert not results.pod_errors
+        for claim in results.new_node_claims:
+            zone_req = claim.requirements.get("topology.kubernetes.io/zone")
+            assert zone_req.has("test-zone-a")
+        remote.close()

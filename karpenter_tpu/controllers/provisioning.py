@@ -26,7 +26,7 @@ from ..scheduling.template import MAX_INSTANCE_TYPES
 from ..scheduling.topology import Topology
 from ..scheduling.volumetopology import VolumeTopology
 from ..scheduling.volumeusage import VolumeResolver
-from ..solver.driver import SolverConfig, TpuSolver
+from ..solver.driver import EncodeCache, SolverConfig, TpuSolver
 from ..utils import pod as pod_utils
 from .state import Cluster
 
@@ -95,6 +95,7 @@ class Provisioner:
         self.recorder = recorder or Recorder(self.clock)
         self.solver_config = solver_config
         self.reserved_capacity_enabled = reserved_capacity_enabled
+        self._encode_cache = EncodeCache()  # survives across schedule() calls
         self.batcher = Batcher(self.clock, batch_idle_duration, batch_max_duration)
         self.volume_topology = VolumeTopology(client)
         self.volume_resolver = VolumeResolver(client)
@@ -206,6 +207,7 @@ class Provisioner:
             state_nodes=state_nodes,
             daemonset_pods=daemonset_pods,
             config=self.solver_config,
+            encode_cache=self._encode_cache,
             volume_resolver=self.volume_resolver,
             reserved_capacity_enabled=self.reserved_capacity_enabled,
         )

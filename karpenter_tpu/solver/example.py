@@ -64,6 +64,6 @@ def example_snapshot_arrays(n_pods: int, n_types: int, shapes: int = 1):
         daemon_overhead=solver.oracle.daemon_overhead,
     )
     a_tzc = solver._offering_availability(snap)
-    nmax = solver._estimate_nmax(snap)
+    nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
     statics = dict(nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
     return snap.solve_args(a_tzc), statics

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ...api import labels as labels_mod
 from ...api import taints as taints_mod
@@ -35,6 +35,7 @@ from .methods import (
     SingleNodeConsolidation,
 )
 from .types import Candidate, Command
+from .validation import VALIDATION_TTL, Validator
 
 POLL_INTERVAL = 10.0  # controller.go:68
 QUEUE_BASE_DELAY = 1.0  # orchestration/queue.go:51-55
@@ -44,6 +45,10 @@ QUEUE_TIMEOUT = 600.0
 DECISIONS = Counter("disruption_decisions_total", "")
 ELIGIBLE_NODES = Gauge("disruption_eligible_nodes", "")
 ALLOWED_DISRUPTIONS = Gauge("disruption_allowed_disruptions", "")
+VALIDATION_FAILURES = Counter(
+    "disruption_validation_failures_total",
+    "Commands abandoned because TTL re-validation found stale state",
+)
 
 
 @dataclass
@@ -157,6 +162,10 @@ class DisruptionController:
         self.ctx = ctx
         self.provisioner = provisioner
         self.queue = OrchestrationQueue(ctx)
+        self.validator = Validator(ctx)
+        # consolidation command awaiting its TTL re-validation
+        # (validation.go:56-215): (command, computed_at)
+        self._pending: Optional[Tuple[Command, float]] = None
         self.methods = [
             Drift(ctx),
             Emptiness(ctx.clock),
@@ -174,6 +183,28 @@ class DisruptionController:
         if not self.ctx.cluster.synced():
             return None
         self._untaint_leftovers()
+        if self._pending is not None:
+            # a consolidation command is waiting out its validation TTL;
+            # the operator loop keeps running meanwhile (the reference
+            # blocks only its disruption goroutine, validation.go:56-83)
+            cmd, computed_at = self._pending
+            if now - computed_at < VALIDATION_TTL:
+                return None
+            self._pending = None
+            stale = self.validator.is_valid(cmd, queue=self.queue)
+            if stale is None:
+                self.execute(cmd)
+                return cmd
+            VALIDATION_FAILURES.inc(labels={"method": cmd.reason})
+            self.ctx.recorder.publish(
+                Event(
+                    cmd.candidates[0].node_claim.uid if cmd.candidates else "",
+                    "Normal",
+                    "DisruptionValidationFailed",
+                    stale,
+                )
+            )
+            # fall through: recompute from fresh state this pass
         for method in self.methods:
             cmd = self._disrupt(method)
             if cmd is not None and cmd.decision != "no-op":
@@ -216,6 +247,11 @@ class DisruptionController:
         if cmd.decision == "no-op":
             if hasattr(method, "mark_consolidated"):
                 method.mark_consolidated()
+            return cmd
+        if method.reason in ("Empty", "Underutilized"):
+            # consolidation acts only after surviving the TTL re-validation
+            # on a later pass (validation.go:56-215); drift skips validation
+            self._pending = (cmd, now)
             return cmd
         self.execute(cmd)
         return cmd

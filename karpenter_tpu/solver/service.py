@@ -136,7 +136,13 @@ class RemoteSolver:
         pods_by_uid = {p.uid: p for p in pods}
         claims: List[DecodedClaim] = []
         for c in response["claims"]:
-            pool = self._pools_by_name[c["pool"]]
+            pool = self._pools_by_name.get(c["pool"])
+            if pool is None:
+                raise RuntimeError(
+                    f"solver returned a claim for unknown nodepool "
+                    f"{c['pool']!r} — controller/sidecar nodepool catalogs "
+                    "are out of sync"
+                )
             by_name = self._types_by_pool.get(c["pool"], {})
             missing = [n for n in c["instance_types"] if n not in by_name]
             if missing:

@@ -219,9 +219,9 @@ class TestEmptinessConsolidation:
         cmd = operator.disruption.reconcile(force=True)
         assert cmd is not None and cmd.decision == "delete"
         assert cmd.reason == "Empty"
-        # queue completes the deletion (no replacements to wait for)
-        operator.disruption.queue.reconcile()
-        for _ in range(4):
+        # the command executes after the 15s validation TTL; the queue then
+        # completes the deletion (no replacements to wait for)
+        for _ in range(30):
             operator.step()
             clock.step(1)
         assert client.list(Node) == []
@@ -321,8 +321,9 @@ class TestMultiNodeConsolidation:
         operator.nodeclaim_disruption.reconcile_all()
         cmd = operator.disruption.reconcile(force=True)
         assert cmd is not None and cmd.decision in ("delete", "replace")
-        # run the world until the command completes and candidates die
-        for _ in range(10):
+        # run the world until the command survives its validation TTL,
+        # executes, and the candidates die
+        for _ in range(20):
             operator.step()
             binder.bind_all()
             clock.step(2)

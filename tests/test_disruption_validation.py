@@ -1,0 +1,157 @@
+"""Disruption validation tests (validation.go:56-215 behaviors)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import Budget, NodeClaim, Node, Pod
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.disruption.helpers import get_candidates
+from karpenter_tpu.controllers.disruption.types import Command
+from karpenter_tpu.controllers.disruption.validation import Validator
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.sim import Binder
+
+from helpers import make_nodepool, make_pod
+
+
+@pytest.fixture
+def env():
+    clock = TestClock()
+    client = Client(clock)
+    provider = KwokCloudProvider(client, corpus.generate(20))
+    operator = Operator(client, provider)
+    binder = Binder(client)
+    return clock, client, provider, operator, binder
+
+
+def provision_cycle(env, n_steps=6):
+    clock, client, provider, operator, binder = env
+    for _ in range(n_steps):
+        operator.step(force_provision=True)
+        binder.bind_all()
+        clock.step(1)
+
+
+def make_empty_node_command(env, budget_nodes=None):
+    """Provision one node, complete its pod, return an Empty command."""
+    clock, client, provider, operator, binder = env
+    pool = make_nodepool()
+    pool.spec.disruption.consolidate_after = 10.0
+    if budget_nodes is not None:
+        pool.spec.disruption.budgets = [Budget(nodes=budget_nodes)]
+    client.create(pool)
+    pod = make_pod()
+    client.create(pod)
+    provision_cycle(env)
+    pod.status.phase = "Succeeded"
+    client.update(pod)
+    clock.step(25)  # past consolidate_after AND the 20s nomination window
+    operator.nodeclaim_disruption.reconcile_all()
+    candidates = get_candidates(
+        client, operator.cluster, provider, clock,
+    )
+    assert candidates
+    return Command(candidates=candidates, reason="Empty"), pod
+
+
+class TestValidator:
+    def test_valid_empty_command(self, env):
+        clock, client, provider, operator, binder = env
+        cmd, _ = make_empty_node_command(env)
+        v = Validator(operator.disruption.ctx)
+        assert v.is_valid(cmd) is None
+
+    def test_stale_when_node_regains_pods(self, env):
+        clock, client, provider, operator, binder = env
+        cmd, _ = make_empty_node_command(env)
+        # a new pod binds to the candidate during the TTL window
+        node = client.list(Node)[0]
+        newcomer = make_pod()
+        newcomer.spec.node_name = node.name
+        client.create(newcomer)
+        v = Validator(operator.disruption.ctx)
+        assert v.is_valid(cmd) is not None
+
+    def test_stale_when_candidate_deleted(self, env):
+        clock, client, provider, operator, binder = env
+        cmd, _ = make_empty_node_command(env)
+        for claim in client.list(NodeClaim):
+            client.delete(claim)
+        for _ in range(4):
+            operator.lifecycle.reconcile_all()
+            operator.termination.reconcile_all()
+            clock.step(1)
+        v = Validator(operator.disruption.ctx)
+        assert v.is_valid(cmd) is not None
+
+    def test_stale_when_budget_tightens(self, env):
+        clock, client, provider, operator, binder = env
+        cmd, _ = make_empty_node_command(env)
+        pool = client.list(type(make_nodepool()))[0]
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        client.update(pool)
+        v = Validator(operator.disruption.ctx)
+        stale = v.is_valid(cmd)
+        assert stale is not None and "budget" in stale
+
+    def test_stale_when_node_nominated(self, env):
+        clock, client, provider, operator, binder = env
+        cmd, _ = make_empty_node_command(env)
+        node = client.list(Node)[0]
+        operator.cluster.nominate_node(node.name, clock.now())
+        v = Validator(operator.disruption.ctx)
+        assert v.is_valid(cmd) is not None
+
+
+class TestValidationDeferred:
+    def _computed_pending(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 10.0
+        client.create(pool)
+        pod = make_pod()
+        client.create(pod)
+        provision_cycle(env)
+        pod.status.phase = "Succeeded"
+        client.update(pod)
+        clock.step(25)
+        operator.nodeclaim_disruption.reconcile_all()
+        cmd = operator.disruption.reconcile(force=True)
+        assert cmd is not None and cmd.decision == "delete"
+        # the command is pending validation, not yet executed
+        assert operator.disruption._pending is not None
+        assert len(client.list(Node)) == 1
+        return cmd
+
+    def test_command_executes_after_ttl(self, env):
+        clock, client, provider, operator, binder = env
+        self._computed_pending(env)
+        clock.step(16)  # past VALIDATION_TTL
+        cmd2 = operator.disruption.reconcile(force=True)
+        assert cmd2 is not None and cmd2.decision == "delete"
+        assert operator.disruption._pending is None
+        for _ in range(5):
+            operator.step()
+            clock.step(1)
+        assert client.list(Node) == []
+
+    def test_nomination_during_ttl_blocks_emptiness(self, env):
+        """State that changes during the TTL window abandons the command."""
+        clock, client, provider, operator, binder = env
+        self._computed_pending(env)
+        node = client.list(Node)[0]
+        operator.cluster.nominate_node(node.name, clock.now())
+        clock.step(16)
+        cmd2 = operator.disruption.reconcile(force=True)
+        # validation failed; nothing executed this pass
+        assert cmd2 is None or cmd2.decision == "no-op"
+        assert len(client.list(Node)) == 1
+
+    def test_not_executed_before_ttl(self, env):
+        clock, client, provider, operator, binder = env
+        self._computed_pending(env)
+        clock.step(5)  # inside the TTL window
+        assert operator.disruption.reconcile(force=True) is None
+        assert operator.disruption._pending is not None
+        assert len(client.list(Node)) == 1

@@ -1,0 +1,225 @@
+"""Deep scheduler scenarios.
+
+Second tier of behavior coverage mirroring the reference's
+scheduling/topology_test.go (minDomains, maxSkew, capacity-type spread,
+combined constraints) and scheduling/instance_selection_test.go (minValues,
+price ordering/truncation, reserved offerings).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.api.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider import types as cp
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.scheduling.topology import Topology
+
+from helpers import make_nodepool, make_pod, make_pods, spread_constraint
+from test_scheduler import solve
+
+
+def zone_counts(results):
+    counts = {}
+    for claim in results.new_node_claims:
+        req = claim.requirements.get(labels.TOPOLOGY_ZONE)
+        zone = req.any() if not req.complement else "?"
+        counts[zone] = counts.get(zone, 0) + len(claim.pods)
+    return counts
+
+
+class TestSpreadDeep:
+    def test_max_skew_two_allows_imbalance(self):
+        # maxSkew=2: counts may differ by up to 2 across zones
+        # (topologygroup.go:205-251)
+        pods = make_pods(
+            8, labels={"app": "x"},
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, max_skew=2,
+                                      labels={"app": "x"})],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 2
+
+    def test_min_domains_unsatisfied_pins_min_to_zero(self):
+        # minDomains=4 but only 3 zones exist: the global min is treated as
+        # 0 (topologygroup.go:270-273), so with maxSkew=1 each zone takes
+        # exactly one pod and the 4th pod cannot land anywhere
+        pods = make_pods(
+            4, labels={"app": "x"},
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1,
+                                      labels={"app": "x"}, min_domains=4)],
+        )
+        results = solve(pods)
+        assert len(results.pod_errors) == 1
+        counts = zone_counts(results)
+        assert sorted(counts.values()) == [1, 1, 1]
+
+    def test_min_domains_satisfied(self):
+        pods = make_pods(
+            3, labels={"app": "x"},
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1,
+                                      labels={"app": "x"}, min_domains=3)],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len(zone_counts(results)) == 3
+
+    def test_capacity_type_spread(self):
+        # spread over karpenter.sh/capacity-type splits spot/on-demand
+        # (well-known domain from offerings)
+        pods = make_pods(
+            4, labels={"app": "x"},
+            spread=[spread_constraint(labels.CAPACITY_TYPE_LABEL_KEY,
+                                      max_skew=1, labels={"app": "x"})],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        cts = {}
+        for claim in results.new_node_claims:
+            ct = claim.requirements.get(labels.CAPACITY_TYPE_LABEL_KEY).any()
+            cts[ct] = cts.get(ct, 0) + len(claim.pods)
+        assert max(cts.values()) - min(cts.values()) <= 1
+        assert set(cts) == {"spot", "on-demand"}
+
+    def test_combined_zone_and_hostname_spread(self):
+        pods = make_pods(
+            6, labels={"app": "x"},
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1,
+                                  labels={"app": "x"}),
+                spread_constraint(labels.HOSTNAME, max_skew=1,
+                                  labels={"app": "x"}),
+            ],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        # hostname skew 1 forces one pod per node
+        assert results.node_count() == 6
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_spread_with_zone_restricted_pool(self):
+        # NodePool restricted to 2 zones: spread only counts those domains
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(
+                labels.TOPOLOGY_ZONE, "In", ["test-zone-a", "test-zone-b"])],
+        )
+        pods = make_pods(
+            4, labels={"app": "x"},
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1,
+                                      labels={"app": "x"})],
+        )
+        results = solve(pods, node_pools=[pool])
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert set(counts) <= {"test-zone-a", "test-zone-b"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestInstanceSelectionDeep:
+    def test_min_values_keeps_enough_types(self):
+        # minValues on instance-type requirement: claims must retain >= 3
+        # type options (types.go:186-233 SatisfiesMinValues)
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(
+                labels.INSTANCE_TYPE, "Exists", [], min_values=3)],
+        )
+        results = solve(make_pods(4, cpu="1"), node_pools=[pool])
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            assert len(claim.instance_type_options) >= 3
+
+    def test_min_values_unsatisfiable_fails(self):
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(
+                labels.INSTANCE_TYPE, "Exists", [], min_values=500)],
+        )
+        results = solve(make_pods(2, cpu="1"), node_pools=[pool],
+                        instance_types=corpus.generate(6))
+        assert len(results.pod_errors) == 2
+
+    def test_cheapest_type_first_after_finalize(self):
+        results = solve(make_pods(3, cpu="1"))
+        for claim in results.new_node_claims:
+            claim.finalize()
+            options = claim.instance_type_options
+            prices = [
+                min(o.price for o in it.offerings if o.available)
+                for it in options
+            ]
+            assert prices == sorted(prices)
+
+    def test_unavailable_offerings_excluded(self):
+        its = corpus.generate(6)
+        for it in its:
+            for o in it.offerings:
+                if o.zone() == "test-zone-a":
+                    o.available = False
+        pods = make_pods(
+            2,
+            requirements=[NodeSelectorRequirement(
+                labels.TOPOLOGY_ZONE, "In", ["test-zone-a"])],
+        )
+        results = solve(pods, instance_types=its)
+        assert len(results.pod_errors) == 2
+
+    def test_gt_lt_requirement_bounds(self):
+        # integer Gt/Lt bounds on a custom label (requirement.go:33-84)
+        pool = make_nodepool(labels={"gen": "5"})
+        ok = make_pod(requirements=[
+            NodeSelectorRequirement("gen", "Gt", ["4"]),
+            NodeSelectorRequirement("gen", "Lt", ["6"]),
+        ])
+        bad = make_pod(requirements=[
+            NodeSelectorRequirement("gen", "Gt", ["5"]),
+        ])
+        results = solve([ok, bad], node_pools=[pool])
+        assert ok.uid not in results.pod_errors
+        assert bad.uid in results.pod_errors
+
+
+class TestReservedOfferings:
+    def _reserved_types(self, capacity=2):
+        its = corpus.generate(4)
+        out = []
+        for it in its[:2]:
+            res_req = Requirements(
+                Requirement(labels.CAPACITY_TYPE_LABEL_KEY, Operator.IN,
+                            [labels.CAPACITY_TYPE_RESERVED]),
+                Requirement(labels.TOPOLOGY_ZONE, Operator.IN, ["test-zone-a"]),
+                Requirement(cp.RESERVATION_ID_LABEL, Operator.IN,
+                            [f"res-{it.name}"]),
+            )
+            it.offerings.append(cp.Offering(
+                requirements=res_req, price=0.001, available=True,
+                reservation_capacity=capacity,
+            ))
+            out.append(it)
+        return its
+
+    def test_reserved_capacity_ledger_limits_claims(self):
+        # 2 reserved slots per offering; extra claims fall back to
+        # non-reserved capacity (reservationmanager.go:28-85)
+        its = self._reserved_types(capacity=1)
+        pool = make_nodepool()
+        pods = make_pods(4, cpu="1")
+        client = Client(TestClock())
+        its_by_pool = {pool.name: its}
+        topology = Topology(client, [], [pool], its_by_pool, pods)
+        scheduler = Scheduler(
+            [pool], its_by_pool, topology, reserved_capacity_enabled=True,
+        )
+        results = scheduler.solve(pods)
+        assert results.all_pods_scheduled()
+        reserved_claims = [
+            c for c in results.new_node_claims
+            if c.requirements.has(labels.CAPACITY_TYPE_LABEL_KEY)
+            and c.requirements.get(labels.CAPACITY_TYPE_LABEL_KEY).has(
+                labels.CAPACITY_TYPE_RESERVED)
+        ]
+        # the ledger caps reserved claims at total reservation capacity
+        assert len(reserved_claims) <= 2

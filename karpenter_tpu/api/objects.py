@@ -249,6 +249,7 @@ COND_INSTANCE_TERMINATING = "InstanceTerminating"
 COND_CONSISTENT_STATE_FOUND = "ConsistentStateFound"
 COND_DISRUPTION_REASON = "DisruptionReason"
 COND_READY = "Ready"
+COND_NODE_REGISTRATION_HEALTHY = "NodeRegistrationHealthy"
 
 
 @dataclass

@@ -138,3 +138,109 @@ def generate(
         out.append(make_instance_type(family, cpu, arch, zones=zones, variant=variant))
         i += 1
     return out
+
+
+# -- JSON corpus files ------------------------------------------------------
+#
+# kwok parity: the reference ships a JSON corpus
+# (kwok/cloudprovider/instance_types.json, loaded via
+# --instance-types-file-path, kwok/options/options.go). Our schema is a list
+# of objects:
+#   {"name": ..., "capacity": {"cpu": "4", "memory": "16Gi", ...},
+#    "labels": {label-key: value, ...},          # single-value requirements
+#    "overhead": {"cpu": "100m", ...},           # optional, kube-reserved
+#    "offerings": [{"zone": ..., "capacityType": ..., "price": 0.1,
+#                   "available": true}, ...]}
+
+
+def load_file(path: str) -> List[InstanceType]:
+    """Load an instance-type corpus from a JSON file."""
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    out: List[InstanceType] = []
+    for entry in raw:
+        labels = dict(entry.get("labels", {}))
+        labels.setdefault(labels_mod.INSTANCE_TYPE, entry["name"])
+        offerings = []
+        zones = []
+        capacity_types = []
+        for o in entry.get("offerings", []):
+            zone, ct = o["zone"], o["capacityType"]
+            if zone not in zones:
+                zones.append(zone)
+            if ct not in capacity_types:
+                capacity_types.append(ct)
+            offerings.append(
+                Offering(
+                    requirements=Requirements(
+                        Requirement(
+                            labels_mod.CAPACITY_TYPE_LABEL_KEY, Operator.IN, [ct]
+                        ),
+                        Requirement(labels_mod.TOPOLOGY_ZONE, Operator.IN, [zone]),
+                    ),
+                    price=float(o["price"]),
+                    available=bool(o.get("available", True)),
+                )
+            )
+        reqs = Requirements(
+            *(Requirement(k, Operator.IN, [v]) for k, v in labels.items())
+        )
+        if zones:
+            reqs.add(Requirement(labels_mod.TOPOLOGY_ZONE, Operator.IN, zones))
+        if capacity_types:
+            reqs.add(
+                Requirement(
+                    labels_mod.CAPACITY_TYPE_LABEL_KEY, Operator.IN, capacity_types
+                )
+            )
+        overhead = InstanceTypeOverhead(
+            kube_reserved=res.parse_resource_list(entry.get("overhead", {}))
+        )
+        out.append(
+            InstanceType(
+                name=entry["name"],
+                requirements=reqs,
+                offerings=offerings,
+                capacity=res.parse_resource_list(entry["capacity"]),
+                overhead=overhead,
+            )
+        )
+    return out
+
+
+def dump_file(path: str, instance_types: List[InstanceType]) -> None:
+    """Write a corpus to the JSON schema load_file reads (the gen tool)."""
+    import json
+
+    entries = []
+    for it in instance_types:
+        labels = {}
+        for r in it.requirements:
+            if not r.complement and len(r.values) == 1:
+                labels[r.key] = next(iter(r.values))
+        entries.append(
+            {
+                "name": it.name,
+                "capacity": {
+                    k: res.format_quantity(v) for k, v in it.capacity.items()
+                },
+                "labels": labels,
+                "overhead": {
+                    k: res.format_quantity(v)
+                    for k, v in it.overhead.total().items()
+                },
+                "offerings": [
+                    {
+                        "zone": o.zone(),
+                        "capacityType": o.capacity_type(),
+                        "price": o.price,
+                        "available": o.available,
+                    }
+                    for o in it.offerings
+                ],
+            }
+        )
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=1)

@@ -21,6 +21,7 @@ from ...api.objects import (
     COND_INITIALIZED,
     Node,
     NodeClaim,
+    NodePool,
     Taint,
 )
 from ...events import Event, Recorder
@@ -164,8 +165,8 @@ class DisruptionController:
         self.queue = OrchestrationQueue(ctx)
         self.validator = Validator(ctx)
         # consolidation command awaiting its TTL re-validation
-        # (validation.go:56-215): (command, computed_at)
-        self._pending: Optional[Tuple[Command, float]] = None
+        # (validation.go:56-215): (command, computed_at, method)
+        self._pending: Optional[Tuple[Command, float, object]] = None
         self.methods = [
             Drift(ctx),
             Emptiness(ctx.clock),
@@ -187,11 +188,11 @@ class DisruptionController:
             # a consolidation command is waiting out its validation TTL;
             # the operator loop keeps running meanwhile (the reference
             # blocks only its disruption goroutine, validation.go:56-83)
-            cmd, computed_at = self._pending
+            cmd, computed_at, method = self._pending
             if now - computed_at < VALIDATION_TTL:
                 return None
             self._pending = None
-            stale = self.validator.is_valid(cmd, queue=self.queue)
+            stale = self.validator.is_valid(cmd, queue=self.queue, method=method)
             if stale is None:
                 self.execute(cmd)
                 return cmd
@@ -251,7 +252,7 @@ class DisruptionController:
         if method.reason in ("Empty", "Underutilized"):
             # consolidation acts only after surviving the TTL re-validation
             # on a later pass (validation.go:56-215); drift skips validation
-            self._pending = (cmd, now)
+            self._pending = (cmd, now, method)
             return cmd
         self.execute(cmd)
         return cmd
@@ -289,6 +290,9 @@ class DisruptionController:
         self.queue.add(command, replacement_names)
 
     def _launch_replacements(self, command: Command) -> List[str]:
+        from ..nodeclaim_disruption import stamp_nodepool_hash
+
+        pools = {np_.name: np_ for np_ in self.ctx.client.list(NodePool)}
         names = []
         for claim_model in command.replacements:
             claim = claim_model.template.to_node_claim(
@@ -296,6 +300,9 @@ class DisruptionController:
                 requirements=claim_model.requirements,
             )
             claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
+            stamp_nodepool_hash(
+                claim, pools.get(claim_model.template.node_pool_name)
+            )
             self.ctx.client.create(claim)
             names.append(claim.name)
         return names

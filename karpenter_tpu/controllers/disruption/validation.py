@@ -27,9 +27,11 @@ class Validator:
     def __init__(self, ctx):
         self.ctx = ctx
 
-    def is_valid(self, command: Command, queue=None) -> Optional[str]:
+    def is_valid(self, command: Command, queue=None, method=None) -> Optional[str]:
         """None when the command is still sound; otherwise the reason it is
-        stale (validation.go:83-215)."""
+        stale (validation.go:83-215). ``method`` re-applies the computing
+        method's eligibility filter so policy changes made during the TTL
+        (consolidation disabled, condition cleared) abandon the command."""
         if command.decision == "no-op":
             return None
         now = self.ctx.clock.now()
@@ -40,6 +42,8 @@ class Validator:
             self.ctx.clock,
             queue=queue,
         )
+        if method is not None:
+            fresh = [c for c in fresh if method.should_disrupt(c)]
         fresh_by_pid = {c.provider_id: c for c in fresh}
         for cand in command.candidates:
             if cand.provider_id not in fresh_by_pid:

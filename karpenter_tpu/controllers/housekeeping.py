@@ -11,12 +11,14 @@ pkg/controllers/nodepool/{hash,counter,readiness}
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional
 
 from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api.objects import (
     COND_CONSISTENT_STATE_FOUND,
+    COND_NODE_REGISTRATION_HEALTHY,
     COND_READY,
     COND_REGISTERED,
     Node,
@@ -127,8 +129,11 @@ class HealthController:
             repairing = sum(
                 1 for n in pool_nodes if n.metadata.deletion_timestamp is not None
             )
-            if pool_nodes and (repairing + 1) / len(pool_nodes) > MAX_REPAIR_FRACTION:
-                continue  # <=20% of a pool may repair at once
+            # <=20% of a pool may repair at once, rounding UP like PDB
+            # percentages (health/controller.go:195-198): 1 of 3 is fine
+            allowed = math.ceil(MAX_REPAIR_FRACTION * len(pool_nodes))
+            if pool_nodes and repairing >= allowed:
+                continue
             if node.metadata.deletion_timestamp is None:
                 NODES_REPAIRED.inc(labels={"nodepool": pool})
                 self.client.delete(node)
@@ -179,12 +184,36 @@ class NodePoolStatusController:
         self.cluster = cluster
 
     def reconcile_all(self) -> None:
+        now = self.client.clock.now()
         nodes = self.cluster.nodes()
+        claims_by_pool: Dict[str, List[NodeClaim]] = {}
+        for claim in self.client.list(NodeClaim):
+            claims_by_pool.setdefault(claim.nodepool_name, []).append(claim)
         for pool in self.client.list(NodePool):
             # drift-hash annotation (hash/controller.go:39-124)
-            pool.metadata.annotations[labels_mod.NODEPOOL_HASH_ANNOTATION_KEY] = (
-                nodepool_hash(pool)
+            current_hash = nodepool_hash(pool)
+            prev_hash = pool.metadata.annotations.get(
+                labels_mod.NODEPOOL_HASH_ANNOTATION_KEY
             )
+            pool.metadata.annotations[labels_mod.NODEPOOL_HASH_ANNOTATION_KEY] = (
+                current_hash
+            )
+            # registration health (registrationhealth/controller.go): a spec
+            # change resets the condition; a claim launched from the CURRENT
+            # spec that registered proves the spec produces viable nodes
+            if prev_hash is not None and prev_hash != current_hash:
+                pool.conds().set(
+                    COND_NODE_REGISTRATION_HEALTHY, "Unknown",
+                    reason="NodePoolSpecChanged", now=now,
+                )
+            elif any(
+                c.conds().is_true(COND_REGISTERED)
+                and c.metadata.annotations.get(
+                    labels_mod.NODEPOOL_HASH_ANNOTATION_KEY
+                ) == current_hash
+                for c in claims_by_pool.get(pool.name, [])
+            ):
+                pool.conds().set(COND_NODE_REGISTRATION_HEALTHY, "True", now=now)
             # status.resources aggregation (counter/controller.go)
             total: res.ResourceList = {}
             count = 0
@@ -194,5 +223,5 @@ class NodePoolStatusController:
                     count += 1
             total["nodes"] = count * res.MILLI
             pool.status.resources = total
-            pool.conds().set(COND_READY, "True", now=self.client.clock.now())
+            pool.conds().set(COND_READY, "True", now=now)
             self.client.update_status(pool)

@@ -45,6 +45,15 @@ def nodepool_hash(pool: NodePool) -> str:
     return hashlib.sha256(json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
 
 
+def stamp_nodepool_hash(claim, pool) -> None:
+    """Stamp the pool's static-field hash onto a claim at creation, feeding
+    drift detection and registration health (hash/controller.go:39-124)."""
+    if pool is not None:
+        claim.metadata.annotations[labels_mod.NODEPOOL_HASH_ANNOTATION_KEY] = (
+            nodepool_hash(pool)
+        )
+
+
 class NodeClaimDisruptionController:
     def __init__(self, client: Client, cloud_provider):
         self.client = client

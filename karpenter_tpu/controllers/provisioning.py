@@ -242,6 +242,9 @@ class Provisioner:
     # -- claim creation (provisioner.go:374-412) --------------------------
 
     def create_node_claims(self, results: Results) -> List[NodeClaim]:
+        from .nodeclaim_disruption import stamp_nodepool_hash
+
+        pools = {np_.name: np_ for np_ in self.client.list(NodePool)}
         created = []
         for claim_model in results.new_node_claims:
             claim = claim_model.template.to_node_claim(
@@ -249,6 +252,9 @@ class Provisioner:
                 requirements=claim_model.requirements,
             )
             claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
+            stamp_nodepool_hash(
+                claim, pools.get(claim_model.template.node_pool_name)
+            )
             self.client.create(claim)
             NODECLAIMS_CREATED.inc(
                 labels={"nodepool": claim_model.template.node_pool_name}

@@ -148,6 +148,22 @@ class TestValidationDeferred:
         assert cmd2 is None or cmd2.decision == "no-op"
         assert len(client.list(Node)) == 1
 
+    def test_policy_change_during_ttl_blocks(self, env):
+        """Disabling consolidation mid-TTL abandons the pending command
+        (eligibility is re-filtered through the method, validation.go:83-149)."""
+        clock, client, provider, operator, binder = env
+        self._computed_pending(env)
+        from karpenter_tpu.api.objects import NodePool
+
+        pool = client.list(NodePool)[0]
+        pool.spec.disruption.consolidation_policy = "WhenEmptyOrUnderutilized"
+        pool.spec.disruption.consolidate_after = None
+        client.update(pool)
+        clock.step(16)
+        cmd2 = operator.disruption.reconcile(force=True)
+        assert cmd2 is None or cmd2.decision == "no-op"
+        assert len(client.list(Node)) == 1
+
     def test_not_executed_before_ttl(self, env):
         clock, client, provider, operator, binder = env
         self._computed_pending(env)

@@ -1,0 +1,162 @@
+"""Housekeeping controller tests: node repair, consistency, registration
+health (health/controller.go, consistency/nodeshape.go,
+registrationhealth/controller.go shapes)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import (
+    COND_CONSISTENT_STATE_FOUND,
+    COND_NODE_REGISTRATION_HEALTHY,
+    Node,
+    NodeClaim,
+    NodePool,
+    PodCondition,
+)
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import RepairPolicy
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.operator import Operator, OperatorOptions
+from karpenter_tpu.sim import Binder
+
+from helpers import make_nodepool, make_pod, make_pods
+
+
+class RepairingProvider(KwokCloudProvider):
+    def repair_policies(self):
+        return [
+            RepairPolicy(
+                condition_type="Ready",
+                condition_status="False",
+                toleration_duration=30.0,
+            )
+        ]
+
+
+@pytest.fixture
+def env():
+    clock = TestClock()
+    client = Client(clock)
+    provider = RepairingProvider(client, corpus.generate(20))
+    operator = Operator(client, provider, OperatorOptions(node_repair=True))
+    binder = Binder(client)
+    return clock, client, provider, operator, binder
+
+
+def provision(env, n_pods=1, n_steps=6):
+    clock, client, provider, operator, binder = env
+    client.create(make_nodepool())
+    pods = make_pods(n_pods)
+    for p in pods:
+        client.create(p)
+    for _ in range(n_steps):
+        operator.step(force_provision=True)
+        binder.bind_all()
+        clock.step(1)
+    return pods
+
+
+def mark_unhealthy(client, clock, node):
+    node.status.conditions.append(
+        PodCondition(type="Ready", status="False",
+                     last_transition_time=clock.now())
+    )
+    client.update(node)
+
+
+class TestNodeRepair:
+    def test_unhealthy_node_repaired_after_toleration(self, env):
+        clock, client, provider, operator, binder = env
+        provision(env)
+        node = client.list(Node)[0]
+        mark_unhealthy(client, clock, node)
+        operator.health.reconcile_all()
+        assert client.try_get(Node, node.name) is not None  # inside toleration
+        clock.step(31)
+        operator.health.reconcile_all()
+        for _ in range(6):
+            operator.step()
+            clock.step(1)
+        assert client.try_get(Node, node.name) is None
+
+    def test_repair_capped_at_20_percent(self, env):
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool(name="pool"))
+        # 5 nodes, all unhealthy: only 1 (20%) may repair per pass
+        for _ in range(5):
+            pod = make_pod(cpu="7")  # big enough to force one node each
+            client.create(pod)
+            for _ in range(6):
+                operator.step(force_provision=True)
+                binder.bind_all()
+                clock.step(1)
+        nodes = client.list(Node)
+        assert len(nodes) == 5
+        for n in nodes:
+            mark_unhealthy(client, clock, n)
+        clock.step(31)
+        operator.health.reconcile_all()
+        deleting = [
+            n for n in client.list(Node) if n.metadata.deletion_timestamp is not None
+        ]
+        assert len(deleting) == 1
+
+    def test_no_repair_without_gate(self, env):
+        clock, client, provider, operator, binder = env
+        operator.options.node_repair = False
+        provision(env)
+        node = client.list(Node)[0]
+        mark_unhealthy(client, clock, node)
+        clock.step(31)
+        for _ in range(3):
+            operator.step()
+            clock.step(1)
+        assert client.try_get(Node, node.name) is not None
+
+
+class TestConsistency:
+    def test_undersized_node_flagged(self, env):
+        clock, client, provider, operator, binder = env
+        provision(env)
+        claim = client.list(NodeClaim)[0]
+        node = client.try_get(Node, claim.status.node_name)
+        # shrink the node to 50% of the claim's expected capacity
+        node.status.capacity = {
+            k: v // 2 for k, v in node.status.capacity.items()
+        }
+        client.update(node)
+        operator.consistency.reconcile_all()
+        assert claim.conds().get(COND_CONSISTENT_STATE_FOUND).status == "False"
+
+    def test_well_shaped_node_passes(self, env):
+        clock, client, provider, operator, binder = env
+        provision(env)
+        claim = client.list(NodeClaim)[0]
+        operator.consistency.reconcile_all()
+        assert claim.conds().is_true(COND_CONSISTENT_STATE_FOUND)
+
+
+class TestRegistrationHealth:
+    def test_healthy_after_registration(self, env):
+        clock, client, provider, operator, binder = env
+        provision(env)
+        pool = client.list(NodePool)[0]
+        assert pool.conds().is_true(COND_NODE_REGISTRATION_HEALTHY)
+
+    def test_spec_change_resets_condition(self, env):
+        clock, client, provider, operator, binder = env
+        provision(env)
+        pool = client.list(NodePool)[0]
+        assert pool.conds().is_true(COND_NODE_REGISTRATION_HEALTHY)
+        pool.spec.template.labels["team"] = "new"
+        client.update(pool)
+        operator.nodepool_status.reconcile_all()
+        assert pool.conds().get(COND_NODE_REGISTRATION_HEALTHY).status == "Unknown"
+        # a claim launched from the NEW spec re-proves health
+        pod = make_pod()
+        client.create(pod)
+        for _ in range(6):
+            operator.step(force_provision=True)
+            binder.bind_all()
+            clock.step(1)
+        assert pool.conds().is_true(COND_NODE_REGISTRATION_HEALTHY)

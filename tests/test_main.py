@@ -1,0 +1,90 @@
+"""Operator binary tests: HTTP servers, corpus files, options wiring."""
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.__main__ import build_operator, serve_health, serve_metrics
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.options import parse_options
+from karpenter_tpu.sim import Binder
+
+from helpers import make_nodepool, make_pod
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestCorpusFile:
+    def test_round_trip(self, tmp_path):
+        its = corpus.generate(8)
+        path = str(tmp_path / "types.json")
+        corpus.dump_file(path, its)
+        back = corpus.load_file(path)
+        assert [it.name for it in back] == [it.name for it in its]
+        assert back[0].capacity == its[0].capacity
+        assert back[0].offerings[0].price == its[0].offerings[0].price
+        assert back[0].offerings[0].zone() == its[0].offerings[0].zone()
+
+    def test_loaded_corpus_schedules(self, tmp_path):
+        path = str(tmp_path / "types.json")
+        corpus.dump_file(path, corpus.generate(10))
+        opts = parse_options(["--instance-types-file-path", path])
+        client = Client(TestClock())
+        operator = build_operator(opts, client=client)
+        binder = Binder(client)
+        client.create(make_nodepool())
+        pod = make_pod()
+        client.create(pod)
+        for _ in range(6):
+            operator.step(force_provision=True)
+            binder.bind_all()
+            client.clock.step(1)
+        assert pod.spec.node_name
+
+
+class TestHTTPServers:
+    def test_metrics_endpoint(self):
+        server = serve_metrics(0)
+        port = server.server_address[1]
+        try:
+            status, body = _get(port, "/metrics")
+            assert status == 200
+            assert "karpenter_tpu_" in body
+            with pytest.raises(urllib.error.HTTPError):
+                _get(port, "/other")
+        finally:
+            server.shutdown()
+
+    def test_health_endpoints(self):
+        client = Client(TestClock())
+        operator = build_operator(parse_options([]), client=client)
+        server = serve_health(0, operator)
+        port = server.server_address[1]
+        try:
+            status, body = _get(port, "/healthz")
+            assert status == 200 and body == "ok"
+            # empty cluster state is synced
+            status, _ = _get(port, "/readyz")
+            assert status == 200
+        finally:
+            server.shutdown()
+
+
+class TestOperatorWiring:
+    def test_feature_gates_reach_controllers(self):
+        opts = parse_options(
+            ["--feature-gates", "NodeRepair=true,SpotToSpotConsolidation=true"]
+        )
+        operator = build_operator(opts, client=Client(TestClock()))
+        assert operator.options.node_repair
+        assert operator.disruption.ctx.spot_to_spot_enabled
+
+    def test_default_corpus_size(self):
+        operator = build_operator(parse_options([]), client=Client(TestClock()))
+        pool = make_nodepool()
+        assert len(operator.cloud_provider.get_instance_types(pool)) == 144

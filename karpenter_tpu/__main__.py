@@ -18,6 +18,7 @@ from typing import List, Optional
 
 from .cloudprovider import corpus
 from .cloudprovider.kwok import KwokCloudProvider
+from .cloudprovider.metrics import MetricsCloudProvider
 from .kube import Client, RealClock
 from .metrics import REGISTRY
 from .operator import Operator, OperatorOptions
@@ -84,7 +85,7 @@ def build_operator(opts: Options, client: Optional[Client] = None) -> Operator:
         instance_types = corpus.load_file(opts.instance_types_file_path)
     else:
         instance_types = corpus.generate(144)  # kwok corpus size
-    provider = KwokCloudProvider(client, instance_types)
+    provider = MetricsCloudProvider(KwokCloudProvider(client, instance_types))
     return Operator(client, provider, OperatorOptions.from_options(opts))
 
 

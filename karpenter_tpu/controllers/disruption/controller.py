@@ -246,7 +246,9 @@ class DisruptionController:
             ALLOWED_DISRUPTIONS.set(float(allowed), labels={"nodepool": pool})
         cmd = method.compute_command(candidates, budgets)
         if cmd.decision == "no-op":
-            if hasattr(method, "mark_consolidated"):
+            if hasattr(method, "mark_consolidated") and not getattr(
+                method, "suppress_memoization", False
+            ):
                 method.mark_consolidated()
             return cmd
         if method.reason in ("Empty", "Underutilized"):
@@ -290,19 +292,10 @@ class DisruptionController:
         self.queue.add(command, replacement_names)
 
     def _launch_replacements(self, command: Command) -> List[str]:
-        from ..nodeclaim_disruption import stamp_nodepool_hash
+        from ..nodeclaim_disruption import materialize_claim
 
         pools = {np_.name: np_ for np_ in self.ctx.client.list(NodePool)}
-        names = []
-        for claim_model in command.replacements:
-            claim = claim_model.template.to_node_claim(
-                instance_type_options=claim_model.instance_type_options,
-                requirements=claim_model.requirements,
-            )
-            claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
-            stamp_nodepool_hash(
-                claim, pools.get(claim_model.template.node_pool_name)
-            )
-            self.ctx.client.create(claim)
-            names.append(claim.name)
-        return names
+        return [
+            materialize_claim(self.ctx.client, claim_model, pools).name
+            for claim_model in command.replacements
+        ]

@@ -303,20 +303,58 @@ class MultiNodeConsolidation(ConsolidationBase):
 
 
 class SingleNodeConsolidation(ConsolidationBase):
-    """Per-candidate sweep, cheapest-to-disrupt first
-    (singlenodeconsolidation.go:34-122)."""
+    """Per-candidate sweep, cheapest-to-disrupt first, interweaving
+    candidates across NodePools and prioritizing pools left unseen by a
+    previous timed-out run (singlenodeconsolidation.go:34-174)."""
 
     consolidation_type = "single"
 
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.previously_unseen_node_pools: set = set()
+        # True when the last pass must not be memoized as "consolidated"
+        # (timed out or budget-constrained, singlenodeconsolidation.go:112-121)
+        self.suppress_memoization = False
+
+    def sort_candidates(self, candidates) -> List[Candidate]:
+        """Disruption-cost base order, then round-robin across pools with
+        previously-unseen pools first (singlenodeconsolidation.go:138-174)."""
+        by_pool: Dict[str, List[Candidate]] = {}
+        for c in sorted(candidates, key=lambda c: c.disruption_cost):
+            by_pool.setdefault(c.node_pool.name, []).append(c)
+        ordered_pools = [p for p in self.previously_unseen_node_pools if p in by_pool]
+        ordered_pools += [p for p in by_pool if p not in self.previously_unseen_node_pools]
+        out: List[Candidate] = []
+        depth = max((len(v) for v in by_pool.values()), default=0)
+        for i in range(depth):
+            for pool in ordered_pools:
+                if i < len(by_pool[pool]):
+                    out.append(by_pool[pool][i])
+        return out
+
     def compute_command(self, candidates, budgets) -> Command:
-        candidates = _budget_filter(
-            sorted(candidates, key=lambda c: c.disruption_cost), budgets
-        )
+        self.suppress_memoization = False
+        ordered = self.sort_candidates(candidates)
+        budgeted = _budget_filter(ordered, budgets)
+        constrained_by_budgets = len(budgeted) < len(ordered)
+        all_pools = {c.node_pool.name for c in ordered}
         deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
-        for c in candidates:
+        seen_pools: set = set()
+        timed_out = False
+        for c in budgeted:
             if self.ctx.clock.now() >= deadline:
+                timed_out = True
                 break
+            seen_pools.add(c.node_pool.name)
             cmd = self.compute_consolidation([c])
             if cmd.decision != "no-op":
+                # early success: unseen-pool bookkeeping keeps its prior
+                # value, like the reference's early return
                 return cmd
+        # remember pools never reached so the next run starts there
+        self.previously_unseen_node_pools = all_pools - seen_pools
+        if timed_out or constrained_by_budgets:
+            # don't let the controller memoize this as "cluster
+            # consolidated": work was skipped, not absent
+            self.suppress_memoization = True
         return Command()

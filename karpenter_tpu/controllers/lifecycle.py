@@ -149,6 +149,15 @@ class LifecycleController:
                     f"deleting NodeClaim unregistered after {int(age)}s",
                 )
             )
+            # a registration timeout marks the owning pool unhealthy
+            # (registrationhealth/controller.go: the False half)
+            pool = self.client.try_get(NodePool, claim.nodepool_name)
+            if pool is not None:
+                pool.conds().set(
+                    COND_NODE_REGISTRATION_HEALTHY, "False",
+                    reason="RegistrationTimeout", now=self.clock.now(),
+                )
+                self.client.update_status(pool)
             self.client.delete(claim)
             self._finalize(claim)
 

@@ -54,6 +54,20 @@ def stamp_nodepool_hash(claim, pool) -> None:
         )
 
 
+def materialize_claim(client, claim_model, pools):
+    """Turn a scheduler claim model into a created NodeClaim CR: price-
+    truncated instance types, termination finalizer, nodepool-hash stamp.
+    Shared by provisioning and disruption replacement launches."""
+    claim = claim_model.template.to_node_claim(
+        instance_type_options=claim_model.instance_type_options,
+        requirements=claim_model.requirements,
+    )
+    claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
+    stamp_nodepool_hash(claim, pools.get(claim_model.template.node_pool_name))
+    client.create(claim)
+    return claim
+
+
 class NodeClaimDisruptionController:
     def __init__(self, client: Client, cloud_provider):
         self.client = client

@@ -242,20 +242,12 @@ class Provisioner:
     # -- claim creation (provisioner.go:374-412) --------------------------
 
     def create_node_claims(self, results: Results) -> List[NodeClaim]:
-        from .nodeclaim_disruption import stamp_nodepool_hash
+        from .nodeclaim_disruption import materialize_claim
 
         pools = {np_.name: np_ for np_ in self.client.list(NodePool)}
         created = []
         for claim_model in results.new_node_claims:
-            claim = claim_model.template.to_node_claim(
-                instance_type_options=claim_model.instance_type_options,
-                requirements=claim_model.requirements,
-            )
-            claim.metadata.finalizers.append(labels_mod.TERMINATION_FINALIZER)
-            stamp_nodepool_hash(
-                claim, pools.get(claim_model.template.node_pool_name)
-            )
-            self.client.create(claim)
+            claim = materialize_claim(self.client, claim_model, pools)
             NODECLAIMS_CREATED.inc(
                 labels={"nodepool": claim_model.template.node_pool_name}
             )

@@ -274,3 +274,48 @@ class TestClusterStateRegressions:
         node.provider_id = "gce://n2"
         client.update(node)
         assert len(cluster.nodes()) == 1
+
+
+class TestMetricsDecorator:
+    def test_instrumented_calls_and_errors(self):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.cloudprovider.metrics import (
+            METHOD_DURATION,
+            METHOD_ERRORS,
+            MetricsCloudProvider,
+        )
+        from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+
+        inner = FakeCloudProvider()
+        provider = MetricsCloudProvider(inner)
+        labels = {"method": "List", "provider": inner.name()}
+        before = METHOD_DURATION.count(labels)
+        provider.list()
+        assert METHOD_DURATION.count(labels) == before + 1
+
+        inner.next_create_err = InsufficientCapacityError("no capacity")
+        from helpers import make_nodepool
+        from karpenter_tpu.api.objects import NodeClaim
+
+        err_labels = {
+            "method": "Create",
+            "provider": inner.name(),
+            "error": "InsufficientCapacityError",
+        }
+        before_err = METHOD_ERRORS.value(err_labels)
+        import pytest as _pytest
+
+        with _pytest.raises(InsufficientCapacityError):
+            provider.create(NodeClaim())
+        assert METHOD_ERRORS.value(err_labels) == before_err + 1
+
+    def test_extension_passthrough(self):
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+        from karpenter_tpu.kube import Client, TestClock
+
+        provider = MetricsCloudProvider(
+            KwokCloudProvider(Client(TestClock()), corpus.generate(4))
+        )
+        provider.process_registrations()  # kwok extension reachable

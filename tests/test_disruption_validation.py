@@ -171,3 +171,46 @@ class TestValidationDeferred:
         assert operator.disruption.reconcile(force=True) is None
         assert operator.disruption._pending is not None
         assert len(client.list(Node)) == 1
+
+
+class TestSingleNodeOrdering:
+    def _candidate(self, pool_name, cost):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            node_pool=SimpleNamespace(name=pool_name), disruption_cost=cost
+        )
+
+    def _method(self):
+        from types import SimpleNamespace
+
+        from karpenter_tpu.controllers.disruption.methods import (
+            SingleNodeConsolidation,
+        )
+
+        ctx = SimpleNamespace(
+            client=None, cluster=None, cloud_provider=None,
+            clock=None, recorder=None, spot_to_spot_enabled=False,
+        )
+        return SingleNodeConsolidation(ctx)
+
+    def test_interweaves_across_pools(self):
+        m = self._method()
+        cands = [
+            self._candidate("a", 1), self._candidate("a", 2),
+            self._candidate("b", 3), self._candidate("b", 4),
+        ]
+        ordered = m.sort_candidates(cands)
+        pools = [c.node_pool.name for c in ordered]
+        assert pools == ["a", "b", "a", "b"]
+        costs = [c.disruption_cost for c in ordered]
+        assert costs == [1, 3, 2, 4]
+
+    def test_unseen_pools_first(self):
+        m = self._method()
+        m.previously_unseen_node_pools = {"b"}
+        cands = [
+            self._candidate("a", 1), self._candidate("b", 10),
+        ]
+        ordered = m.sort_candidates(cands)
+        assert [c.node_pool.name for c in ordered] == ["b", "a"]

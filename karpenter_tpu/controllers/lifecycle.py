@@ -17,9 +17,11 @@ from ..api import taints as taints_mod
 from ..api.objects import (
     COND_INITIALIZED,
     COND_LAUNCHED,
+    COND_NODE_REGISTRATION_HEALTHY,
     COND_REGISTERED,
     Node,
     NodeClaim,
+    NodePool,
 )
 from ..cloudprovider.types import (
     CloudProviderError,

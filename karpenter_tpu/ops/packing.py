@@ -34,6 +34,11 @@ def _cumsum_excl(x, axis=-1):
     return jnp.cumsum(x, axis=axis) - x
 
 
+def _bcast(mask, ndim):
+    """Broadcast a [NMAX] bool mask against an [NMAX, ...] array."""
+    return mask.reshape(mask.shape + (1,) * (ndim - 1))
+
+
 def greedy_prefix_fill(cap, n):
     """Fill slots in order: slot i gets min(cap_i, remaining)."""
     before = _cumsum_excl(cap)
@@ -199,6 +204,12 @@ def pack(
         )
 
         # ---- 3. new claims from highest-weight feasible template ----
+        # Each iteration opens a BULK of k identical claims of the chosen
+        # template (the reference opens one node per failed pod,
+        # scheduler.go:375-423; identical claims commute, so opening the
+        # whole run at once is equivalent and keeps the while-trip count at
+        # O(templates), not O(nodes)). The per-claim pool-limit debit is
+        # identical for every claim in the bulk, so limits clamp k directly.
         def body(carry):
             st, rem, fills = carry
             # feasible types per template under the remaining pool limits
@@ -212,45 +223,67 @@ def pack(
             p_star = jnp.argmax(feas_p)  # first True in weight order
             any_feasible = jnp.any(feas_p)
             n_per = jnp.max(jnp.where(avail[p_star], n_fit_pgt[p_star, gi], 0))
-            n_take = jnp.minimum(rem, n_per)
 
-            slot = st.n_open
-            would_overflow = slot >= nmax
-            ok = any_feasible & ~would_overflow & (n_take > 0)
-
-            tmask_new = avail[p_star] & (n_fit_pgt[p_star, gi] >= n_take)
-            used_new = p_daemon[p_star] + n_take.astype(jnp.float32) * req
-            # merged claim requirement state (template handled via tables; the
-            # stored masks start from the group's own constraint set)
-            write = lambda arr, val: jnp.where(
-                ok, arr.at[jnp.minimum(slot, nmax - 1)].set(val), arr
-            )
             # pessimistic limit debit: max capacity over the claim's options
             debit = jnp.max(
                 jnp.where(avail[p_star][:, None], t_cap, 0), axis=0
             )  # [R]
+            # claims the remaining pool limit affords (identical debit each)
+            with_debit = debit > 0
+            k_limit = jnp.where(
+                p_has_limit[p_star],
+                jnp.min(
+                    jnp.where(
+                        with_debit,
+                        jnp.floor(st.pool_rem[p_star] / jnp.maximum(debit, 1e-9)),
+                        jnp.inf,
+                    )
+                ),
+                jnp.inf,
+            )
+            k_want = jnp.minimum(
+                jnp.ceil(rem / jnp.maximum(n_per, 1)).astype(jnp.int32),
+                jnp.where(jnp.isinf(k_limit), 2**30, k_limit).astype(jnp.int32),
+            )
+            slot = st.n_open
+            k_slots = jnp.maximum(nmax - slot, 0)
+            k = jnp.minimum(k_want, k_slots)
+            ok = any_feasible & (k > 0) & (n_per > 0)
+            k = jnp.where(ok, k, 0)
+
+            # per-slot takes: full n_per runs, last claim partial
+            slots = jnp.arange(nmax, dtype=jnp.int32)
+            in_bulk = (slots >= slot) & (slots < slot + k)
+            takes = jnp.clip(rem - (slots - slot) * n_per, 0, n_per)
+            takes = jnp.where(in_bulk, takes, 0)  # [NMAX]
+            placed = jnp.sum(takes)
+
+            tmask_new = avail[p_star] & (n_fit_pgt[p_star, gi] >= takes[:, None])
+            used_new = p_daemon[p_star][None, :] + takes[:, None].astype(jnp.float32) * req[None, :]
+            write = lambda arr, val: jnp.where(
+                _bcast(in_bulk, arr.ndim), val, arr
+            )
             pool_rem = jnp.where(
                 ok & p_has_limit[p_star],
-                st.pool_rem.at[p_star].add(-debit),
+                st.pool_rem.at[p_star].add(-debit * k.astype(jnp.float32)),
                 st.pool_rem,
             )
             st = st._replace(
                 c_used=write(st.c_used, used_new),
-                c_npods=write(st.c_npods, n_take),
+                c_npods=write(st.c_npods, takes),
                 c_active=write(st.c_active, True),
                 c_pool=write(st.c_pool, p_star),
                 c_tmask=write(st.c_tmask, tmask_new),
-                c_def=write(st.c_def, gdef),
-                c_neg=write(st.c_neg, gneg),
-                c_mask=write(st.c_mask, gmask),
+                c_def=write(st.c_def, gdef[None, :]),
+                c_neg=write(st.c_neg, gneg[None, :]),
+                c_mask=write(st.c_mask, gmask[None, :, :]),
                 pool_rem=pool_rem,
-                n_open=jnp.where(ok, slot + 1, st.n_open),
-                overflow=st.overflow | (any_feasible & would_overflow),
+                n_open=slot + k,
+                overflow=st.overflow
+                | (any_feasible & (n_per > 0) & (k_want > k_slots)),
             )
-            fills = jnp.where(
-                ok, fills.at[jnp.minimum(slot, nmax - 1)].add(n_take), fills
-            )
-            rem = jnp.where(ok, rem - n_take, rem)
+            fills = fills + takes
+            rem = rem - placed
             return st, rem, fills
 
         # loop while rem>0 and the last iteration made progress; a stuck

@@ -79,12 +79,12 @@ def _ptr(arr: np.ndarray):
 
 
 def solve_core_native(
-    g_count, g_req, g_def, g_neg, g_mask,
+    g_count, g_req, g_def, g_neg, g_mask, g_hcap,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc,
-    n_def, n_mask, n_avail, n_base, n_tol,
+    n_def, n_mask, n_avail, n_base, n_tol, n_hcnt,
     well_known,
     nmax: int,
     zone_kid: int,
@@ -94,6 +94,8 @@ def solve_core_native(
     lib = _load()
 
     g_count = _as(g_count, np.int32)
+    g_hcap = _as(g_hcap, np.int32)
+    n_hcnt = _as(n_hcnt, np.int32)
     g_req = _as(g_req, np.float32)
     g_def, g_neg, g_mask = (_as(x, np.uint8) for x in (g_def, g_neg, g_mask))
     p_def, p_neg, p_mask = (_as(x, np.uint8) for x in (p_def, p_neg, p_mask))
@@ -132,12 +134,14 @@ def solve_core_native(
         ctypes.c_int(R), ctypes.c_int(K), ctypes.c_int(V1), ctypes.c_int(O),
         ctypes.c_int(nmax), ctypes.c_int(zone_kid), ctypes.c_int(ct_kid),
         _ptr(g_count), _ptr(g_req), _ptr(g_def), _ptr(g_neg), _ptr(g_mask),
+        _ptr(g_hcap),
         _ptr(p_def), _ptr(p_neg), _ptr(p_mask), _ptr(p_daemon), _ptr(p_limit),
         _ptr(p_has_limit), _ptr(p_tol), _ptr(p_titype_ok),
         _ptr(t_def), _ptr(t_mask), _ptr(t_alloc), _ptr(t_cap),
         _ptr(o_avail), _ptr(o_zone), _ptr(o_ct),
         _ptr(a_tzc),
         _ptr(n_def), _ptr(n_mask), _ptr(n_avail), _ptr(n_base), _ptr(n_tol),
+        _ptr(n_hcnt),
         _ptr(well_known),
         _ptr(c_pool), _ptr(c_tmask), _ptr(n_open), _ptr(overflow),
         _ptr(exist_fills), _ptr(claim_fills), _ptr(unplaced),

@@ -158,6 +158,7 @@ int kt_solve(
     // groups (FFD order)
     const int32_t* g_count, const float* g_req, const uint8_t* g_def,
     const uint8_t* g_neg, const uint8_t* g_mask,
+    const int32_t* g_hcap,  // [G] per-entity hostname-topology cap
     // templates
     const uint8_t* p_def, const uint8_t* p_neg, const uint8_t* p_mask,
     const float* p_daemon, const float* p_limit, const uint8_t* p_has_limit,
@@ -171,6 +172,7 @@ int kt_solve(
     // existing nodes
     const uint8_t* n_def, const uint8_t* n_mask, const float* n_avail,
     const float* n_base, const uint8_t* n_tol,
+    const int32_t* n_hcnt,  // [N, G] prior selected-pod counts
     const uint8_t* well_known,
     // outputs
     int32_t* out_c_pool,      // [NMAX]
@@ -274,12 +276,20 @@ int kt_solve(
     const uint8_t* gneg = g_neg + gi * K;
     const uint8_t* gmask = g_mask + gi * KV;
 
+    // hostname-topology per-entity cap (see ops/packing.py step): spread's
+    // skew bound collapses to "<= maxSkew selected pods per node/claim"
+    // because hostname domains have a global min of 0.
+    const int32_t hc = g_hcap[gi];
+
     // ---- 1. existing nodes, fixed priority order ----
     for (int n = 0; n < N; ++n) {
       exist_cap[n] =
           (cap_ng[static_cast<size_t>(n) * G + gi] > 0)
               ? fits_count(n_avail + n * R, exist_used.data() + n * R, req, R)
               : 0;
+      exist_cap[n] = std::min(
+          exist_cap[n],
+          std::max(hc - n_hcnt[static_cast<size_t>(n) * G + gi], 0));
     }
     greedy_prefix_fill(exist_cap, count, exist_fill);
     int32_t rem = count;
@@ -343,7 +353,7 @@ int kt_solve(
         }
         if (off && add > best) best = add;
       }
-      claim_cap[s] = best;
+      claim_cap[s] = std::min(best, hc);  // open claims carry no prior
     }
     waterfill(c_npods, claim_cap, rem, claim_fill);
     for (int s = 0; s < NMAX; ++s) {
@@ -432,6 +442,7 @@ int kt_solve(
         n_per = std::max(
             n_per, n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t]);
       }
+      n_per = std::min(n_per, hc);
       int32_t n_take = std::min(rem, n_per);
       if (n_take <= 0) break;
       if (n_open >= NMAX) {

@@ -96,6 +96,7 @@ class PackState(NamedTuple):
 def pack(
     # groups (FFD order)
     g_count, g_req, g_def, g_neg, g_mask,
+    g_hcap,  # [G] int32 per-entity cap (hostname spread/anti; 2**30 = none)
     # precomputed feasibility tables
     compat_pg, type_ok_pgt, n_fit_pgt,  # [P,G], [P,G,T], [P,G,T]
     cap_ng,  # [N, G] existing-node capacity at t0 (compat ∧ taints)
@@ -107,6 +108,7 @@ def pack(
     p_daemon, p_limit, p_has_limit, p_tol,
     # existing nodes
     n_avail, n_base,
+    n_hcnt,  # [N, G] int32 prior selected-pod counts (hostname topology)
     well_known,
     nmax: int,
     zone_kid: int,
@@ -150,6 +152,12 @@ def pack(
         count = g_count[gi]
         req = g_req[gi]
         gdef, gneg, gmask = g_def[gi], g_neg[gi], g_mask[gi]
+        # hostname-topology per-entity cap: a hostname domain's global min
+        # is 0 (topologygroup.go:253-274), so spread's skew bound collapses
+        # to "<= maxSkew selected pods per node"; anti-affinity is the cap=1
+        # case (empty-domain rule, topologygroup.go:340-366). Existing nodes
+        # deduct pods already counted against the constraint.
+        hcap = g_hcap[gi]
 
         # ---- 1. existing nodes, fixed priority order ----
         exist_cap = jnp.where(
@@ -157,6 +165,7 @@ def pack(
             fits_count(n_avail, state.exist_used, req[None, :]),
             0,
         )
+        exist_cap = jnp.minimum(exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0))
         exist_fill = greedy_prefix_fill(exist_cap, count)
         exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
         rem = count - jnp.sum(exist_fill)
@@ -186,6 +195,7 @@ def pack(
         claim_cap = jnp.where(
             state.c_active & claim_compat, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0
         )
+        claim_cap = jnp.minimum(claim_cap, hcap)  # open claims carry no prior
         claim_fill = waterfill(state.c_npods, claim_cap, rem)
         rem = rem - jnp.sum(claim_fill)
 
@@ -222,7 +232,9 @@ def pack(
             feas_p = jnp.any(avail, axis=-1)
             p_star = jnp.argmax(feas_p)  # first True in weight order
             any_feasible = jnp.any(feas_p)
-            n_per = jnp.max(jnp.where(avail[p_star], n_fit_pgt[p_star, gi], 0))
+            n_per = jnp.minimum(
+                jnp.max(jnp.where(avail[p_star], n_fit_pgt[p_star, gi], 0)), hcap
+            )
 
             # pessimistic limit debit: max capacity over the claim's options
             debit = jnp.max(

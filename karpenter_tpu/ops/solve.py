@@ -19,12 +19,12 @@ from .packing import pack
 
 
 def solve_core(
-    g_count, g_req, g_def, g_neg, g_mask,
+    g_count, g_req, g_def, g_neg, g_mask, g_hcap,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc,
-    n_def, n_mask, n_avail, n_base, n_tol,
+    n_def, n_mask, n_avail, n_base, n_tol, n_hcnt,
     well_known,
     nmax: int,
     zone_kid: int,
@@ -50,12 +50,14 @@ def solve_core(
 
     state, exist_fills, claim_fills, unplaced = pack(
         g_count, g_req, g_def, g_neg, g_mask,
+        g_hcap,
         compat_pg, type_ok, n_fit,
         cap_ng,
         t_alloc, t_cap,
         a_tzc,
         p_daemon, p_limit, p_has_limit, p_tol,
         n_avail, n_base,
+        n_hcnt,
         well_known,
         nmax=nmax,
         zone_kid=zone_kid,

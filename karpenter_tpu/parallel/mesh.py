@@ -61,6 +61,7 @@ def snapshot_shardings(mesh) -> Tuple:
         g,  # g_def [G, K]
         g,  # g_neg [G, K]
         g,  # g_mask [G, K, V1]
+        g,  # g_hcap [G]
         rep,  # p_def
         rep,  # p_neg
         rep,  # p_mask
@@ -82,6 +83,7 @@ def snapshot_shardings(mesh) -> Tuple:
         rep,  # n_avail
         rep,  # n_base
         S(None, "data"),  # n_tol [N, G]
+        S(None, "data"),  # n_hcnt [N, G]
         rep,  # well_known [K]
     )
 

@@ -159,7 +159,7 @@ class TpuSolver:
     def solve(self, pods: Sequence[Pod]) -> Results:
         if self.config.force_oracle:
             return self.oracle.solve(pods)
-        groups, rest = enc.partition_and_group(pods)
+        groups, rest = enc.partition_and_group(pods, topology=self.oracle.topology)
 
         tpu_claims: List[DecodedClaim] = []
         tpu_errors: Dict[str, object] = {}
@@ -289,15 +289,19 @@ class TpuSolver:
                     np.inf,
                 )
             best = np.maximum(best, np.min(per_n, axis=-1).max(axis=1))
+        # the hostname-topology cap bounds every fill regardless of source
+        best = np.minimum(best, snap.g_hcap)
         capped = np.minimum(best, snap.g_count.astype(np.float64))
         return int(capped.max()) if capped.size else 0
 
     def _estimate_nmax(self, snap: enc.EncodedSnapshot, fit: np.ndarray) -> int:
         """Host-side claim-count bound: pods per node by the best
-        unconstrained fit. Compatibility can only shrink the real fit, so
-        this may undershoot; the overflow retry doubles NMAX in that case."""
+        unconstrained fit, clamped by the hostname-topology per-entity cap
+        (a maxSkew=1 hostname spread means one claim per pod). Compatibility
+        can only shrink the real fit, so this may undershoot; the overflow
+        retry doubles NMAX in that case."""
         n_fit = np.where(np.isfinite(fit), fit, 0)
-        best = np.maximum(n_fit.max(axis=1), 1)
+        best = np.maximum(np.minimum(n_fit.max(axis=1), snap.g_hcap), 1)
         return enc._next_pow2(
             int(np.ceil(snap.g_count / best).sum()) + len(snap.groups) + 8, floor=8
         )

@@ -30,9 +30,12 @@ from ..api.objects import Pod
 from ..api.requirements import Requirements, pod_requirements
 from ..cloudprovider import types as cp
 from ..scheduling.template import NodeClaimTemplate
+from ..scheduling.topology import TopologyType
 from .vocab import Vocab, _next_pow2
 
 _MEMORY_LIKE = ("memory", "storage", "hugepages")
+
+HCAP_NONE = 2**30  # sentinel: no per-entity topology cap
 
 
 def _unit_divisor(resource_name: str) -> int:
@@ -61,12 +64,33 @@ def quantize_capacity(rl: res.ResourceList, names: Sequence[str]) -> np.ndarray:
 
 
 @dataclass
+class TopoSpec:
+    """Tensorized topology state for one pod group.
+
+    Host-side distillation of the oracle's TopologyGroups
+    (scheduling/topology.py) into the forms the kernel consumes:
+
+    - hostname-keyed constraints collapse to a per-entity cap: hostname
+      domains have a global min of 0 (reference topologygroup.go:253-274),
+      so "count+1-min <= maxSkew" is just "<= maxSkew pods of this group per
+      node/claim"; self anti-affinity is the maxSkew=1 case of the same rule
+      (empty-domain selection, topologygroup.go:340-366).
+    - prior counts come from cluster pods already selected by the
+      constraint (topology.go:322-420), keyed by node name.
+    """
+
+    host_cap: Optional[int] = None  # per-entity cap; None = unconstrained
+    host_counts: Dict[str, int] = field(default_factory=dict)  # node -> prior
+
+
+@dataclass
 class PodGroup:
     """An equivalence class of schedulable pods."""
 
     pods: List[Pod]
     requirements: Requirements
     requests: res.ResourceList
+    topo: Optional[TopoSpec] = None
 
     @property
     def count(self) -> int:
@@ -77,7 +101,11 @@ def group_key(pod: Pod) -> tuple:
     """Equivalence key from raw spec primitives — no Requirements objects
     are built per pod (hot for 50k-pod snapshots); the group's Requirements
     are constructed once in build_groups. Frozensets, not sorted tuples:
-    only equality/hash matter here and set construction is ~2x faster."""
+    only equality/hash matter here and set construction is ~2x faster.
+
+    Pods carrying topology constraints additionally key on namespace +
+    labels + the constraint signatures: their placement depends on selector
+    matching, so only pods that count identically may share a group."""
     spec = pod.spec
     affinity_key = ()
     if spec.node_affinity is not None and spec.node_affinity.required:
@@ -85,7 +113,7 @@ def group_key(pod: Pod) -> tuple:
             (t.key, t.operator, tuple(t.values), t.min_values)
             for t in spec.node_affinity.required[0]
         )
-    return (
+    base = (
         frozenset(spec.requests.items()),
         frozenset(spec.node_selector.items()) if spec.node_selector else (),
         affinity_key,
@@ -93,14 +121,57 @@ def group_key(pod: Pod) -> tuple:
             (t.key, t.operator, t.value, t.effect) for t in spec.tolerations
         ) if spec.tolerations else (),
     )
+    if not (spec.topology_spread_constraints or spec.pod_anti_affinity or spec.pod_affinity):
+        return base
+    topo = (
+        pod.metadata.namespace,
+        frozenset(pod.metadata.labels.items()),
+        tuple(
+            (
+                t.max_skew, t.topology_key, t.when_unsatisfiable,
+                t.label_selector.key() if t.label_selector else None,
+                t.min_domains, t.node_affinity_policy, t.node_taints_policy,
+            )
+            for t in spec.topology_spread_constraints
+        ),
+        tuple(
+            (t.topology_key, t.label_selector.key() if t.label_selector else None, t.namespaces)
+            for t in spec.pod_affinity
+        ),
+        tuple(
+            (t.topology_key, t.label_selector.key() if t.label_selector else None, t.namespaces)
+            for t in spec.pod_anti_affinity
+        ),
+    )
+    return base + topo
 
 
-def is_tensorizable(pod: Pod) -> bool:
-    """Pods the TPU fast path handles this round; the rest route to the
-    host oracle (topology/host-port/preference state is sequential)."""
+def is_tensorizable(pod: Pod, allow_topology: bool = False) -> bool:
+    """Pods the TPU fast path handles; the rest route to the host oracle.
+
+    ``allow_topology`` admits the topology shapes the kernel models —
+    hostname-keyed spread / anti-affinity (per-entity caps) — subject to
+    the global cross-group checks in partition_and_group (a Topology
+    context is required for those). Everything else with sequential state
+    (host ports, volumes, preference relaxation, Gt/Lt) stays host-side."""
     spec = pod.spec
-    if spec.topology_spread_constraints or spec.pod_affinity or spec.pod_anti_affinity:
+    if spec.pod_affinity:
         return False
+    if not allow_topology and (spec.topology_spread_constraints or spec.pod_anti_affinity):
+        return False
+    if allow_topology:
+        for tsc in spec.topology_spread_constraints:
+            if tsc.topology_key != labels_mod.HOSTNAME:
+                return False
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                return False  # ScheduleAnyway relaxes host-side
+            if tsc.node_taints_policy == "Honor":
+                return False  # taint-gated counting stays host-side
+        for term in spec.pod_anti_affinity:
+            if term.topology_key != labels_mod.HOSTNAME:
+                return False
+        if len(spec.pod_anti_affinity) > 1:
+            return False
     if spec.preferred_pod_affinity or spec.preferred_pod_anti_affinity:
         return False
     if spec.host_ports or spec.volumes:
@@ -140,6 +211,8 @@ class EncodedSnapshot:
     g_def: np.ndarray  # [G, K] bool
     g_neg: np.ndarray  # [G, K] bool
     g_mask: np.ndarray  # [G, K, V1] bool
+    g_hcap: np.ndarray  # [G] int32 per-entity cap (hostname topology; HCAP_NONE=free)
+    n_hcnt: np.ndarray  # [N, G] int32 prior selected-pod counts per existing node
 
     # instance types
     t_alloc: np.ndarray  # [T, R] f32
@@ -181,12 +254,14 @@ class EncodedSnapshot:
         multi-chip padding all build from this)."""
         return (
             self.g_count, self.g_req, self.g_def, self.g_neg, self.g_mask,
+            self.g_hcap,
             self.p_def, self.p_neg, self.p_mask, self.p_daemon,
             self.p_limit, self.p_has_limit, self.p_tol, self.p_titype_ok,
             self.t_def, self.t_mask, self.t_alloc, self.t_cap,
             self.o_avail, self.o_zone, self.o_ct,
             a_tzc,
             self.n_def, self.n_mask, self.n_avail, self.n_base, self.n_tol,
+            self.n_hcnt,
             self.well_known,
         )
 
@@ -275,8 +350,11 @@ def encode(
     g_def = np.zeros((G, K), bool)
     g_neg = np.zeros((G, K), bool)
     g_mask = np.ones((G, K, V1), bool)
+    g_hcap = np.full((G,), HCAP_NONE, np.int32)
     for i, g in enumerate(groups):
         g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
+        if g.topo is not None and g.topo.host_cap is not None:
+            g_hcap[i] = g.topo.host_cap
 
     # -- instance types + templates (static side, cached per padding) -----
     static_key = (K, V1, tuple(resource_names))
@@ -360,6 +438,7 @@ def encode(
     n_def = np.zeros((N, K), bool)
     n_mask = np.ones((N, K, V1), bool)
     n_tol = np.zeros((N, max(G, 1)), bool)
+    n_hcnt = np.zeros((N, max(G, 1)), np.int32)
     existing_names = []
     for i, en in enumerate(existing_nodes):
         # `en` is a scheduling.inflight.ExistingNode (carries the remaining
@@ -373,6 +452,15 @@ def encode(
                 taints_mod.tolerates(en.cached_taints, g.pods[0].spec.tolerations)
                 is None
             )
+            if g.topo is not None and g.topo.host_counts:
+                # hostname domains are the node's hostname label (node name
+                # as fallback), mirroring Topology._count_domains
+                domain = (
+                    en.state_node.hostname()
+                    if hasattr(en, "state_node")
+                    else en.name
+                )
+                n_hcnt[i, gi] = g.topo.host_counts.get(domain, 0)
 
     return EncodedSnapshot(
         vocab=vocab,
@@ -386,6 +474,8 @@ def encode(
         g_def=g_def,
         g_neg=g_neg,
         g_mask=g_mask,
+        g_hcap=g_hcap,
+        n_hcnt=n_hcnt,
         t_alloc=t_alloc,
         t_cap=t_cap,
         t_def=t_def,
@@ -423,14 +513,29 @@ def build_groups(pods: Sequence[Pod]) -> List[PodGroup]:
 
 def partition_and_group(
     pods: Sequence[Pod],
+    topology=None,
 ) -> Tuple[List[PodGroup], List[Pod]]:
     """One pass over the batch: route non-tensorizable pods to the host
     oracle and group the rest into equivalence classes, FFD-ordered
-    (queue.go:76-112). Fused because both checks walk the same 50k specs."""
+    (queue.go:76-112). Fused because both checks walk the same 50k specs.
+
+    With a ``topology`` (scheduling.topology.Topology, already updated with
+    every pending pod), pods whose topology constraints the kernel models
+    are admitted too, then re-checked globally:
+
+    - a constraint's selector must match only its own group's pending pods
+      (self-selecting) or none at all — cross-group selection serializes
+      through the oracle;
+    - any oracle-routed pod whose topology selectors match a tensorized
+      group demotes that group (the oracle cannot see TPU placements);
+    - inverse anti-affinity from already-bound cluster pods demotes the
+      groups it selects (their placements are gated node-by-node).
+    """
     by_key: Dict[tuple, PodGroup] = {}
     rest: List[Pod] = []
+    allow_topo = topology is not None
     for pod in pods:
-        if not is_tensorizable(pod):
+        if not is_tensorizable(pod, allow_topology=allow_topo):
             rest.append(pod)
             continue
         key = group_key(pod)
@@ -442,6 +547,9 @@ def partition_and_group(
         else:
             g.pods.append(pod)
     groups = list(by_key.values())
+    if allow_topo:
+        groups, demoted = _resolve_topology(groups, rest, topology)
+        rest.extend(demoted)
     # FFD order over groups: cpu desc, then memory desc (queue.go:76-112)
     groups.sort(
         key=lambda g: (
@@ -450,3 +558,155 @@ def partition_and_group(
         )
     )
     return groups, rest
+
+
+def _pod_constraint_selectors(pod: Pod):
+    """(namespaces, selector) for every topology constraint on the pod,
+    including preferred terms (they own TopologyGroups too)."""
+    spec = pod.spec
+    ns = pod.metadata.namespace
+    for tsc in spec.topology_spread_constraints:
+        yield {ns}, tsc.label_selector
+    terms = list(spec.pod_affinity) + list(spec.pod_anti_affinity)
+    terms += [wt.term for wt in spec.preferred_pod_affinity]
+    terms += [wt.term for wt in spec.preferred_pod_anti_affinity]
+    for term in terms:
+        yield (set(term.namespaces) if term.namespaces else {ns}), term.label_selector
+
+
+def _resolve_topology(
+    groups: List[PodGroup], rest: List[Pod], topology
+) -> Tuple[List[PodGroup], List[Pod]]:
+    """Global cross-group checks + TopoSpec construction (see
+    partition_and_group docstring). Returns (kept groups, demoted pods)."""
+    # distinct (namespace, labels) -> owning group indices (-1 = oracle side)
+    label_owners: Dict[tuple, set] = {}
+    for gi, g in enumerate(groups):
+        for p in g.pods:
+            label_owners.setdefault(
+                (p.metadata.namespace, frozenset(p.metadata.labels.items())), set()
+            ).add(gi)
+    for p in rest:
+        label_owners.setdefault(
+            (p.metadata.namespace, frozenset(p.metadata.labels.items())), set()
+        ).add(-1)
+
+    def matched_owners(namespaces: set, selector) -> set:
+        out: set = set()
+        if selector is None:
+            return out  # nil selector selects nothing (labels.Nothing())
+        for (ns, labels_fs), owners in label_owners.items():
+            if ns in namespaces and selector.matches(dict(labels_fs)):
+                out |= owners
+        return out
+
+    demote: set = set()
+
+    # oracle-routed pods' constraints demote any tensorized group they
+    # select: the oracle cannot see TPU placements. Demotion is transitive —
+    # a demoted group's own constraints become oracle-side too — so iterate
+    # to a fixpoint.
+    seen_sigs = set()
+
+    def demote_by_selectors(pod: Pod) -> None:
+        for namespaces, selector in _pod_constraint_selectors(pod):
+            sig = (frozenset(namespaces), selector.key() if selector else None)
+            if sig in seen_sigs:
+                continue
+            seen_sigs.add(sig)
+            demote.update(
+                gi for gi in matched_owners(namespaces, selector) if gi >= 0
+            )
+
+    for p in rest:
+        demote_by_selectors(p)
+
+    # inverse anti-affinity owned by anyone outside the tensorized groups
+    # (bound cluster pods, or pending pods already oracle-routed) gates
+    # placements node-by-node in the oracle — demote every group it selects,
+    # including constraint-free ones (their labels may match the selector).
+    group_uids = [{p.uid for p in g.pods} for g in groups]
+    all_uids = set().union(*group_uids) if group_uids else set()
+    for tg in topology.inverse_topology_groups.values():
+        if tg.owners - all_uids:
+            demote.update(
+                gi
+                for gi in matched_owners(tg.namespaces, tg.selector)
+                if gi >= 0
+            )
+
+    for gi, g in enumerate(groups):
+        if gi in demote:
+            continue
+        rep = g.pods[0]
+        if not (rep.spec.topology_spread_constraints or rep.spec.pod_anti_affinity):
+            continue
+        uids = group_uids[gi]
+        owned = [
+            tg for tg in topology.topology_groups.values() if tg.is_owned_by(rep.uid)
+        ]
+        constraints = []  # (cap, counts) per hostname constraint
+        for tg in owned:
+            # shared TopologyGroup across groups -> coupled counting
+            if not tg.owners <= uids:
+                demote.add(gi)
+                break
+            matched = matched_owners(tg.namespaces, tg.selector)
+            if matched - {gi}:
+                demote.add(gi)  # selects pods outside this group
+                break
+            if tg.selects(rep):
+                # self-selecting: the skew bound is a per-entity cap of
+                # maxSkew (anti: 1) minus pods already counted on the node
+                cap = (
+                    tg.max_skew
+                    if tg.type is TopologyType.SPREAD
+                    else 1  # anti-affinity: only empty domains accept
+                )
+                constraints.append(
+                    (cap, {d: c for d, c in tg.domains.items() if c > 0})
+                )
+            else:
+                # non-self-selecting: placements never change the counts, so
+                # the constraint is a binary per-node gate — blocked when the
+                # prior already exceeds the allowance (spread: > maxSkew,
+                # anti: > 0), unlimited otherwise. Encoded as an infinite
+                # effective prior on blocked nodes under an infinite cap.
+                threshold = (
+                    tg.max_skew if tg.type is TopologyType.SPREAD else 0
+                )
+                constraints.append(
+                    (
+                        HCAP_NONE,
+                        {
+                            d: HCAP_NONE
+                            for d, c in tg.domains.items()
+                            if c > threshold
+                        },
+                    )
+                )
+        if gi in demote:
+            continue
+        # fold constraints: fresh-entity cap = min cap_i; a node's residual
+        # is min_i (cap_i - prior_i), stored back as an effective prior so
+        # the kernel's single (cap - prior) recovers it
+        spec = TopoSpec()
+        if constraints:
+            spec.host_cap = min(c for c, _ in constraints)
+            for d in {d for _, counts in constraints for d in counts}:
+                residual = min(c - counts.get(d, 0) for c, counts in constraints)
+                spec.host_counts[d] = spec.host_cap - max(residual, 0)
+        g.topo = spec
+
+    # transitive closure: a demoted group's constraints join the oracle side
+    pending = set(demote)
+    while pending:
+        gi = pending.pop()
+        before = set(demote)
+        for p in groups[gi].pods:
+            demote_by_selectors(p)
+        pending |= demote - before
+
+    kept = [g for gi, g in enumerate(groups) if gi not in demote]
+    demoted_pods = [p for gi in demote for p in groups[gi].pods]
+    return kept, demoted_pods

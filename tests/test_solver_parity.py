@@ -152,8 +152,12 @@ class TestNodePoolInteraction:
             assert claim.template.node_pool_name == "open"
 
 
-class TestHybridRouting:
-    def test_spread_pods_fall_back_to_oracle(self):
+class TestHostnameTopology:
+    """Hostname-keyed spread/anti-affinity ride the TPU fast path as
+    per-entity caps (ops/packing.py; reference topologygroup.go:253-274,
+    340-366)."""
+
+    def test_hostname_spread_rides_fast_path(self):
         from helpers import spread_constraint
 
         app = {"app": "x"}
@@ -166,5 +170,224 @@ class TestHybridRouting:
         solver = TpuSolver(node_pools, its_by_pool, topo)
         results = solver.solve(pods)
         assert results.all_pods_scheduled()
-        # hostname spread forces 3 dedicated nodes via the oracle path
-        assert results.node_count() >= 4
+        # maxSkew=1 hostname spread: one spread pod per claim; plain pods
+        # co-pack onto the same claims (no split-brain extra nodes)
+        assert results.node_count() == 3
+        for claim in results.new_node_claims:
+            spread_pods = [p for p in claim.pods if p.metadata.labels.get("app") == "x"]
+            assert len(spread_pods) <= 1
+
+    def test_hostname_spread_parity(self):
+        from helpers import spread_constraint
+
+        app = {"app": "s"}
+        pods = make_pods(
+            9, cpu="1", labels=app,
+            spread=[spread_constraint(labels.HOSTNAME, labels=app)],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+        assert tpu_r.node_count() == 9
+        for claim in tpu_r.new_node_claims:
+            assert len(claim.pods) <= 1
+
+    def test_hostname_spread_skew2_parity(self):
+        from helpers import spread_constraint
+
+        app = {"app": "s2"}
+        pods = make_pods(
+            10, cpu="1", labels=app,
+            spread=[spread_constraint(labels.HOSTNAME, max_skew=2, labels=app)],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+        assert tpu_r.node_count() == 5
+        for claim in tpu_r.new_node_claims:
+            assert len(claim.pods) <= 2
+
+    def test_hostname_anti_affinity_parity(self):
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+
+        app = {"app": "anti"}
+        term = PodAffinityTerm(
+            topology_key=labels.HOSTNAME,
+            label_selector=LabelSelector(match_labels=dict(app)),
+        )
+        pods = make_pods(8, cpu="1", labels=app, pod_anti_affinity=[term])
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+        assert tpu_r.node_count() == 8
+        for claim in tpu_r.new_node_claims:
+            assert len(claim.pods) <= 1
+
+    def test_cross_group_selector_demotes_to_oracle(self):
+        from karpenter_tpu.solver import encode as enc
+        from helpers import spread_constraint
+
+        # the spread selector also matches the plain pods' labels -> the
+        # spread group must serialize through the oracle
+        app = {"app": "shared"}
+        plain = make_pods(4, cpu="2", labels=app)
+        spreaders = make_pods(
+            3, cpu="1", labels=app,
+            spread=[spread_constraint(labels.HOSTNAME, labels=app)],
+        )
+        pods = plain + spreaders
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert {p.uid for p in rest} >= {p.uid for p in spreaders}
+        # end-to-end still schedules everything
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_non_self_selecting_spread_is_node_gate(self):
+        from helpers import spread_constraint
+
+        # the selector matches nothing pending or bound: counts never move,
+        # so the constraint never blocks (0 <= maxSkew) and pods co-pack
+        pods = make_pods(
+            5, cpu="1", labels={"app": "x"},
+            spread=[spread_constraint(labels.HOSTNAME, labels={"app": "other"})],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r)
+        assert tpu_r.node_count() == 1
+
+    def test_non_self_selecting_anti_blocks_counted_node(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, Node, ObjectMeta, PodAffinityTerm,
+        )
+        from karpenter_tpu.controllers.state import StateNode
+
+        client = Client(TestClock())
+        node = Node(
+            metadata=ObjectMeta(
+                name="busy-1",
+                labels={
+                    labels.TOPOLOGY_ZONE: "test-zone-a",
+                    labels.HOSTNAME: "busy-1",
+                },
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("16"),
+            "memory": res.parse_quantity("64Gi"),
+            "pods": res.parse_quantity("110"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        client.create(node)
+        blocker = make_pod(labels={"app": "y"}, node_name="busy-1", phase="Running")
+        client.create(blocker)
+        sn = StateNode(node=node)
+
+        term = PodAffinityTerm(
+            topology_key=labels.HOSTNAME,
+            label_selector=LabelSelector(match_labels={"app": "y"}),
+        )
+        pods = make_pods(3, cpu="1", labels={"app": "z"}, pod_anti_affinity=[term])
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(client, [sn], node_pools, its_by_pool, pods)
+        solver = TpuSolver(node_pools, its_by_pool, topo, state_nodes=[sn])
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        # the counted node is gated; the fresh claim may hold all three
+        # (their own anti selects app=y, not each other)
+        for en in results.existing_nodes:
+            assert not en.pods
+        assert results.node_count() == 1
+
+    def test_bound_inverse_anti_demotes_plain_group(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, Node, ObjectMeta, PodAffinityTerm,
+        )
+        from karpenter_tpu.controllers.state import StateNode
+        from karpenter_tpu.solver import encode as enc
+
+        client = Client(TestClock())
+        node = Node(
+            metadata=ObjectMeta(
+                name="anti-1",
+                labels={
+                    labels.TOPOLOGY_ZONE: "test-zone-a",
+                    labels.HOSTNAME: "anti-1",
+                },
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("16"),
+            "memory": res.parse_quantity("64Gi"),
+            "pods": res.parse_quantity("110"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        client.create(node)
+        # bound pod repels app=plain from its node
+        term = PodAffinityTerm(
+            topology_key=labels.HOSTNAME,
+            label_selector=LabelSelector(match_labels={"app": "plain"}),
+        )
+        blocker = make_pod(
+            labels={"app": "other"}, node_name="anti-1", phase="Running",
+            pod_anti_affinity=[term],
+        )
+        client.create(blocker)
+        sn = StateNode(node=node)
+
+        pods = make_pods(3, cpu="1", labels={"app": "plain"})
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(client, [sn], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 3  # demoted to the oracle
+        solver = TpuSolver(node_pools, its_by_pool, topo, state_nodes=[sn])
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        for en in results.existing_nodes:
+            assert not en.pods  # oracle honors the bound pod's anti-affinity
+
+    def test_transitive_demotion(self):
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+        from karpenter_tpu.solver import encode as enc
+
+        # A's anti selects both its own labels and B's -> A demoted for
+        # cross-group selection, then B demoted transitively
+        sel = LabelSelector(
+            match_expressions=[
+                __import__(
+                    "karpenter_tpu.api.objects", fromlist=["LabelSelectorRequirement"]
+                ).LabelSelectorRequirement(key="app", operator="In", values=("a", "b"))
+            ]
+        )
+        term = PodAffinityTerm(topology_key=labels.HOSTNAME, label_selector=sel)
+        a_pods = make_pods(2, cpu="1", labels={"app": "a"}, pod_anti_affinity=[term])
+        b_pods = make_pods(2, cpu="2", labels={"app": "b"})
+        pods = a_pods + b_pods
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 4
+
+    def test_schedule_anyway_spread_falls_back(self):
+        from karpenter_tpu.solver import encode as enc
+        from helpers import spread_constraint
+
+        app = {"app": "soft"}
+        pods = make_pods(
+            3, labels=app,
+            spread=[
+                spread_constraint(
+                    labels.HOSTNAME, labels=app, when_unsatisfiable="ScheduleAnyway"
+                )
+            ],
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 3

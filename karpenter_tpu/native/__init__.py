@@ -80,23 +80,38 @@ def _ptr(arr: np.ndarray):
 
 def solve_core_native(
     g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+    g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc,
-    n_def, n_mask, n_avail, n_base, n_tol, n_hcnt,
+    n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     well_known,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
+    has_domains: bool = True,  # trace-time gate for the JAX twin; unused here
 ) -> Tuple[np.ndarray, ...]:
-    """Same contract as ops/solve.py::solve_core (and solve_all), on host."""
+    """Same contract as ops/solve.py::solve_core (and solve_all), on host.
+
+    ``has_domains`` is accepted for call-site symmetry with the jitted
+    kernel; the C++ core branches on g_dmode at runtime, so no gating is
+    needed."""
     lib = _load()
 
     g_count = _as(g_count, np.int32)
     g_hcap = _as(g_hcap, np.int32)
     n_hcnt = _as(n_hcnt, np.int32)
     g_req = _as(g_req, np.float32)
+    g_dmode = _as(g_dmode, np.int32)
+    g_dkey = _as(g_dkey, np.int32)
+    g_dskew = _as(g_dskew, np.int32)
+    g_dmin0 = _as(g_dmin0, np.uint8)
+    g_dprior = _as(g_dprior, np.int32)
+    g_dreg = _as(g_dreg, np.uint8)
+    g_drank = _as(g_drank, np.int32)
+    n_dzone = _as(n_dzone, np.int32)
+    n_dct = _as(n_dct, np.int32)
     g_def, g_neg, g_mask = (_as(x, np.uint8) for x in (g_def, g_neg, g_mask))
     p_def, p_neg, p_mask = (_as(x, np.uint8) for x in (p_def, p_neg, p_mask))
     p_daemon = _as(p_daemon, np.float32)
@@ -128,6 +143,8 @@ def solve_core_native(
     exist_fills = np.zeros((G, max(N, 1)), np.int32)
     claim_fills = np.zeros((G, nmax), np.int32)
     unplaced = np.zeros(G, np.int32)
+    c_dzone = np.full(nmax, -1, np.int32)
+    c_dct = np.full(nmax, -1, np.int32)
 
     lib.kt_solve(
         ctypes.c_int(G), ctypes.c_int(T), ctypes.c_int(P), ctypes.c_int(N),
@@ -135,6 +152,8 @@ def solve_core_native(
         ctypes.c_int(nmax), ctypes.c_int(zone_kid), ctypes.c_int(ct_kid),
         _ptr(g_count), _ptr(g_req), _ptr(g_def), _ptr(g_neg), _ptr(g_mask),
         _ptr(g_hcap),
+        _ptr(g_dmode), _ptr(g_dkey), _ptr(g_dskew), _ptr(g_dmin0),
+        _ptr(g_dprior), _ptr(g_dreg), _ptr(g_drank),
         _ptr(p_def), _ptr(p_neg), _ptr(p_mask), _ptr(p_daemon), _ptr(p_limit),
         _ptr(p_has_limit), _ptr(p_tol), _ptr(p_titype_ok),
         _ptr(t_def), _ptr(t_mask), _ptr(t_alloc), _ptr(t_cap),
@@ -142,9 +161,11 @@ def solve_core_native(
         _ptr(a_tzc),
         _ptr(n_def), _ptr(n_mask), _ptr(n_avail), _ptr(n_base), _ptr(n_tol),
         _ptr(n_hcnt),
+        _ptr(n_dzone), _ptr(n_dct),
         _ptr(well_known),
         _ptr(c_pool), _ptr(c_tmask), _ptr(n_open), _ptr(overflow),
         _ptr(exist_fills), _ptr(claim_fills), _ptr(unplaced),
+        _ptr(c_dzone), _ptr(c_dct),
     )
     return (
         c_pool,
@@ -154,4 +175,6 @@ def solve_core_native(
         exist_fills[:, :N],
         claim_fills,
         unplaced,
+        c_dzone,
+        c_dct,
     )

@@ -4,11 +4,13 @@
 // tables (ops/feasibility.py) + grouped first-fit-decreasing packing
 // (ops/packing.py) — over the identical dense snapshot arrays, with the same
 // tie-breaking (greedy prefix fill over existing nodes, integer water-fill
-// over open claims, highest-weight-template-first for new claims). The
-// reference's runtime is a compiled (Go) binary; this is the TPU build's
-// native runtime path: used as the host fallback when no accelerator is
-// attached, and as an independent implementation the JAX kernel is
-// parity-tested against (tests/test_native.py).
+// over open claims, highest-weight-template-first for new claims), and the
+// same topology forms (per-entity hostname caps; per-step domain quotas for
+// zone/capacity-type spread and affinity bootstrap). The reference's runtime
+// is a compiled (Go) binary; this is the TPU build's native runtime path:
+// used as the host fallback when no accelerator is attached, and as an
+// independent implementation the JAX kernel is parity-tested against
+// (tests/test_native.py).
 //
 // Scalar float math is done in float32 to match XLA's element types so the
 // two implementations agree bit-for-bit on fits counts.
@@ -26,6 +28,7 @@ using std::uint8_t;
 
 constexpr float kInf = std::numeric_limits<float>::infinity();
 constexpr int32_t kBigFit = 1 << 30;
+constexpr int32_t kBigDom = 1 << 28;  // "unbounded" domain capacity (_BIGI)
 
 // fits_count (ops/feasibility.py:68-80): identical float32 semantics.
 inline int32_t fits_count(const float* alloc, const float* base, const float* req,
@@ -84,7 +87,23 @@ inline bool req_compatible(const uint8_t* n_def, const uint8_t* n_neg,
   return req_intersect(n_def, n_neg, n_mask, p_def, p_neg, p_mask, K, V1);
 }
 
-// greedy_prefix_fill (ops/packing.py:37-40)
+// Type t has an available offering in domain slot d of the constrained axis
+// (dkey 0 = zone-major of a_tzc, 1 = capacity-type) under `other` on the
+// other axis. Callers separately require the constrained-axis mask to admit
+// d (the JAX kernel's toff = einsum(other) ∧ dom-row).
+inline bool off_in_domain(const uint8_t* az /* [V1, V1] */, int dkey, int d,
+                          const uint8_t* other, int V1) {
+  if (dkey == 0) {
+    for (int c = 0; c < V1; ++c)
+      if (az[d * V1 + c] && other[c]) return true;
+  } else {
+    for (int z = 0; z < V1; ++z)
+      if (az[z * V1 + d] && other[z]) return true;
+  }
+  return false;
+}
+
+// greedy_prefix_fill (ops/packing.py)
 inline void greedy_prefix_fill(const std::vector<int32_t>& cap, int32_t n,
                                std::vector<int32_t>& fill) {
   int32_t before = 0;
@@ -97,7 +116,7 @@ inline void greedy_prefix_fill(const std::vector<int32_t>& cap, int32_t n,
   }
 }
 
-// waterfill (ops/packing.py:43-72): identical level/deficit semantics.
+// waterfill (ops/packing.py): identical level/deficit semantics.
 inline void waterfill(const std::vector<int32_t>& npods,
                       const std::vector<int32_t>& cap, int32_t n,
                       std::vector<int32_t>& fills) {
@@ -159,6 +178,12 @@ int kt_solve(
     const int32_t* g_count, const float* g_req, const uint8_t* g_def,
     const uint8_t* g_neg, const uint8_t* g_mask,
     const int32_t* g_hcap,  // [G] per-entity hostname-topology cap
+    // domain-keyed constraint descriptors (ops/packing.py DMODE_*)
+    const int32_t* g_dmode, const int32_t* g_dkey, const int32_t* g_dskew,
+    const uint8_t* g_dmin0,
+    const int32_t* g_dprior,  // [G, V1]
+    const uint8_t* g_dreg,    // [G, V1]
+    const int32_t* g_drank,   // [G, V1]
     // templates
     const uint8_t* p_def, const uint8_t* p_neg, const uint8_t* p_mask,
     const float* p_daemon, const float* p_limit, const uint8_t* p_has_limit,
@@ -173,6 +198,7 @@ int kt_solve(
     const uint8_t* n_def, const uint8_t* n_mask, const float* n_avail,
     const float* n_base, const uint8_t* n_tol,
     const int32_t* n_hcnt,  // [N, G] prior selected-pod counts
+    const int32_t* n_dzone, const int32_t* n_dct,  // [N] domain value ids
     const uint8_t* well_known,
     // outputs
     int32_t* out_c_pool,      // [NMAX]
@@ -181,9 +207,13 @@ int kt_solve(
     uint8_t* out_overflow,    // [1]
     int32_t* out_exist_fills, // [G, N]
     int32_t* out_claim_fills, // [G, NMAX]
-    int32_t* out_unplaced     // [G]
+    int32_t* out_unplaced,    // [G]
+    int32_t* out_c_dzone,     // [NMAX] pinned zone value id (-1 = unpinned)
+    int32_t* out_c_dct        // [NMAX] pinned capacity-type value id
 ) {
   const int KV = K * V1;
+  const int NSLOT = V1 + 2;  // V1 domains + ANY + DEAD
+  const int ANY = V1, DEAD = V1 + 1;
 
   // ---- feasibility tables (ops/feasibility.py) ------------------------
   // compat_pg [P,G], type_ok_pgt [P,G,T], n_fit_pgt [P,G,T]
@@ -258,6 +288,7 @@ int kt_solve(
   std::vector<uint8_t> c_def(static_cast<size_t>(NMAX) * K, 0);
   std::vector<uint8_t> c_neg(static_cast<size_t>(NMAX) * K, 0);
   std::vector<uint8_t> c_mask(static_cast<size_t>(NMAX) * KV, 1);
+  std::vector<int32_t> c_dzone(NMAX, -1), c_dct(NMAX, -1);
   std::vector<float> pool_rem(p_limit, p_limit + static_cast<size_t>(P) * R);
   int32_t n_open = 0;
   bool overflow = false;
@@ -268,6 +299,10 @@ int kt_solve(
 
   std::vector<int32_t> exist_cap(N), exist_fill(N);
   std::vector<int32_t> claim_cap(NMAX), claim_fill(NMAX);
+  std::vector<int32_t> c_slot(NMAX);
+  std::vector<int32_t> qd(NSLOT), qrem(NSLOT);
+  std::vector<int32_t> wf_npods(NMAX), wf_cap(NMAX), wf_fill(NMAX);
+  std::vector<uint8_t> other_row(V1);
 
   for (int gi = 0; gi < G; ++gi) {
     int32_t count = g_count[gi];
@@ -281,6 +316,18 @@ int kt_solve(
     // because hostname domains have a global min of 0.
     const int32_t hc = g_hcap[gi];
 
+    // domain-keyed constraint descriptors
+    const int32_t mode = g_dmode[gi];
+    const bool dyn = mode > 0;
+    const int dkey = g_dkey[gi];
+    const int kid_sel = (dkey == 0) ? zone_kid : ct_kid;
+    const int other_kid = (dkey == 0) ? ct_kid : zone_kid;
+    const int32_t skew = g_dskew[gi];
+    const bool min0 = g_dmin0[gi];
+    const int32_t* D0 = g_dprior + static_cast<size_t>(gi) * V1;
+    const uint8_t* reg = g_dreg + static_cast<size_t>(gi) * V1;
+    const int32_t* drank = g_drank + static_cast<size_t>(gi) * V1;
+
     // ---- 1. existing nodes, fixed priority order ----
     for (int n = 0; n < N; ++n) {
       exist_cap[n] =
@@ -291,22 +338,109 @@ int kt_solve(
           exist_cap[n],
           std::max(hc - n_hcnt[static_cast<size_t>(n) * G + gi], 0));
     }
-    greedy_prefix_fill(exist_cap, count, exist_fill);
-    int32_t rem = count;
-    for (int n = 0; n < N; ++n) {
-      if (exist_fill[n] > 0) {
-        for (int r = 0; r < R; ++r)
-          exist_used[static_cast<size_t>(n) * R + r] += exist_fill[n] * req[r];
-        out_exist_fills[static_cast<size_t>(gi) * N + n] = exist_fill[n];
-        rem -= exist_fill[n];
+
+    // node domain slot on the constrained axis
+    std::vector<int32_t> nd_slot(N, ANY);
+    if (dyn) {
+      for (int n = 0; n < N; ++n) {
+        int32_t d = (dkey == 0) ? n_dzone[n] : n_dct[n];
+        nd_slot[n] = (d >= 0 && d < V1 && reg[d]) ? d : DEAD;
+      }
+    }
+
+    // ---- domain quota qd[NSLOT] (ops/packing.py step) ------------------
+    std::fill(qd.begin(), qd.end(), 0);
+    if (!dyn) {
+      qd[ANY] = count;
+    } else {
+      std::vector<int32_t> czcap(V1, 0);
+      for (int n = 0; n < N; ++n)
+        if (nd_slot[n] < V1) czcap[nd_slot[n]] += exist_cap[n];
+      // fresh_ok_d: any (template, type) feasible with an offering in d,
+      // under the template∪group masks on both axes
+      std::vector<uint8_t> fresh_ok(V1, 0);
+      for (int p = 0; p < P; ++p) {
+        const uint8_t* pm = p_mask + static_cast<size_t>(p) * KV;
+        for (int v = 0; v < V1; ++v)
+          other_row[v] = pm[other_kid * V1 + v] && gmask[other_kid * V1 + v];
+        for (int t = 0; t < T; ++t) {
+          if (!type_ok_pgt[(static_cast<size_t>(p) * G + gi) * T + t]) continue;
+          const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
+          for (int d = 0; d < V1; ++d) {
+            if (fresh_ok[d]) continue;
+            if (!(pm[kid_sel * V1 + d] && gmask[kid_sel * V1 + d])) continue;
+            if (off_in_domain(az, dkey, d, other_row.data(), V1))
+              fresh_ok[d] = 1;
+          }
+        }
+      }
+      std::vector<int32_t> realcap(V1);
+      for (int d = 0; d < V1; ++d)
+        realcap[d] =
+            std::min<int32_t>(czcap[d] + (fresh_ok[d] ? kBigDom : 0), kBigDom);
+      if (mode == 1 /* DMODE_SPREAD */) {
+        // L* = maxSkew + min over registered domains of (D0 + cap): the
+        // closed form of sequential min-count-within-maxSkew selection
+        // (topologygroup.go:205-251); minDomains pins the min to 0
+        int32_t mfloor = kBigDom;
+        for (int d = 0; d < V1; ++d)
+          if (reg[d]) mfloor = std::min(mfloor, D0[d] + realcap[d]);
+        if (min0) mfloor = 0;
+        int64_t lstar = static_cast<int64_t>(skew) + mfloor;
+        std::vector<int32_t> npods(V1), scap(V1);
+        for (int d = 0; d < V1; ++d) {
+          npods[d] = reg[d] ? D0[d] : kBigDom;
+          int64_t c = reg[d] ? std::max<int64_t>(lstar - D0[d], 0) : 0;
+          scap[d] = static_cast<int32_t>(
+              std::min<int64_t>(c, realcap[d]));
+        }
+        std::vector<int32_t> qfill(V1);
+        waterfill(npods, scap, count, qfill);
+        for (int d = 0; d < V1; ++d) qd[d] = qfill[d];
+      } else {  // DMODE_AFFINITY: bootstrap pins the group to one domain
+        int32_t d_aff = -1;
+        for (int n = 0; n < N && d_aff < 0; ++n)
+          if (exist_cap[n] >= 1 && nd_slot[n] < V1) d_aff = nd_slot[n];
+        if (d_aff < 0) {
+          int32_t best_rank = kBigDom;
+          for (int d = 0; d < V1; ++d)
+            if (fresh_ok[d] && reg[d] && drank[d] < best_rank) {
+              best_rank = drank[d];
+              d_aff = d;
+            }
+        }
+        if (d_aff >= 0) qd[d_aff] = count;
+      }
+    }
+    std::copy(qd.begin(), qd.end(), qrem.begin());
+
+    // tier-1 fill under per-domain budgets (prefix order within each slot)
+    {
+      std::vector<int32_t> placed(NSLOT, 0);
+      for (int n = 0; n < N; ++n) {
+        int32_t f = qd[nd_slot[n]] - placed[nd_slot[n]];
+        if (f < 0) f = 0;
+        if (f > exist_cap[n]) f = exist_cap[n];
+        exist_fill[n] = f;
+        placed[nd_slot[n]] += f;
+      }
+      for (int n = 0; n < N; ++n) {
+        if (exist_fill[n] > 0) {
+          for (int r = 0; r < R; ++r)
+            exist_used[static_cast<size_t>(n) * R + r] += exist_fill[n] * req[r];
+          out_exist_fills[static_cast<size_t>(gi) * N + n] = exist_fill[n];
+          qrem[nd_slot[n]] -= exist_fill[n];
+        }
       }
     }
 
     // ---- 2. open claims, least-loaded first ----
     std::vector<uint8_t> got(NMAX, 0);
+    std::vector<int32_t> percap_d(dyn ? static_cast<size_t>(NMAX) * V1 : 0, 0);
     for (int s = 0; s < NMAX; ++s) {
       claim_cap[s] = 0;
       claim_fill[s] = 0;
+      c_slot[s] = dyn ? DEAD : ANY;
       if (!c_active[s]) continue;
       // claim-vs-group key compatibility (overlap | exempt | not both
       // defined) + custom-label rule + template tolerance/compat
@@ -329,7 +463,9 @@ int kt_solve(
       compat = compat && p_tol[pp * G + gi] && compat_pg[pp * G + gi];
       if (!compat) continue;
       // per-type: options ∧ template-group table ∧ fits under load ∧
-      // offering under merged masks
+      // offering under merged masks (per admissible domain when dynamic)
+      for (int v = 0; v < V1; ++v)
+        other_row[v] = sm[other_kid * V1 + v] && gmask[other_kid * V1 + v];
       int32_t best = 0;
       for (int t = 0; t < T; ++t) {
         if (!c_tmask[static_cast<size_t>(s) * T + t]) continue;
@@ -351,15 +487,59 @@ int kt_solve(
             }
           }
         }
-        if (off && add > best) best = add;
+        if (!off) continue;
+        if (add > best) best = add;
+        if (dyn) {
+          for (int d = 0; d < V1; ++d) {
+            if (!(sm[kid_sel * V1 + d] && gmask[kid_sel * V1 + d])) continue;
+            if (off_in_domain(az, dkey, d, other_row.data(), V1)) {
+              int32_t& pc = percap_d[static_cast<size_t>(s) * V1 + d];
+              pc = std::max(pc, add);
+            }
+          }
+        }
       }
-      claim_cap[s] = std::min(best, hc);  // open claims carry no prior
+      if (dyn) {
+        // assign the claim to the admissible domain with the largest
+        // remaining quota (argmax, ties by lowest slot index)
+        int32_t best_q = -1, d_star = DEAD;
+        for (int d = 0; d < V1; ++d) {
+          if (percap_d[static_cast<size_t>(s) * V1 + d] < 1) continue;
+          if (qrem[d] < 1) continue;
+          if (qrem[d] > best_q) {
+            best_q = qrem[d];
+            d_star = d;
+          }
+        }
+        c_slot[s] = d_star;
+        claim_cap[s] =
+            (d_star < V1) ? percap_d[static_cast<size_t>(s) * V1 + d_star] : 0;
+      } else {
+        claim_cap[s] = best;
+      }
+      claim_cap[s] = std::min(claim_cap[s], hc);  // open claims carry no prior
     }
-    waterfill(c_npods, claim_cap, rem, claim_fill);
+    // per-slot water-fill with the slot's remaining quota as budget
+    for (int sl = 0; sl < NSLOT; ++sl) {
+      if (qrem[sl] <= 0) continue;
+      bool any = false;
+      for (int s = 0; s < NMAX; ++s) {
+        bool in = (c_slot[s] == sl);
+        wf_npods[s] = in ? c_npods[s] : kBigDom;
+        wf_cap[s] = in ? claim_cap[s] : 0;
+        any = any || (in && claim_cap[s] > 0);
+      }
+      if (!any) continue;
+      waterfill(wf_npods, wf_cap, qrem[sl], wf_fill);
+      for (int s = 0; s < NMAX; ++s)
+        if (wf_fill[s] > 0) {
+          claim_fill[s] = wf_fill[s];
+          qrem[sl] -= wf_fill[s];
+        }
+    }
     for (int s = 0; s < NMAX; ++s) {
       if (claim_fill[s] <= 0) continue;
       got[s] = 1;
-      rem -= claim_fill[s];
       c_npods[s] += claim_fill[s];
       for (int r = 0; r < R; ++r)
         c_used[static_cast<size_t>(s) * R + r] += claim_fill[s] * req[r];
@@ -372,16 +552,27 @@ int kt_solve(
       uint8_t* sd = c_def.data() + static_cast<size_t>(s) * K;
       uint8_t* sn = c_neg.data() + static_cast<size_t>(s) * K;
       int pp = c_pool[s];
+      const bool tighten = dyn && c_slot[s] < V1;
       for (int k = 0; k < K; ++k) {
         sd[k] = sd[k] || gdef[k];
         sn[k] = sn[k] && gneg[k];
         for (int v = 0; v < V1; ++v) sm[k * V1 + v] = sm[k * V1 + v] && gmask[k * V1 + v];
       }
+      if (tighten) {
+        // pin the claim to the selected domain (the oracle tightens node
+        // requirements to the chosen single domain, topology.go:220-242)
+        for (int v = 0; v < V1; ++v)
+          if (v != c_slot[s]) sm[kid_sel * V1 + v] = 0;
+        if (dkey == 0)
+          c_dzone[s] = c_slot[s];
+        else
+          c_dct[s] = c_slot[s];
+      }
       for (int t = 0; t < T; ++t) {
         if (!c_tmask[static_cast<size_t>(s) * T + t]) continue;
         bool keep = type_ok_pgt[(static_cast<size_t>(pp) * G + gi) * T + t];
         if (keep) {
-          // offering under the (now merged) masks
+          // offering under the (now merged, possibly pinned) masks
           bool off = false;
           const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
           for (int z = 0; z < V1 && !off; ++z) {
@@ -406,92 +597,145 @@ int kt_solve(
     }
 
     // ---- 3. new claims from highest-weight feasible template ----
-    while (rem > 0 && !overflow) {
-      int p_star = -1;
-      for (int p = 0; p < P && p_star < 0; ++p) {
-        for (int t = 0; t < T; ++t) {
-          if (!type_ok_pgt[(static_cast<size_t>(p) * G + gi) * T + t]) continue;
-          if (p_has_limit[p]) {
-            bool within = true;
-            for (int r = 0; r < R; ++r)
-              if (t_cap[t * R + r] > pool_rem[static_cast<size_t>(p) * R + r]) {
-                within = false;
-                break;
-              }
-            if (!within) continue;
-          }
-          p_star = p;
-          break;
+    // Serve one domain slot per iteration (largest remaining quota); a
+    // no-progress slot is retired so other domains still get served.
+    std::vector<uint8_t> ddead(NSLOT, 0);
+    ddead[DEAD] = 1;
+    while (!overflow) {
+      int d_sel = -1;
+      int32_t best_q = 0;
+      for (int sl = 0; sl < NSLOT; ++sl)
+        if (!ddead[sl] && qrem[sl] > best_q) {
+          best_q = qrem[sl];
+          d_sel = sl;
         }
-      }
-      if (p_star < 0) break;  // unplaceable remainder
-      int32_t n_per = 0;
-      for (int t = 0; t < T; ++t) {
-        if (!type_ok_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t])
-          continue;
-        if (p_has_limit[p_star]) {
-          bool within = true;
+      if (d_sel < 0) break;
+      const bool is_any = (d_sel == ANY);
+
+      // template/type availability in the selected domain
+      auto type_avail = [&](int p, int t) -> bool {
+        if (!type_ok_pgt[(static_cast<size_t>(p) * G + gi) * T + t])
+          return false;
+        if (p_has_limit[p]) {
           for (int r = 0; r < R; ++r)
-            if (t_cap[t * R + r] >
-                pool_rem[static_cast<size_t>(p_star) * R + r]) {
-              within = false;
-              break;
-            }
-          if (!within) continue;
+            if (t_cap[t * R + r] > pool_rem[static_cast<size_t>(p) * R + r])
+              return false;
         }
-        n_per = std::max(
-            n_per, n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t]);
+        if (!is_any) {
+          const uint8_t* pm = p_mask + static_cast<size_t>(p) * KV;
+          if (!(pm[kid_sel * V1 + d_sel] && gmask[kid_sel * V1 + d_sel]))
+            return false;
+          for (int v = 0; v < V1; ++v)
+            other_row[v] =
+                pm[other_kid * V1 + v] && gmask[other_kid * V1 + v];
+          if (!off_in_domain(a_tzc + static_cast<size_t>(t) * V1 * V1, dkey,
+                             d_sel, other_row.data(), V1))
+            return false;
+        }
+        return true;
+      };
+
+      int p_star = -1;
+      for (int p = 0; p < P && p_star < 0; ++p)
+        for (int t = 0; t < T; ++t)
+          if (type_avail(p, t)) {
+            p_star = p;
+            break;
+          }
+      if (p_star < 0) {
+        ddead[d_sel] = 1;
+        continue;
       }
-      n_per = std::min(n_per, hc);
-      int32_t n_take = std::min(rem, n_per);
-      if (n_take <= 0) break;
-      if (n_open >= NMAX) {
-        overflow = true;
-        break;
-      }
-      int slot = n_open++;
-      c_active[slot] = 1;
-      c_pool[slot] = p_star;
-      c_npods[slot] = n_take;
-      for (int r = 0; r < R; ++r)
-        c_used[static_cast<size_t>(slot) * R + r] =
-            p_daemon[static_cast<size_t>(p_star) * R + r] + n_take * req[r];
+      // one BULK of identical claims for this domain (frozen avail set),
+      // matching the JAX body: k bounded by demand, the pool-limit ledger
+      // (identical debit per claim) and the remaining slots
+      std::vector<uint8_t> avail_t(T);
+      int32_t n_per = 0;
       std::vector<float> debit(R, 0.0f);
       for (int t = 0; t < T; ++t) {
-        bool avail =
-            type_ok_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t];
-        if (avail && p_has_limit[p_star]) {
-          bool within = true;
-          for (int r = 0; r < R; ++r)
-            if (t_cap[t * R + r] >
-                pool_rem[static_cast<size_t>(p_star) * R + r]) {
-              within = false;
-              break;
-            }
-          avail = within;
-        }
-        c_tmask[static_cast<size_t>(slot) * T + t] =
-            avail &&
-            (n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t] >=
-             n_take);
-        if (avail)
-          for (int r = 0; r < R; ++r)
-            debit[r] = std::max(debit[r], t_cap[t * R + r]);
+        avail_t[t] = type_avail(p_star, t);
+        if (!avail_t[t]) continue;
+        n_per = std::max(
+            n_per, n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t]);
+        for (int r = 0; r < R; ++r)
+          debit[r] = std::max(debit[r], t_cap[t * R + r]);
       }
-      std::memcpy(c_def.data() + static_cast<size_t>(slot) * K, gdef, K);
-      std::memcpy(c_neg.data() + static_cast<size_t>(slot) * K, gneg, K);
-      std::memcpy(c_mask.data() + static_cast<size_t>(slot) * KV, gmask, KV);
+      n_per = std::min(n_per, hc);
+      if (n_per <= 0) {
+        ddead[d_sel] = 1;
+        continue;
+      }
+      const int32_t rem_d = qrem[d_sel];
+      int64_t k_limit = kBigFit;
+      if (p_has_limit[p_star]) {
+        for (int r = 0; r < R; ++r)
+          if (debit[r] > 0.0f)
+            k_limit = std::min<int64_t>(
+                k_limit,
+                static_cast<int64_t>(std::floor(
+                    pool_rem[static_cast<size_t>(p_star) * R + r] /
+                    std::max(debit[r], 1e-9f))));
+      }
+      int64_t k_want = std::min<int64_t>(
+          (rem_d + n_per - 1) / n_per, std::max<int64_t>(k_limit, 0));
+      int64_t k_slots = NMAX - n_open;
+      if (k_want > k_slots) overflow = true;
+      int64_t k = std::min(k_want, k_slots);
+      if (k <= 0) {
+        ddead[d_sel] = 1;
+        continue;
+      }
+      int32_t placed = 0;
+      for (int64_t i = 0; i < k; ++i) {
+        int32_t n_take =
+            std::min<int32_t>(rem_d - static_cast<int32_t>(i) * n_per, n_per);
+        int slot = n_open++;
+        c_active[slot] = 1;
+        c_pool[slot] = p_star;
+        c_npods[slot] = n_take;
+        for (int r = 0; r < R; ++r)
+          c_used[static_cast<size_t>(slot) * R + r] =
+              p_daemon[static_cast<size_t>(p_star) * R + r] + n_take * req[r];
+        for (int t = 0; t < T; ++t)
+          c_tmask[static_cast<size_t>(slot) * T + t] =
+              avail_t[t] &&
+              (n_fit_pgt[(static_cast<size_t>(p_star) * G + gi) * T + t] >=
+               n_take);
+        std::memcpy(c_def.data() + static_cast<size_t>(slot) * K, gdef, K);
+        std::memcpy(c_neg.data() + static_cast<size_t>(slot) * K, gneg, K);
+        std::memcpy(c_mask.data() + static_cast<size_t>(slot) * KV, gmask, KV);
+        if (dyn && !is_any) {
+          // claims opened for a dynamic group are domain-pinned from birth
+          uint8_t* sm = c_mask.data() + static_cast<size_t>(slot) * KV;
+          for (int v = 0; v < V1; ++v)
+            if (v != d_sel) sm[kid_sel * V1 + v] = 0;
+          if (dkey == 0)
+            c_dzone[slot] = d_sel;
+          else
+            c_dct[slot] = d_sel;
+        }
+        out_claim_fills[static_cast<size_t>(gi) * NMAX + slot] = n_take;
+        placed += n_take;
+      }
       if (p_has_limit[p_star])
         for (int r = 0; r < R; ++r)
-          pool_rem[static_cast<size_t>(p_star) * R + r] -= debit[r];
-      out_claim_fills[static_cast<size_t>(gi) * NMAX + slot] = n_take;
-      rem -= n_take;
+          pool_rem[static_cast<size_t>(p_star) * R + r] -=
+              debit[r] * static_cast<float>(k);
+      qrem[d_sel] -= placed;
+      if (placed == 0) ddead[d_sel] = 1;
     }
-    out_unplaced[gi] = rem;
+    int32_t left = 0;
+    for (int sl = 0; sl < NSLOT; ++sl) left += qrem[sl];
+    // pods never granted quota (domain water-fill ran out of capacity)
+    int32_t granted = 0;
+    for (int sl = 0; sl < NSLOT; ++sl) granted += qd[sl];
+    out_unplaced[gi] = (count - granted) + left;
   }
 
   std::memcpy(out_c_pool, c_pool.data(), sizeof(int32_t) * NMAX);
   std::memcpy(out_c_tmask, c_tmask.data(), sizeof(uint8_t) * NMAX * T);
+  std::memcpy(out_c_dzone, c_dzone.data(), sizeof(int32_t) * NMAX);
+  std::memcpy(out_c_dct, c_dct.data(), sizeof(int32_t) * NMAX);
   out_n_open[0] = n_open;
   out_overflow[0] = overflow ? 1 : 0;
   return overflow ? 1 : 0;

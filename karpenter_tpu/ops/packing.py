@@ -13,10 +13,29 @@ classes); each step places a whole group:
    NodePool limit ledger (subtractMax, scheduler.go:498-515) which can
    change the feasible template/type set for the next claim.
 
+Topology constraints ride the scan in two tensor forms:
+
+- **hostname-keyed** spread/anti-affinity collapse to a per-entity cap
+  (``g_hcap``): hostname domains have a global min of 0
+  (topologygroup.go:253-274), so the skew bound is just "<= maxSkew
+  selected pods per node/claim".
+- **domain-keyed** (zone / capacity-type) constraints use a per-step
+  domain-quota vector ``qd`` over the interned value slots. Because
+  cross-group constraints are demoted to the host oracle
+  (solver/encode.py:_resolve_topology), a group's domain counts only
+  change during its *own* step — priors are static inputs, no cross-step
+  carry is needed. Self-selecting spread distributes the group by
+  water-filling domains under a skew-derived level cap L* (the closed form
+  of the reference's sequential min-count-within-maxSkew selection,
+  topologygroup.go:205-251); affinity's bootstrap rule pins the whole
+  group to one domain (topologygroup.go:277-324). Non-self-selecting
+  gates and affinity-with-prior-pods reduce to mask intersections at
+  encode time and need no kernel support at all.
+
 All constraint checks are precomputed batched tables from
 ops/feasibility.py; the scan body is index arithmetic over [NMAX] slots.
-Pods with sequential topology state are not routed here (see
-solver/encode.py:is_tensorizable).
+Pods with truly sequential state (host ports, volumes, relaxation) are not
+routed here (see solver/encode.py:is_tensorizable).
 """
 
 from __future__ import annotations
@@ -28,6 +47,13 @@ import jax
 import jax.numpy as jnp
 
 from .feasibility import fits_count
+
+# domain modes (solver/encode.py:TopoSpec.dmode)
+DMODE_NONE = 0
+DMODE_SPREAD = 1
+DMODE_AFFINITY = 2
+
+_BIGI = 2**28  # "unbounded" domain capacity; keeps int32 bisection safe
 
 
 def _cumsum_excl(x, axis=-1):
@@ -50,7 +76,9 @@ def waterfill(npods, cap, n):
     remaining capacity (ties by slot index). Returns fills [NSLOTS] int32.
 
     Equivalent to the reference's per-pod re-sort by fewest pods
-    (scheduler.go:366); solved as: find the smallest water level L with
+    (scheduler.go:366) — and to its per-pod min-count domain selection for
+    topology spread (topologygroup.go:231-251) when slots are domains;
+    solved as: find the smallest water level L with
     f(L) = sum(clip(L - npods, 0, cap)) >= n by bisection, then hand the
     deficit layer out by slot index.
     """
@@ -87,16 +115,20 @@ class PackState(NamedTuple):
     c_def: jnp.ndarray  # [NMAX, K] bool
     c_neg: jnp.ndarray  # [NMAX, K] bool
     c_mask: jnp.ndarray  # [NMAX, K, V1] bool
+    c_dzone: jnp.ndarray  # [NMAX] int32 pinned zone value id (-1 = unpinned)
+    c_dct: jnp.ndarray  # [NMAX] int32 pinned capacity-type value id
     pool_rem: jnp.ndarray  # [P, R]
     n_open: jnp.ndarray  # scalar int32
     overflow: jnp.ndarray  # scalar bool
 
 
-@partial(jax.jit, static_argnames=("nmax", "zone_kid", "ct_kid"))
+@partial(jax.jit, static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains"))
 def pack(
     # groups (FFD order)
     g_count, g_req, g_def, g_neg, g_mask,
     g_hcap,  # [G] int32 per-entity cap (hostname spread/anti; 2**30 = none)
+    g_dmode, g_dkey, g_dskew, g_dmin0,  # [G] domain-constraint descriptors
+    g_dprior, g_dreg, g_drank,  # [G, V1] prior counts / registered / rank
     # precomputed feasibility tables
     compat_pg, type_ok_pgt, n_fit_pgt,  # [P,G], [P,G,T], [P,G,T]
     cap_ng,  # [N, G] existing-node capacity at t0 (compat ∧ taints)
@@ -105,21 +137,31 @@ def pack(
     # offerings zone×ct availability per type
     a_tzc,  # [T, Vz, Vc] bool
     # templates
-    p_daemon, p_limit, p_has_limit, p_tol,
+    p_mask, p_daemon, p_limit, p_has_limit, p_tol,
     # existing nodes
     n_avail, n_base,
     n_hcnt,  # [N, G] int32 prior selected-pod counts (hostname topology)
+    n_dzone, n_dct,  # [N] int32 zone / capacity-type value id (-1 = none)
     well_known,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
+    has_domains: bool = True,
 ):
     """Run the grouped-FFD scan. Returns per-group placement matrices and the
-    final claim state for decoding."""
+    final claim state for decoding.
+
+    ``has_domains`` (static) gates the domain-quota machinery: when the host
+    proves no group carries a domain-keyed constraint (all g_dmode == 0),
+    the per-domain offering tensors and quota logic are traced out entirely,
+    keeping the topology-free hot path at its original per-step cost."""
     P, G, T = type_ok_pgt.shape
     N = n_avail.shape[0]
     R = t_alloc.shape[1]
     K, V1 = g_mask.shape[1], g_mask.shape[2]
+    # domain slots: V1 real domains + ANY (unconstrained groups) + DEAD
+    NSLOT = V1 + 2
+    ANY, DEAD = V1, V1 + 1
 
     a_tzc_f = a_tzc.astype(jnp.float32)
 
@@ -133,31 +175,52 @@ def pack(
         c_def=jnp.zeros((nmax, K), bool),
         c_neg=jnp.zeros((nmax, K), bool),
         c_mask=jnp.ones((nmax, K, V1), bool),
+        c_dzone=jnp.full((nmax,), -1, jnp.int32),
+        c_dct=jnp.full((nmax,), -1, jnp.int32),
         pool_rem=p_limit,
         n_open=jnp.int32(0),
         overflow=jnp.bool_(False),
     )
-
-    def claim_offering_ok_per_type(zc_mask, cc_mask, tmask_unused=None):
-        """off[t] for every claim given its zone/ct masks [NMAX, V1]."""
-        # einsum over (claims, types, zone-values, ct-values)
-        vz = a_tzc.shape[1]
-        vc = a_tzc.shape[2]
-        z = zc_mask[:, :vz].astype(jnp.float32)
-        c = cc_mask[:, :vc].astype(jnp.float32)
-        return jnp.einsum("nz,tzc,nc->nt", z, a_tzc_f, c) > 0
 
     def step(state: PackState, xs):
         (gi,) = xs
         count = g_count[gi]
         req = g_req[gi]
         gdef, gneg, gmask = g_def[gi], g_neg[gi], g_mask[gi]
-        # hostname-topology per-entity cap: a hostname domain's global min
-        # is 0 (topologygroup.go:253-274), so spread's skew bound collapses
-        # to "<= maxSkew selected pods per node"; anti-affinity is the cap=1
-        # case (empty-domain rule, topologygroup.go:340-366). Existing nodes
-        # deduct pods already counted against the constraint.
         hcap = g_hcap[gi]
+        mode = g_dmode[gi]
+        dyn = mode > 0
+        dkey = g_dkey[gi]  # 0 = zone axis, 1 = capacity-type axis
+        kid_sel = jnp.where(dkey == 0, zone_kid, ct_kid)
+        skew = g_dskew[gi]
+        min0 = g_dmin0[gi]
+        D0 = g_dprior[gi]  # [V1]
+        reg = g_dreg[gi]  # [V1]
+        drank = g_drank[gi]  # [V1]
+
+        gz = gmask[zone_kid]  # [V1]
+        gc = gmask[ct_kid]
+        cz = jnp.take(state.c_mask, zone_kid, axis=1) & gz[None, :]  # [NMAX,V1]
+        cc = jnp.take(state.c_mask, ct_kid, axis=1) & gc[None, :]
+
+        if has_domains:
+            # ---- per-domain offering availability ----------------------
+            # For each claim/template and type: is an offering available in
+            # domain slot d of the constrained axis, under the entity's
+            # mask on the OTHER axis (offering_ok resolved per domain).
+            av_z = jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_tzc_f) > 0
+            av_c = jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_tzc_f) > 0
+            toff_nt = jnp.where(
+                dkey == 0, av_z & cz[:, None, :], av_c & cc[:, None, :]
+            )  # [NMAX, T, V1]
+
+            pz = p_mask[:, zone_kid, :] & gz[None, :]  # [P, V1]
+            pc = p_mask[:, ct_kid, :] & gc[None, :]
+            pav_z = jnp.einsum("pc,tzc->ptz", pc.astype(jnp.float32), a_tzc_f) > 0
+            pav_c = jnp.einsum("pz,tzc->ptc", pz.astype(jnp.float32), a_tzc_f) > 0
+            toff_pt = jnp.where(
+                dkey == 0, pav_z & pz[:, None, :], pav_c & pc[:, None, :]
+            )  # [P, T, V1]
 
         # ---- 1. existing nodes, fixed priority order ----
         exist_cap = jnp.where(
@@ -166,9 +229,83 @@ def pack(
             0,
         )
         exist_cap = jnp.minimum(exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0))
-        exist_fill = greedy_prefix_fill(exist_cap, count)
+
+        if has_domains:
+            # node domain slot on the constrained axis
+            nd_raw = jnp.where(dkey == 0, n_dzone, n_dct)  # [N]
+            nd_ok = (nd_raw >= 0) & jnp.take(reg, jnp.clip(nd_raw, 0, V1 - 1))
+            nd_slot = jnp.where(dyn, jnp.where(nd_ok, nd_raw, DEAD), ANY)
+            nd_oh = jax.nn.one_hot(nd_slot, NSLOT, dtype=jnp.int32)  # [N, NSLOT]
+
+            # ---- domain quota qd[NSLOT] --------------------------------
+            czcap_exist = jnp.sum(exist_cap[:, None] * nd_oh, axis=0)[:V1]
+            fresh_ok_d = jnp.any(
+                type_ok_pgt[:, gi, :, None] & toff_pt, axis=(0, 1)
+            )  # [V1]
+            realcap = jnp.minimum(
+                czcap_exist + jnp.where(fresh_ok_d, _BIGI, 0), _BIGI
+            )
+            # SPREAD: closed form of sequential min-count-within-maxSkew.
+            # The global min can only rise while low domains keep absorbing
+            # pods; a domain that saturates at E^max = D0 + cap pins the
+            # min, so every placement level l must satisfy
+            # l <= E^max_z + maxSkew for all registered domains z
+            # (minDomains pins the min to 0 instead, topologygroup.go:270-273).
+            emax = jnp.where(reg, D0 + realcap, _BIGI)
+            mfloor = jnp.where(min0, 0, jnp.min(emax))
+            lstar = skew + mfloor
+            scap = jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0)
+            q_spread = waterfill(jnp.where(reg, D0, _BIGI), scap, count)  # [V1]
+
+            # AFFINITY bootstrap: all pods pin to ONE viable domain — the
+            # first fitting existing node's domain (the oracle walks nodes
+            # in priority order), else the lowest-rank (sorted-first)
+            # fresh-feasible domain (topologygroup.go:277-324).
+            if N:
+                n_elig = (exist_cap >= 1) & (nd_slot < V1)
+                has_exist = jnp.any(n_elig)
+                first_n = jnp.argmax(n_elig)
+                d_exist = jnp.clip(nd_raw[first_n], 0, V1 - 1)
+            else:
+                has_exist = jnp.bool_(False)
+                d_exist = jnp.int32(0)
+            fresh_feas = fresh_ok_d & reg
+            d_fresh = jnp.argmin(jnp.where(fresh_feas, drank, _BIGI))
+            aff_feasible = has_exist | jnp.any(fresh_feas)
+            d_aff = jnp.where(has_exist, d_exist, d_fresh)
+            q_aff = jnp.where(
+                aff_feasible,
+                jax.nn.one_hot(d_aff, V1, dtype=jnp.int32) * count,
+                jnp.zeros((V1,), jnp.int32),
+            )
+
+            q_dom = jnp.where(
+                mode == DMODE_SPREAD,
+                q_spread,
+                jnp.where(mode == DMODE_AFFINITY, q_aff, 0),
+            )
+            qd = (
+                jnp.zeros((NSLOT,), jnp.int32)
+                .at[:V1]
+                .set(jnp.where(dyn, q_dom, 0))
+                .at[ANY]
+                .set(jnp.where(dyn, 0, count))
+            )
+
+            # tier-1 fill under per-domain budgets: within each domain slot
+            # the prefix-cumsum preserves node priority order; for
+            # unconstrained groups every node sits in ANY and this is plain
+            # greedy_prefix_fill
+            pre = _cumsum_excl(exist_cap[:, None] * nd_oh, axis=0)  # [N, NSLOT]
+            pre_own = jnp.sum(pre * nd_oh, axis=1)  # [N]
+            budget = qd[nd_slot]
+            exist_fill = jnp.clip(budget - pre_own, 0, exist_cap)
+            qrem = qd - jnp.sum(exist_fill[:, None] * nd_oh, axis=0)
+        else:
+            qd = jnp.zeros((NSLOT,), jnp.int32).at[ANY].set(count)
+            exist_fill = greedy_prefix_fill(exist_cap, count)
+            qrem = qd.at[ANY].add(-jnp.sum(exist_fill))
         exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
-        rem = count - jnp.sum(exist_fill)
 
         # ---- 2. open claims, least-loaded first ----
         # claim-level compatibility with the group
@@ -180,6 +317,7 @@ def pack(
         )
         claim_compat = jnp.all(key_ok, axis=-1) & custom_ok
         claim_compat &= p_tol[state.c_pool, gi] & compat_pg[state.c_pool, gi]
+        claim_live = state.c_active & claim_compat
 
         # per-type feasibility on each claim: current options ∧ (template ∪
         # group) table ∧ fits under current load ∧ offering under merged masks
@@ -188,47 +326,113 @@ def pack(
         add_fit = fits_count(
             t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
         )  # [NMAX, T]
-        off = claim_offering_ok_per_type(
-            merged_mask[:, zone_kid, :], merged_mask[:, ct_kid, :]
-        )
+        if has_domains:
+            off = jnp.any(toff_nt, axis=-1)  # [NMAX, T] any admissible domain
+        else:
+            # joint zone×ct offering admissibility, one einsum
+            off = (
+                jnp.einsum(
+                    "nz,tzc,nc->nt",
+                    cz.astype(jnp.float32), a_tzc_f, cc.astype(jnp.float32),
+                )
+                > 0
+            )
         tm = tm & off & (add_fit >= 1)
-        claim_cap = jnp.where(
-            state.c_active & claim_compat, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0
-        )
+
+        cap_any = jnp.where(claim_live, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0)
+        if has_domains:
+            # per-claim per-domain capacity, and a single domain assignment
+            # per claim (the admissible domain with the largest remaining
+            # quota)
+            percap = jnp.max(
+                jnp.where(tm[:, :, None] & toff_nt, add_fit[:, :, None], 0), axis=1
+            )  # [NMAX, V1]
+            adm = claim_live[:, None] & (percap >= 1) & (qrem[:V1] > 0)[None, :]
+            d_star = jnp.argmax(jnp.where(adm, qrem[:V1][None, :], -1), axis=1)
+            c_slot = jnp.where(
+                dyn, jnp.where(jnp.any(adm, axis=1), d_star, DEAD), ANY
+            )  # [NMAX]
+            cap_dom = jnp.take_along_axis(percap, d_star[:, None], axis=1)[:, 0]
+            claim_cap = jnp.where(dyn, jnp.where(c_slot < V1, cap_dom, 0), cap_any)
+        else:
+            c_slot = jnp.full((nmax,), ANY, jnp.int32)
+            claim_cap = cap_any
         claim_cap = jnp.minimum(claim_cap, hcap)  # open claims carry no prior
-        claim_fill = waterfill(state.c_npods, claim_cap, rem)
-        rem = rem - jnp.sum(claim_fill)
+
+        def wf_slot(slot_idx, slot_budget):
+            m = c_slot == slot_idx
+            return waterfill(
+                jnp.where(m, state.c_npods, _BIGI),
+                jnp.where(m, claim_cap, 0),
+                slot_budget,
+            )
+
+        fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)  # [NSLOT, NMAX]
+        claim_fill = jnp.sum(fills_sd, axis=0)  # each claim in exactly one slot
+        qrem = qrem - jnp.sum(fills_sd, axis=1)
 
         got = claim_fill > 0
         c_used = state.c_used + claim_fill[:, None] * req[None, :]
         c_npods = state.c_npods + claim_fill
         c_def = state.c_def | (got[:, None] & gdef[None, :])
         c_neg = jnp.where(got[:, None], state.c_neg & gneg[None, :], state.c_neg)
-        c_mask = jnp.where(got[:, None, None], merged_mask, state.c_mask)
-        # surviving types: previous options ∧ group table ∧ still fits load
         still_fits = jnp.all(t_alloc[None, :, :] >= c_used[:, None, :], axis=-1)
-        c_tmask = jnp.where(
-            got[:, None],
-            state.c_tmask & type_ok_pgt[state.c_pool, gi, :] & off & still_fits,
-            state.c_tmask,
-        )
+        surv = type_ok_pgt[state.c_pool, gi, :] & off & still_fits
+        if has_domains:
+            # dynamic groups pin the claim to the selected domain (the
+            # oracle tightens the node requirement to the chosen single
+            # domain, topology.go:220-242): AND the constrained-axis mask
+            # row down to it; surviving types also need offerings there
+            tighten = dyn & got & (c_slot < V1)
+            d_oh = jax.nn.one_hot(
+                jnp.clip(c_slot, 0, V1 - 1), V1, dtype=bool
+            )  # [NMAX, V1]
+            krow = jax.nn.one_hot(kid_sel, K, dtype=bool)  # [K]
+            tight_mask = merged_mask & (~krow[None, :, None] | d_oh[:, None, :])
+            c_mask = jnp.where(
+                got[:, None, None],
+                jnp.where(tighten[:, None, None], tight_mask, merged_mask),
+                state.c_mask,
+            )
+            toff_at = jnp.take_along_axis(
+                toff_nt, jnp.clip(c_slot, 0, V1 - 1)[:, None, None], axis=2
+            )[..., 0]  # [NMAX, T]
+            surv = surv & jnp.where(tighten[:, None], toff_at, True)
+            pin = jnp.clip(c_slot, 0, V1 - 1)
+            c_dzone2 = jnp.where(tighten & (dkey == 0), pin, state.c_dzone)
+            c_dct2 = jnp.where(tighten & (dkey == 1), pin, state.c_dct)
+        else:
+            c_mask = jnp.where(got[:, None, None], merged_mask, state.c_mask)
+            c_dzone2, c_dct2 = state.c_dzone, state.c_dct
+        c_tmask = jnp.where(got[:, None], state.c_tmask & surv, state.c_tmask)
 
         # ---- 3. new claims from highest-weight feasible template ----
-        # Each iteration opens a BULK of k identical claims of the chosen
-        # template (the reference opens one node per failed pod,
-        # scheduler.go:375-423; identical claims commute, so opening the
-        # whole run at once is equivalent and keeps the while-trip count at
-        # O(templates), not O(nodes)). The per-claim pool-limit debit is
-        # identical for every claim in the bulk, so limits clamp k directly.
+        # Each iteration serves ONE domain slot (the largest remaining
+        # quota) and opens a BULK of k identical claims of the chosen
+        # template there (identical claims commute, so opening the run at
+        # once matches the reference's one-node-per-failed-pod loop,
+        # scheduler.go:375-423, with a while-trip count of
+        # O(templates × domains), not O(nodes)).
         def body(carry):
-            st, rem, fills = carry
+            st, qrem, fills, ddead = carry
+            d_sel = jnp.argmax(jnp.where(ddead, -1, qrem))
+            rem_d = qrem[d_sel]
+            is_any = d_sel == ANY
+            if has_domains:
+                tdok = jnp.where(
+                    is_any,
+                    jnp.ones((P, T), bool),
+                    toff_pt[:, :, jnp.clip(d_sel, 0, V1 - 1)],
+                )
+            else:
+                tdok = jnp.ones((P, T), bool)
             # feasible types per template under the remaining pool limits
             within_limits = jnp.where(
                 p_has_limit[:, None],
                 jnp.all(t_cap[None, :, :] <= st.pool_rem[:, None, :], axis=-1),
                 True,
             )  # [P, T]
-            avail = type_ok_pgt[:, gi, :] & within_limits  # [P, T]
+            avail = type_ok_pgt[:, gi, :] & within_limits & tdok  # [P, T]
             feas_p = jnp.any(avail, axis=-1)
             p_star = jnp.argmax(feas_p)  # first True in weight order
             any_feasible = jnp.any(feas_p)
@@ -254,7 +458,7 @@ def pack(
                 jnp.inf,
             )
             k_want = jnp.minimum(
-                jnp.ceil(rem / jnp.maximum(n_per, 1)).astype(jnp.int32),
+                jnp.ceil(rem_d / jnp.maximum(n_per, 1)).astype(jnp.int32),
                 jnp.where(jnp.isinf(k_limit), 2**30, k_limit).astype(jnp.int32),
             )
             slot = st.n_open
@@ -266,12 +470,30 @@ def pack(
             # per-slot takes: full n_per runs, last claim partial
             slots = jnp.arange(nmax, dtype=jnp.int32)
             in_bulk = (slots >= slot) & (slots < slot + k)
-            takes = jnp.clip(rem - (slots - slot) * n_per, 0, n_per)
+            takes = jnp.clip(rem_d - (slots - slot) * n_per, 0, n_per)
             takes = jnp.where(in_bulk, takes, 0)  # [NMAX]
             placed = jnp.sum(takes)
 
             tmask_new = avail[p_star] & (n_fit_pgt[p_star, gi] >= takes[:, None])
             used_new = p_daemon[p_star][None, :] + takes[:, None].astype(jnp.float32) * req[None, :]
+            if has_domains:
+                # claims opened for a dynamic group are domain-pinned at birth
+                kr = jax.nn.one_hot(kid_sel, K, dtype=bool)
+                open_mask = jnp.where(
+                    dyn & ~is_any,
+                    gmask
+                    & (
+                        ~kr[:, None]
+                        | jax.nn.one_hot(
+                            jnp.clip(d_sel, 0, V1 - 1), V1, dtype=bool
+                        )[None, :]
+                    ),
+                    gmask,
+                )  # [K, V1]
+                d_pin = jnp.where(dyn & ~is_any, jnp.clip(d_sel, 0, V1 - 1), -1)
+            else:
+                open_mask = gmask
+                d_pin = jnp.int32(-1)
             write = lambda arr, val: jnp.where(
                 _bcast(in_bulk, arr.ndim), val, arr
             )
@@ -288,27 +510,26 @@ def pack(
                 c_tmask=write(st.c_tmask, tmask_new),
                 c_def=write(st.c_def, gdef[None, :]),
                 c_neg=write(st.c_neg, gneg[None, :]),
-                c_mask=write(st.c_mask, gmask[None, :, :]),
+                c_mask=write(st.c_mask, open_mask[None, :, :]),
+                c_dzone=write(
+                    st.c_dzone, jnp.where(dkey == 0, d_pin, -1)
+                ),
+                c_dct=write(st.c_dct, jnp.where(dkey == 1, d_pin, -1)),
                 pool_rem=pool_rem,
                 n_open=slot + k,
                 overflow=st.overflow
                 | (any_feasible & (n_per > 0) & (k_want > k_slots)),
             )
             fills = fills + takes
-            rem = rem - placed
-            return st, rem, fills
+            qrem = qrem.at[d_sel].add(-placed)
+            # a no-progress iteration means this domain has no feasible
+            # template left; retire it so other domains still get served
+            ddead = ddead.at[d_sel].set(ddead[d_sel] | (placed == 0))
+            return st, qrem, fills, ddead
 
-        # loop while rem>0 and the last iteration made progress; a stuck
-        # iteration means no feasible template remains (those pods error out)
         def cond2(carry):
-            st, rem, fills, stuck = carry
-            return (rem > 0) & ~st.overflow & ~stuck
-
-        def body2(carry):
-            st, rem, fills, _ = carry
-            st2, rem2, fills2 = body((st, rem, fills))
-            stuck = rem2 == rem  # no progress: unplaceable or overflow
-            return st2, rem2, fills2, stuck
+            st, qrem, fills, ddead = carry
+            return jnp.any((qrem > 0) & ~ddead) & ~st.overflow
 
         new_state = state._replace(
             exist_used=exist_used,
@@ -318,11 +539,15 @@ def pack(
             c_neg=c_neg,
             c_mask=c_mask,
             c_tmask=c_tmask,
+            c_dzone=c_dzone2,
+            c_dct=c_dct2,
         )
-        new_state, rem, claim_fill, _ = jax.lax.while_loop(
-            cond2, body2, (new_state, rem, claim_fill, jnp.bool_(False))
+        ddead0 = jnp.zeros((NSLOT,), bool).at[DEAD].set(True)
+        new_state, qrem, claim_fill, _ = jax.lax.while_loop(
+            cond2, body, (new_state, qrem, claim_fill, ddead0)
         )
-        return new_state, (exist_fill, claim_fill, rem)
+        unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
+        return new_state, (exist_fill, claim_fill, unplaced)
 
     state, (exist_fills, claim_fills, unplaced) = jax.lax.scan(
         step, state, (jnp.arange(G),)

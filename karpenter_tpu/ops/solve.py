@@ -20,15 +20,17 @@ from .packing import pack
 
 def solve_core(
     g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+    g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc,
-    n_def, n_mask, n_avail, n_base, n_tol, n_hcnt,
+    n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     well_known,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
+    has_domains: bool = True,
 ):
     compat_pg, type_ok, n_fit = fresh_claim_feasibility(
         g_def, g_neg, g_mask, g_req,
@@ -51,17 +53,20 @@ def solve_core(
     state, exist_fills, claim_fills, unplaced = pack(
         g_count, g_req, g_def, g_neg, g_mask,
         g_hcap,
+        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
         compat_pg, type_ok, n_fit,
         cap_ng,
         t_alloc, t_cap,
         a_tzc,
-        p_daemon, p_limit, p_has_limit, p_tol,
+        p_mask, p_daemon, p_limit, p_has_limit, p_tol,
         n_avail, n_base,
         n_hcnt,
+        n_dzone, n_dct,
         well_known,
         nmax=nmax,
         zone_kid=zone_kid,
         ct_kid=ct_kid,
+        has_domains=has_domains,
     )
     return (
         state.c_pool,
@@ -71,17 +76,21 @@ def solve_core(
         exist_fills,
         claim_fills,
         unplaced,
+        state.c_dzone,
+        state.c_dct,
     )
 
 
-solve_all = jax.jit(solve_core, static_argnames=("nmax", "zone_kid", "ct_kid"))
+solve_all = jax.jit(
+    solve_core, static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains")
+)
 
 # MSB-first bit weights, matching numpy's unpackbits(bitorder="big")
 _BIT_WEIGHTS = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
 
 
 def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
-                      fills_dtype=jnp.int32):
+                      has_domains: bool = True, fills_dtype=jnp.int32):
     """solve_core with a wire-compact output layout.
 
     The axon tunnel charges ~60 ms fixed latency per readback plus
@@ -92,8 +101,9 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
     is static per snapshot).
     """
     (c_pool, c_tmask, n_open, overflow,
-     exist_fills, claim_fills, unplaced) = solve_core(
-        *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid)
+     exist_fills, claim_fills, unplaced, c_dzone, c_dct) = solve_core(
+        *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid,
+        has_domains=has_domains)
     n, t = c_tmask.shape
     t_pad = -(-t // 8) * 8
     padded = jnp.pad(c_tmask, ((0, 0), (0, t_pad - t))).reshape(n, t_pad // 8, 8)
@@ -106,10 +116,12 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
         exist_fills.astype(fills_dtype),
         claim_fills.astype(fills_dtype),
         unplaced,
+        c_dzone.astype(jnp.int16),
+        c_dct.astype(jnp.int16),
     )
 
 
 solve_all_packed = jax.jit(
     solve_core_packed,
-    static_argnames=("nmax", "zone_kid", "ct_kid", "fills_dtype"),
+    static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains", "fills_dtype"),
 )

@@ -62,6 +62,13 @@ def snapshot_shardings(mesh) -> Tuple:
         g,  # g_neg [G, K]
         g,  # g_mask [G, K, V1]
         g,  # g_hcap [G]
+        g,  # g_dmode [G]
+        g,  # g_dkey [G]
+        g,  # g_dskew [G]
+        g,  # g_dmin0 [G]
+        g,  # g_dprior [G, V1]
+        g,  # g_dreg [G, V1]
+        g,  # g_drank [G, V1]
         rep,  # p_def
         rep,  # p_neg
         rep,  # p_mask
@@ -84,11 +91,15 @@ def snapshot_shardings(mesh) -> Tuple:
         rep,  # n_base
         S(None, "data"),  # n_tol [N, G]
         S(None, "data"),  # n_hcnt [N, G]
+        rep,  # n_dzone [N]
+        rep,  # n_dct [N]
         rep,  # well_known [K]
     )
 
 
-def sharded_solve_fn(mesh, nmax: int, zone_kid: int, ct_kid: int):
+def sharded_solve_fn(
+    mesh, nmax: int, zone_kid: int, ct_kid: int, has_domains: bool = True
+):
     """The full solve step jitted over the mesh. Group/type-sharded inputs,
     replicated outputs; XLA/GSPMD inserts the ICI collectives."""
     import jax
@@ -96,7 +107,13 @@ def sharded_solve_fn(mesh, nmax: int, zone_kid: int, ct_kid: int):
     from ..ops.solve import solve_core
 
     return jax.jit(
-        partial(solve_core, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid),
+        partial(
+            solve_core,
+            nmax=nmax,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+            has_domains=has_domains,
+        ),
         in_shardings=snapshot_shardings(mesh),
         out_shardings=jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec()

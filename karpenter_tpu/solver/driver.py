@@ -25,7 +25,7 @@ import numpy as np
 from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api.objects import NodePool, Pod
-from ..api.requirements import Requirements
+from ..api.requirements import Operator, Requirement, Requirements
 from ..cloudprovider import types as cp
 from ..scheduling.scheduler import Results, Scheduler
 from ..scheduling.template import NodeClaimTemplate
@@ -207,7 +207,13 @@ class TpuSolver:
             a_tzc = cache[avail_key] = self._offering_availability(snap)
         fit = self._fit_matrix(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
-        statics = dict(zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
+        statics = dict(
+            zone_kid=snap.zone_kid,
+            ct_kid=snap.ct_kid,
+            # static gate: topology-free batches trace out the per-domain
+            # offering tensors and quota machinery entirely
+            has_domains=bool((snap.g_dmode > 0).any()),
+        )
         args = snap.solve_args(a_tzc)
 
         if self.config.backend == "native":
@@ -240,7 +246,7 @@ class TpuSolver:
                     *args, nmax=nmax, fills_dtype=fills_dtype, **statics
                 )
                 (c_pool, packed, n_open, overflow,
-                 exist_fills, claim_fills, unplaced) = [
+                 exist_fills, claim_fills, unplaced, c_dzone, c_dct) = [
                     np.asarray(x) for x in jax.device_get(out)
                 ]
                 c_tmask = np.unpackbits(packed, axis=1)[:, :n_types].astype(bool)
@@ -248,6 +254,7 @@ class TpuSolver:
                     c_pool.astype(np.int32), c_tmask, n_open, overflow,
                     exist_fills.astype(np.int32),
                     claim_fills.astype(np.int32), unplaced,
+                    c_dzone.astype(np.int32), c_dct.astype(np.int32),
                 )
 
         else:
@@ -258,12 +265,13 @@ class TpuSolver:
 
         while True:
             (c_pool, c_tmask, n_open, overflow,
-             exist_fills, claim_fills, unplaced) = call(nmax)
+             exist_fills, claim_fills, unplaced, c_dzone, c_dct) = call(nmax)
             if not overflow:
                 break
             nmax *= 2
         return self._decode(
-            snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills, unplaced
+            snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills,
+            unplaced, c_dzone, c_dct,
         )
 
     def _fit_matrix(self, snap: enc.EncodedSnapshot) -> np.ndarray:
@@ -302,8 +310,13 @@ class TpuSolver:
         retry doubles NMAX in that case."""
         n_fit = np.where(np.isfinite(fit), fit, 0)
         best = np.maximum(np.minimum(n_fit.max(axis=1), snap.g_hcap), 1)
+        # domain-constrained groups open claims per domain (zonal spread
+        # water-fills across zones), so each may leave one partial claim per
+        # registered domain instead of one overall
+        extra = int(snap.g_dreg[snap.g_dmode > 0].sum()) if len(snap.groups) else 0
         return enc._next_pow2(
-            int(np.ceil(snap.g_count / best).sum()) + len(snap.groups) + 8, floor=8
+            int(np.ceil(snap.g_count / best).sum()) + len(snap.groups) + extra + 8,
+            floor=8,
         )
 
     def _offering_availability(self, snap: enc.EncodedSnapshot) -> np.ndarray:
@@ -337,6 +350,8 @@ class TpuSolver:
         exist_fills: np.ndarray,  # [G, N]
         claim_fills: np.ndarray,  # [G, NMAX]
         unplaced: np.ndarray,  # [G]
+        c_dzone: Optional[np.ndarray] = None,  # [NMAX] pinned zone value ids
+        c_dct: Optional[np.ndarray] = None,  # [NMAX] pinned capacity-type ids
     ) -> Tuple[List[DecodedClaim], Dict[str, object]]:
         self._cursors = {}
 
@@ -369,6 +384,22 @@ class TpuSolver:
             claim = DecodedClaim(
                 nct, [], options, Requirements(*nct.requirements.values())
             )
+            # domain-pinned claims (zonal spread / affinity bootstrap) carry
+            # the selected domain as a concrete requirement so the created
+            # node lands there (the oracle tightens the in-flight claim the
+            # same way, topology.go:220-242)
+            for pins, key in (
+                (c_dzone, labels_mod.TOPOLOGY_ZONE),
+                (c_dct, labels_mod.CAPACITY_TYPE_LABEL_KEY),
+            ):
+                if pins is None or pins[slot] < 0:
+                    continue
+                kid = snap.vocab.key_ids[key]
+                claim.requirements.add(
+                    Requirement(
+                        key, Operator.IN, [snap.vocab.values[kid][int(pins[slot])]]
+                    )
+                )
             claim_by_slot[slot] = claim
             claims.append(claim)
         for gi, slot in zip(*np.nonzero(claim_fills)):

@@ -27,15 +27,25 @@ from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api import taints as taints_mod
 from ..api.objects import Pod
-from ..api.requirements import Requirements, pod_requirements
+from ..api.requirements import Operator, Requirement, Requirements, pod_requirements
 from ..cloudprovider import types as cp
 from ..scheduling.template import NodeClaimTemplate
-from ..scheduling.topology import TopologyType
+from ..scheduling.topology import MAX_SKEW_UNBOUNDED, TopologyType
 from .vocab import Vocab, _next_pow2
 
 _MEMORY_LIKE = ("memory", "storage", "hugepages")
 
 HCAP_NONE = 2**30  # sentinel: no per-entity topology cap
+
+# domain-constraint modes for the kernel's quota machinery (ops/packing.py)
+DMODE_NONE = 0
+DMODE_SPREAD = 1
+DMODE_AFFINITY = 2
+
+# topology keys whose domains are interned in the offering vocabulary and
+# therefore ride the TPU as a dense domain axis (solver/vocab.py)
+DOMAIN_KEYS = (labels_mod.TOPOLOGY_ZONE, labels_mod.CAPACITY_TYPE_LABEL_KEY)
+_DRANK_NONE = 2**28
 
 
 def _unit_divisor(resource_name: str) -> int:
@@ -63,6 +73,28 @@ def quantize_capacity(rl: res.ResourceList, names: Sequence[str]) -> np.ndarray:
     return out
 
 
+def _node_single_value(en, key: str) -> Optional[str]:
+    """The node's concrete value for a label key, if single-valued."""
+    if not en.requirements.has(key):
+        return None
+    r = en.requirements.get(key)
+    if r.complement or len(r.values) != 1:
+        return None
+    return next(iter(r.values))
+
+
+def _observe_node_domains(vocab: "Vocab", en) -> None:
+    for key in DOMAIN_KEYS:
+        v = _node_single_value(en, key)
+        if v is not None:
+            vocab.value_id(key, v)
+
+
+def _node_domain_id(vocab: "Vocab", en, key: str) -> int:
+    v = _node_single_value(en, key)
+    return vocab.value_id(key, v) if v is not None else -1
+
+
 @dataclass
 class TopoSpec:
     """Tensorized topology state for one pod group.
@@ -75,12 +107,27 @@ class TopoSpec:
       so "count+1-min <= maxSkew" is just "<= maxSkew pods of this group per
       node/claim"; self anti-affinity is the maxSkew=1 case of the same rule
       (empty-domain selection, topologygroup.go:340-366).
+    - domain-keyed (zone / capacity-type) constraints become a per-group
+      descriptor over the interned value slots: self-selecting spread
+      (DMODE_SPREAD) carries maxSkew + priors + the registered universe for
+      the kernel's quota water-fill (topologygroup.go:205-251); affinity
+      with no compatible placed pods (DMODE_AFFINITY) triggers the
+      bootstrap single-domain rule (topologygroup.go:277-324).
+      Non-self-selecting gates and affinity-with-priors need no kernel
+      state at all — they intersect the group's requirement mask in
+      _resolve_topology.
     - prior counts come from cluster pods already selected by the
-      constraint (topology.go:322-420), keyed by node name.
+      constraint (topology.go:322-420), keyed by node name / domain value.
     """
 
     host_cap: Optional[int] = None  # per-entity cap; None = unconstrained
     host_counts: Dict[str, int] = field(default_factory=dict)  # node -> prior
+    dmode: int = DMODE_NONE
+    dkey: Optional[str] = None  # TOPOLOGY_ZONE or CAPACITY_TYPE_LABEL_KEY
+    dskew: int = 0
+    dmin0: bool = False  # minDomains unsatisfied: global min pinned to 0
+    dprior: Dict[str, int] = field(default_factory=dict)  # domain -> count
+    dreg: frozenset = frozenset()  # registered ∧ pod-admissible domains
 
 
 @dataclass
@@ -150,27 +197,40 @@ def is_tensorizable(pod: Pod, allow_topology: bool = False) -> bool:
     """Pods the TPU fast path handles; the rest route to the host oracle.
 
     ``allow_topology`` admits the topology shapes the kernel models —
-    hostname-keyed spread / anti-affinity (per-entity caps) — subject to
-    the global cross-group checks in partition_and_group (a Topology
-    context is required for those). Everything else with sequential state
-    (host ports, volumes, preference relaxation, Gt/Lt) stays host-side."""
+    hostname-keyed spread / anti-affinity (per-entity caps) and zone- or
+    capacity-type-keyed spread / pod-affinity (domain quotas / mask gates)
+    — subject to the global cross-group checks in partition_and_group (a
+    Topology context is required for those). Everything else with
+    sequential state (host ports, volumes, preference relaxation, Gt/Lt)
+    stays host-side."""
     spec = pod.spec
-    if spec.pod_affinity:
-        return False
-    if not allow_topology and (spec.topology_spread_constraints or spec.pod_anti_affinity):
+    if not allow_topology and (
+        spec.topology_spread_constraints or spec.pod_anti_affinity or spec.pod_affinity
+    ):
         return False
     if allow_topology:
         for tsc in spec.topology_spread_constraints:
-            if tsc.topology_key != labels_mod.HOSTNAME:
-                return False
+            if tsc.topology_key != labels_mod.HOSTNAME and (
+                tsc.topology_key not in DOMAIN_KEYS
+            ):
+                return False  # custom topology keys stay host-side
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 return False  # ScheduleAnyway relaxes host-side
             if tsc.node_taints_policy == "Honor":
                 return False  # taint-gated counting stays host-side
         for term in spec.pod_anti_affinity:
+            # zonal anti-affinity serializes host-side: the oracle records
+            # EVERY domain of a multi-domain claim as occupied
+            # (topology.go:205-214), which the quota form cannot express
             if term.topology_key != labels_mod.HOSTNAME:
                 return False
         if len(spec.pod_anti_affinity) > 1:
+            return False
+        for term in spec.pod_affinity:
+            # hostname affinity (co-locate on one node) stays host-side
+            if term.topology_key not in DOMAIN_KEYS:
+                return False
+        if len(spec.pod_affinity) > 1:
             return False
     if spec.preferred_pod_affinity or spec.preferred_pod_anti_affinity:
         return False
@@ -213,6 +273,16 @@ class EncodedSnapshot:
     g_mask: np.ndarray  # [G, K, V1] bool
     g_hcap: np.ndarray  # [G] int32 per-entity cap (hostname topology; HCAP_NONE=free)
     n_hcnt: np.ndarray  # [N, G] int32 prior selected-pod counts per existing node
+    # domain-keyed (zone / capacity-type) constraint descriptors
+    g_dmode: np.ndarray  # [G] int32 DMODE_*
+    g_dkey: np.ndarray  # [G] int32 0=zone 1=capacity-type
+    g_dskew: np.ndarray  # [G] int32 maxSkew
+    g_dmin0: np.ndarray  # [G] bool minDomains pins global min to 0
+    g_dprior: np.ndarray  # [G, V1] int32 prior counts per domain slot
+    g_dreg: np.ndarray  # [G, V1] bool registered ∧ pod-admissible domains
+    g_drank: np.ndarray  # [G, V1] int32 sorted-domain rank (bootstrap order)
+    n_dzone: np.ndarray  # [N] int32 node zone value id (-1 = none)
+    n_dct: np.ndarray  # [N] int32 node capacity-type value id (-1 = none)
 
     # instance types
     t_alloc: np.ndarray  # [T, R] f32
@@ -255,6 +325,8 @@ class EncodedSnapshot:
         return (
             self.g_count, self.g_req, self.g_def, self.g_neg, self.g_mask,
             self.g_hcap,
+            self.g_dmode, self.g_dkey, self.g_dskew, self.g_dmin0,
+            self.g_dprior, self.g_dreg, self.g_drank,
             self.p_def, self.p_neg, self.p_mask, self.p_daemon,
             self.p_limit, self.p_has_limit, self.p_tol, self.p_titype_ok,
             self.t_def, self.t_mask, self.t_alloc, self.t_cap,
@@ -262,6 +334,7 @@ class EncodedSnapshot:
             a_tzc,
             self.n_def, self.n_mask, self.n_avail, self.n_base, self.n_tol,
             self.n_hcnt,
+            self.n_dzone, self.n_dct,
             self.well_known,
         )
 
@@ -304,6 +377,13 @@ def encode(
     # this keeps the value axis independent of the instance-type count.
     for g in groups:
         vocab.observe(g.requirements)
+        if g.topo is not None and g.topo.dmode != DMODE_NONE:
+            # domain-constraint universes must be interned before the
+            # padded shape is fixed; sorted so value-id assignment (the
+            # water-fill's deficit tie-break) is deterministic across
+            # processes and matches the oracle's sorted-domain order
+            for d in sorted(g.topo.dreg | set(g.topo.dprior)):
+                vocab.value_id(g.topo.dkey, d)
     if not cache.get("static_observed"):
         for nct in templates:
             vocab.observe(nct.requirements)
@@ -319,12 +399,15 @@ def encode(
                     vocab.value_id(labels_mod.CAPACITY_TYPE_LABEL_KEY, v)
         for en in existing_nodes:
             # ExistingNode models (scheduling/inflight.py); their requirement
-            # keys come from concrete node labels
+            # keys come from concrete node labels. Zone / capacity-type
+            # values are interned so nodes index into the domain axis.
             vocab.observe_keys(en.requirements)
+            _observe_node_domains(vocab, en)
         cache["static_observed"] = True
     else:
         for en in existing_nodes:
             vocab.observe_keys(en.requirements)
+            _observe_node_domains(vocab, en)
 
     K, V1 = vocab.padded_shape()
     static_names = cache.get("static_names")
@@ -351,10 +434,31 @@ def encode(
     g_neg = np.zeros((G, K), bool)
     g_mask = np.ones((G, K, V1), bool)
     g_hcap = np.full((G,), HCAP_NONE, np.int32)
+    g_dmode = np.zeros((G,), np.int32)
+    g_dkey = np.zeros((G,), np.int32)
+    g_dskew = np.zeros((G,), np.int32)
+    g_dmin0 = np.zeros((G,), bool)
+    g_dprior = np.zeros((G, V1), np.int32)
+    g_dreg = np.zeros((G, V1), bool)
+    g_drank = np.full((G, V1), _DRANK_NONE, np.int32)
     for i, g in enumerate(groups):
         g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
-        if g.topo is not None and g.topo.host_cap is not None:
-            g_hcap[i] = g.topo.host_cap
+        if g.topo is not None:
+            if g.topo.host_cap is not None:
+                g_hcap[i] = g.topo.host_cap
+            if g.topo.dmode != DMODE_NONE:
+                t = g.topo
+                g_dmode[i] = t.dmode
+                g_dkey[i] = 0 if t.dkey == labels_mod.TOPOLOGY_ZONE else 1
+                g_dskew[i] = min(t.dskew, HCAP_NONE)
+                g_dmin0[i] = t.dmin0
+                # rank = sorted-domain order, the oracle's tie-break and
+                # bootstrap preference (topologygroup.go:291-324)
+                for rank, d in enumerate(sorted(t.dreg)):
+                    vid = vocab.value_id(t.dkey, d)
+                    g_dreg[i, vid] = True
+                    g_drank[i, vid] = rank
+                    g_dprior[i, vid] = t.dprior.get(d, 0)
 
     # -- instance types + templates (static side, cached per padding) -----
     static_key = (K, V1, tuple(resource_names))
@@ -439,6 +543,8 @@ def encode(
     n_mask = np.ones((N, K, V1), bool)
     n_tol = np.zeros((N, max(G, 1)), bool)
     n_hcnt = np.zeros((N, max(G, 1)), np.int32)
+    n_dzone = np.full((N,), -1, np.int32)
+    n_dct = np.full((N,), -1, np.int32)
     existing_names = []
     for i, en in enumerate(existing_nodes):
         # `en` is a scheduling.inflight.ExistingNode (carries the remaining
@@ -447,6 +553,8 @@ def encode(
         n_avail[i] = quantize_capacity(en.cached_available, resource_names)
         n_base[i] = quantize_requests(en.requests, resource_names)
         n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
+        n_dzone[i] = _node_domain_id(vocab, en, labels_mod.TOPOLOGY_ZONE)
+        n_dct[i] = _node_domain_id(vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY)
         for gi, g in enumerate(groups):
             n_tol[i, gi] = (
                 taints_mod.tolerates(en.cached_taints, g.pods[0].spec.tolerations)
@@ -476,6 +584,15 @@ def encode(
         g_mask=g_mask,
         g_hcap=g_hcap,
         n_hcnt=n_hcnt,
+        g_dmode=g_dmode,
+        g_dkey=g_dkey,
+        g_dskew=g_dskew,
+        g_dmin0=g_dmin0,
+        g_dprior=g_dprior,
+        g_dreg=g_dreg,
+        g_drank=g_drank,
+        n_dzone=n_dzone,
+        n_dct=n_dct,
         t_alloc=t_alloc,
         t_cap=t_cap,
         t_def=t_def,
@@ -547,7 +664,13 @@ def partition_and_group(
         else:
             g.pods.append(pod)
     groups = list(by_key.values())
-    if allow_topo:
+    if allow_topo and (topology.topology_groups or topology.inverse_topology_groups):
+        # Constraint-free batches skip the cross-group resolution entirely:
+        # an empty forward-group map means no pending pod owns a topology
+        # constraint (Topology.update registered every pending pod before
+        # this call), and an empty inverse map means no bound pod's
+        # anti-affinity can gate placements — so there is nothing to demote
+        # and no TopoSpec to build.
         groups, demoted = _resolve_topology(groups, rest, topology)
         rest.extend(demoted)
     # FFD order over groups: cpu desc, then memory desc (queue.go:76-112)
@@ -639,13 +762,18 @@ def _resolve_topology(
         if gi in demote:
             continue
         rep = g.pods[0]
-        if not (rep.spec.topology_spread_constraints or rep.spec.pod_anti_affinity):
+        if not (
+            rep.spec.topology_spread_constraints
+            or rep.spec.pod_anti_affinity
+            or rep.spec.pod_affinity
+        ):
             continue
         uids = group_uids[gi]
         owned = [
             tg for tg in topology.topology_groups.values() if tg.is_owned_by(rep.uid)
         ]
         constraints = []  # (cap, counts) per hostname constraint
+        spec = TopoSpec()
         for tg in owned:
             # shared TopologyGroup across groups -> coupled counting
             if not tg.owners <= uids:
@@ -655,42 +783,124 @@ def _resolve_topology(
             if matched - {gi}:
                 demote.add(gi)  # selects pods outside this group
                 break
-            if tg.selects(rep):
-                # self-selecting: the skew bound is a per-entity cap of
-                # maxSkew (anti: 1) minus pods already counted on the node
-                cap = (
-                    tg.max_skew
-                    if tg.type is TopologyType.SPREAD
-                    else 1  # anti-affinity: only empty domains accept
-                )
-                constraints.append(
-                    (cap, {d: c for d, c in tg.domains.items() if c > 0})
-                )
-            else:
-                # non-self-selecting: placements never change the counts, so
-                # the constraint is a binary per-node gate — blocked when the
-                # prior already exceeds the allowance (spread: > maxSkew,
-                # anti: > 0), unlimited otherwise. Encoded as an infinite
-                # effective prior on blocked nodes under an infinite cap.
-                threshold = (
-                    tg.max_skew if tg.type is TopologyType.SPREAD else 0
-                )
-                constraints.append(
-                    (
-                        HCAP_NONE,
-                        {
-                            d: HCAP_NONE
-                            for d, c in tg.domains.items()
-                            if c > threshold
-                        },
+            self_sel = tg.selects(rep)
+            if tg.key == labels_mod.HOSTNAME:
+                if tg.type is TopologyType.POD_AFFINITY:
+                    demote.add(gi)  # hostname co-location stays host-side
+                    break
+                if self_sel:
+                    # self-selecting: the skew bound is a per-entity cap of
+                    # maxSkew (anti: 1) minus pods already counted on the node
+                    cap = (
+                        tg.max_skew
+                        if tg.type is TopologyType.SPREAD
+                        else 1  # anti-affinity: only empty domains accept
                     )
+                    constraints.append(
+                        (cap, {d: c for d, c in tg.domains.items() if c > 0})
+                    )
+                else:
+                    # non-self-selecting: placements never change the counts,
+                    # so the constraint is a binary per-node gate — blocked
+                    # when the prior already exceeds the allowance (spread:
+                    # > maxSkew, anti: > 0), unlimited otherwise. Encoded as
+                    # an infinite effective prior on blocked nodes under an
+                    # infinite cap.
+                    threshold = (
+                        tg.max_skew if tg.type is TopologyType.SPREAD else 0
+                    )
+                    constraints.append(
+                        (
+                            HCAP_NONE,
+                            {
+                                d: HCAP_NONE
+                                for d, c in tg.domains.items()
+                                if c > threshold
+                            },
+                        )
+                    )
+            elif (
+                tg.key in DOMAIN_KEYS
+                and tg.type is not TopologyType.POD_ANTI_AFFINITY
+            ):
+                # pod-admissible universe: the min (and every selection)
+                # ranges over registered domains the pod itself admits
+                # (topologygroup.go:231-251: candidate ∈ self.domains,
+                # min over pod_domains)
+                pod_dom = (
+                    g.requirements.get(tg.key)
+                    if g.requirements.has(tg.key)
+                    else Requirement(tg.key, Operator.EXISTS)
                 )
+                counts = {
+                    d: c for d, c in tg.domains.items() if pod_dom.has(d)
+                }
+                if tg.type is TopologyType.SPREAD:
+                    min0 = (
+                        tg.min_domains is not None
+                        and len(counts) < tg.min_domains
+                    )
+                    m = (
+                        0
+                        if min0
+                        else (min(counts.values()) if counts else MAX_SKEW_UNBOUNDED)
+                    )
+                    if self_sel:
+                        if spec.dmode != DMODE_NONE:
+                            demote.add(gi)  # one dynamic constraint per group
+                            break
+                        spec.dmode = DMODE_SPREAD
+                        spec.dkey = tg.key
+                        spec.dskew = tg.max_skew
+                        spec.dmin0 = min0
+                        spec.dprior = counts
+                        spec.dreg = frozenset(counts)
+                    else:
+                        # static gate: placements never move the counts, so
+                        # admissible domains are exactly those within skew
+                        # today — intersect them into the group requirement
+                        # (the oracle adds the same IN set per placement,
+                        # topology.go:220-242)
+                        allowed = [
+                            d for d, c in counts.items() if c - m <= tg.max_skew
+                        ]
+                        g.requirements.add(
+                            Requirement(tg.key, Operator.IN, allowed)
+                        )
+                else:  # POD_AFFINITY on zone / capacity-type
+                    nonempty = [d for d, c in counts.items() if c > 0]
+                    if nonempty:
+                        # compatible pods already placed: a static
+                        # nonempty-domain gate (topologygroup.go:277-290)
+                        g.requirements.add(
+                            Requirement(tg.key, Operator.IN, nonempty)
+                        )
+                    elif self_sel:
+                        if spec.dmode != DMODE_NONE:
+                            demote.add(gi)
+                            break
+                        # bootstrap: the whole group pins to one viable
+                        # domain (topologygroup.go:291-324)
+                        spec.dmode = DMODE_AFFINITY
+                        spec.dkey = tg.key
+                        spec.dprior = counts
+                        spec.dreg = frozenset(counts)
+                    else:
+                        # no compatible placed pods and no bootstrap right:
+                        # unsatisfiable (the oracle returns DoesNotExist)
+                        g.requirements.add(
+                            Requirement(tg.key, Operator.IN, [])
+                        )
+            else:
+                # zone/ct anti-affinity and custom topology keys serialize
+                # through the host oracle
+                demote.add(gi)
+                break
         if gi in demote:
             continue
-        # fold constraints: fresh-entity cap = min cap_i; a node's residual
-        # is min_i (cap_i - prior_i), stored back as an effective prior so
-        # the kernel's single (cap - prior) recovers it
-        spec = TopoSpec()
+        # fold hostname constraints: fresh-entity cap = min cap_i; a node's
+        # residual is min_i (cap_i - prior_i), stored back as an effective
+        # prior so the kernel's single (cap - prior) recovers it
         if constraints:
             spec.host_cap = min(c for c, _ in constraints)
             for d in {d for _, counts in constraints for d in counts}:

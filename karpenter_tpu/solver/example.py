@@ -19,18 +19,40 @@ from . import encode as enc
 from .driver import TpuSolver
 
 
-def example_pods(count: int, shapes: int = 1) -> List[Pod]:
+def example_pods(count: int, shapes: int = 1, zonal: int = 0) -> List[Pod]:
+    """``zonal`` of the pods additionally carry a self-selecting zonal
+    topology-spread constraint, exercising the kernel's domain-quota path."""
+    from ..api import labels as labels_mod
+    from ..api.objects import LabelSelector, TopologySpreadConstraint
+
     pods = []
     for i in range(count):
         s = i % shapes
+        spread = []
+        pod_labels = {}
+        if i < zonal:
+            # one uniform shape: the zonal pods must form a single
+            # equivalence class (a shared spread constraint across groups
+            # demotes them all to the host oracle)
+            s = 0
+            pod_labels = {"example": "zonal"}
+            spread = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels_mod.TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels=dict(pod_labels)),
+                )
+            ]
         pods.append(
             Pod(
-                metadata=ObjectMeta(name=f"pod-{i}"),
+                metadata=ObjectMeta(name=f"pod-{i}", labels=pod_labels),
                 spec=PodSpec(
                     requests={
                         res.CPU: (1 + s % 7) * res.MILLI,
                         res.MEMORY: (1 + s % 9) * 2**30 * res.MILLI,
-                    }
+                    },
+                    topology_spread_constraints=spread,
                 ),
             )
         )
@@ -42,20 +64,23 @@ def example_nodepool(name: str = "default") -> NodePool:
 
 
 def example_solver(
-    n_pods: int, n_types: int, shapes: int = 1
+    n_pods: int, n_types: int, shapes: int = 1, zonal: int = 0
 ) -> Tuple[TpuSolver, List[Pod]]:
-    pods = example_pods(n_pods, shapes)
+    pods = example_pods(n_pods, shapes, zonal=zonal)
     pools = [example_nodepool()]
     its = {pools[0].name: corpus.generate(n_types)}
     topology = Topology(Client(TestClock()), [], pools, its, pods)
     return TpuSolver(pools, its, topology), pods
 
 
-def example_snapshot_arrays(n_pods: int, n_types: int, shapes: int = 1):
+def example_snapshot_arrays(
+    n_pods: int, n_types: int, shapes: int = 1, zonal: int = 0
+):
     """Encoded snapshot + static kwargs for solve_core, ready to feed the
     kernels directly."""
-    solver, pods = example_solver(n_pods, n_types, shapes)
-    groups = enc.build_groups(pods)
+    solver, pods = example_solver(n_pods, n_types, shapes, zonal=zonal)
+    groups, rest = enc.partition_and_group(pods, topology=solver.oracle.topology)
+    assert not rest
     templates = solver.oracle.templates
     snap = enc.encode(
         groups,
@@ -65,5 +90,10 @@ def example_snapshot_arrays(n_pods: int, n_types: int, shapes: int = 1):
     )
     a_tzc = solver._offering_availability(snap)
     nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
-    statics = dict(nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
+    statics = dict(
+        nmax=nmax,
+        zone_kid=snap.zone_kid,
+        ct_kid=snap.ct_kid,
+        has_domains=bool((snap.g_dmode > 0).any()),
+    )
     return snap.solve_args(a_tzc), statics

@@ -391,3 +391,270 @@ class TestHostnameTopology:
         topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
         groups, rest = enc.partition_and_group(pods, topology=topo)
         assert not groups and len(rest) == 3
+
+
+class TestZonalTopology:
+    """Zone/capacity-type-keyed spread and pod affinity ride the TPU fast
+    path: self-selecting spread as a per-step domain-quota water-fill,
+    affinity as mask gates / the bootstrap single-domain rule
+    (ops/packing.py; reference topologygroup.go:205-324)."""
+
+    def _zone_distribution(self, results):
+        dist = {}
+        for claim in results.new_node_claims:
+            zr = claim.requirements.get(labels.TOPOLOGY_ZONE)
+            assert not zr.complement and len(zr.values) == 1, (
+                "zonal claims must be pinned to a single zone"
+            )
+            z = next(iter(zr.values))
+            dist[z] = dist.get(z, 0) + len(claim.pods)
+        return dist
+
+    def test_zonal_spread_rides_fast_path(self):
+        from karpenter_tpu.solver import encode as enc
+        from helpers import spread_constraint
+
+        app = {"app": "zs"}
+        pods = make_pods(
+            9, cpu="1", labels=app,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)],
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not rest and len(groups) == 1
+        assert groups[0].topo.dmode == enc.DMODE_SPREAD
+        assert groups[0].topo.dkey == labels.TOPOLOGY_ZONE
+        assert groups[0].topo.dreg == frozenset(
+            ("test-zone-a", "test-zone-b", "test-zone-c")
+        )
+
+    def test_zonal_spread_parity(self):
+        from helpers import spread_constraint
+
+        app = {"app": "zsp"}
+        pods = make_pods(
+            12, cpu="1", labels=app,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+        dist = self._zone_distribution(tpu_r)
+        assert sum(dist.values()) == 12
+        assert max(dist.values()) - min(dist.values()) <= 1  # maxSkew honored
+        assert len(dist) == 3
+
+    def test_zonal_spread_skew2_parity(self):
+        from helpers import spread_constraint
+
+        app = {"app": "zs2"}
+        pods = make_pods(
+            10, cpu="1", labels=app,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, max_skew=2, labels=app)],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+        dist = self._zone_distribution(tpu_r)
+        assert sum(dist.values()) == 10
+        assert max(dist.values()) - min(dist.values() if len(dist) == 3 else [0]) <= 2
+
+    def test_zonal_spread_with_plain_pods(self):
+        from helpers import spread_constraint
+
+        app = {"app": "zmix"}
+        pods = make_pods(8, cpu="2") + make_pods(
+            6, cpu="1", labels=app,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert tpu_r.all_pods_scheduled()
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+
+    def test_zonal_spread_with_cluster_priors(self):
+        """Prior selected pods shift the water-fill: zone a starts at 2, so
+        new pods favor b and c until counts level (topology.go:322-420)."""
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from helpers import spread_constraint
+
+        app = {"app": "zprior"}
+        client = Client(TestClock())
+        node = Node(
+            metadata=ObjectMeta(
+                name="prior-1",
+                labels={labels.TOPOLOGY_ZONE: "test-zone-a",
+                        labels.HOSTNAME: "prior-1"},
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("4"),
+            "memory": res.parse_quantity("16Gi"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        client.create(node)
+        for _ in range(2):
+            client.create(
+                make_pod(labels=app, node_name="prior-1", phase="Running")
+            )
+
+        pods = make_pods(
+            7, cpu="1", labels=app,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)],
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(client, [], node_pools, its_by_pool, pods)
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        dist = self._zone_distribution(results)
+        # [a=2 prior] + 7 water-filled = final counts (3,3,3)
+        assert dist == {"test-zone-a": 1, "test-zone-b": 3, "test-zone-c": 3}
+
+    def test_min_domains_unsatisfied_pins_min(self):
+        """minDomains above the domain count pins the global min to 0: every
+        zone caps at maxSkew (topologygroup.go:270-273)."""
+        from helpers import spread_constraint
+
+        app = {"app": "zmind"}
+        pods = make_pods(
+            6, cpu="1", labels=app,
+            spread=[
+                spread_constraint(
+                    labels.TOPOLOGY_ZONE, labels=app, min_domains=5
+                )
+            ],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        # 3 zones x cap 1 = 3 scheduled, 3 unplaced on both paths
+        assert len(oracle_r.pod_errors) == 3
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+
+    def test_zonal_affinity_bootstrap_parity(self):
+        from helpers import affinity_term
+
+        app = {"app": "zaff"}
+        pods = make_pods(
+            8, cpu="1", labels=app,
+            pod_affinity=[affinity_term(labels.TOPOLOGY_ZONE, app)],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert tpu_r.all_pods_scheduled()
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+        dist = self._zone_distribution(tpu_r)
+        assert len(dist) == 1  # bootstrap pins the whole group to one zone
+
+    def test_zonal_affinity_with_prior_gates_to_nonempty(self):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from helpers import affinity_term
+
+        app = {"app": "zaffp"}
+        client = Client(TestClock())
+        node = Node(
+            metadata=ObjectMeta(
+                name="aff-1",
+                labels={labels.TOPOLOGY_ZONE: "test-zone-b",
+                        labels.HOSTNAME: "aff-1"},
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("4"),
+            "memory": res.parse_quantity("16Gi"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        client.create(node)
+        client.create(make_pod(labels=app, node_name="aff-1", phase="Running"))
+
+        pods = make_pods(
+            5, cpu="1", labels=app,
+            pod_affinity=[affinity_term(labels.TOPOLOGY_ZONE, app)],
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(client, [], node_pools, its_by_pool, pods)
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        dist = self._zone_distribution(results)
+        assert set(dist) == {"test-zone-b"}  # gated to the occupied zone
+
+    def test_two_dynamic_constraints_demote(self):
+        from karpenter_tpu.solver import encode as enc
+        from helpers import spread_constraint
+
+        app = {"app": "zdouble"}
+        pods = make_pods(
+            4, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, labels=app),
+                spread_constraint(labels.CAPACITY_TYPE_LABEL_KEY, labels=app),
+            ],
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 4  # one quota system per group
+
+    def test_zone_and_hostname_spread_combined(self):
+        from helpers import spread_constraint
+
+        app = {"app": "zboth"}
+        pods = make_pods(
+            6, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, labels=app),
+                spread_constraint(labels.HOSTNAME, labels=app),
+            ],
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert tpu_r.all_pods_scheduled()
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+        dist = self._zone_distribution(tpu_r)
+        assert max(dist.values()) - min(dist.values()) <= 1
+        for claim in tpu_r.new_node_claims:
+            assert len(claim.pods) <= 1  # hostname cap rides along
+
+    def test_benchmark_mix_routes_all_classes(self):
+        """The reference's 5-class benchmark mix
+        (scheduling_benchmark_test.go:236-249): every class now rides the
+        TPU fast path."""
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+        from karpenter_tpu.solver import encode as enc
+        from helpers import affinity_term, spread_constraint
+
+        generic = make_pods(10, cpu="1", memory="2Gi")
+        zspread = make_pods(
+            6, cpu="1", labels={"mix": "zs"},
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels={"mix": "zs"})],
+        )
+        hspread = make_pods(
+            6, cpu="1", labels={"mix": "hs"},
+            spread=[spread_constraint(labels.HOSTNAME, labels={"mix": "hs"})],
+        )
+        zaff = make_pods(
+            6, cpu="1", labels={"mix": "za"},
+            pod_affinity=[affinity_term(labels.TOPOLOGY_ZONE, {"mix": "za"})],
+        )
+        hanti = make_pods(
+            4, cpu="1", labels={"mix": "ha"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels.HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"mix": "ha"}),
+                )
+            ],
+        )
+        pods = generic + zspread + hspread + zaff + hanti
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not rest, "all five benchmark pod classes must tensorize"
+        assert len(groups) == 5
+
+        oracle_r, tpu_r = run_both(pods)
+        assert tpu_r.all_pods_scheduled()
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)

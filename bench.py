@@ -1,25 +1,38 @@
-"""Headline benchmark: the BASELINE.json north-star shape.
+"""BASELINE-contract benchmark: the full metric, not just one number.
 
-Schedules 50k pending pods (100 distinct shapes) against 800 instance types
-through the full TpuSolver path (grouping -> encoding -> fused TPU kernel ->
-decode) and reports pods/sec against the reference's asserted floor of
-100 pods/sec (scheduling_benchmark_test.go:51).
+BASELINE.json's metric is "pods-scheduled/sec + p99 Solve() latency;
+packing-cost delta" over five configs. This driver:
 
-Prints exactly one JSON line.
+- runs every BASELINE config (identical / mixed+gpu / constrained-50k /
+  multi-node consolidation / spot+od with limits) plus a size grid
+  ({500, 5k, 10k, 50k} pods x {10, 400, 800} types), reporting pods/sec
+  and p99 solve latency per entry;
+- computes the packing-cost delta vs the host oracle (the Go-FFD-equivalent
+  semantic reference, scheduling/scheduler.py) for every config where the
+  oracle run is affordable, asserting the <=2% bound from BASELINE.json;
+- prints exactly ONE JSON line to stdout — the north-star config
+  (50k constrained pods x 800 types) — and writes the full grid to
+  bench_grid.json next to this file (stderr carries a readable table).
+
+The reference's own benchmark harness is scheduling_benchmark_test.go:70-133
+(grid + in-test floor); tests/test_perf_floor.py carries the in-test
+equivalents of its assertions.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import statistics
 import sys
 import time
-from typing import Tuple
+from typing import Dict, List, Optional, Tuple
 
-N_PODS = 50_000
-N_TYPES = 800
-N_SHAPES = 100
+N_HEADLINE_PODS = 50_000
+N_HEADLINE_TYPES = 800
 BASELINE_PODS_PER_SEC = 100.0  # reference floor, scheduling_benchmark_test.go:51
-
+COST_DELTA_BOUND = 0.02  # BASELINE.json: <=2% packing-cost regression
+ORACLE_POD_BUDGET = 12_000  # largest batch we run through the host oracle
 
 PROBE_TIMEOUT_S = 90.0  # tunnel backend init is seconds when healthy
 
@@ -65,13 +78,7 @@ def _probe_tpu() -> bool:
 
 
 def init_backend() -> Tuple[str, bool]:
-    """Bring up the JAX backend, loudly. Returns (platform, fell_back).
-
-    The benchmark wants the real TPU (the environment's default `axon`
-    platform, a tunneled single chip).  If the tunnel is down — which
-    manifests as a hang, not an error — fall back to CPU so a perf number
-    is still recorded, and say so on stderr + in the metric name.
-    """
+    """Bring up the JAX backend, loudly. Returns (platform, fell_back)."""
     import jax
 
     # NB: the JAX_PLATFORMS env var is unreliable here — the environment's
@@ -93,34 +100,215 @@ def init_backend() -> Tuple[str, bool]:
     return plat, fell_back
 
 
-def run_once():
-    from karpenter_tpu.solver.example import example_solver
+# -- workload builders ------------------------------------------------------
 
-    solver, pods = example_solver(N_PODS, N_TYPES, N_SHAPES)
-    t0 = time.perf_counter()
-    results = solver.solve(pods)
-    dt = time.perf_counter() - t0
-    if results.pod_errors:
+
+def _build(config: str, n_pods: int, n_types: int):
+    """(solver_factory, pods) for a named config. A fresh solver per run
+    keeps solves independent; the EncodeCache is shared so catalog encoding
+    amortizes exactly as it does in the provisioner."""
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver.driver import EncodeCache
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.workloads import (
+        constrained_mix, diverse_reference_mix, identical_pods, mixed_pods,
+        spot_od_pools,
+    )
+
+    if config == "identical":
+        pods = identical_pods(n_pods)
+        pools = [example_nodepool()]
+    elif config == "mixed":
+        pods = mixed_pods(n_pods)
+        pools = [example_nodepool()]
+    elif config == "constrained":
+        pods = constrained_mix(n_pods)
+        pools = [example_nodepool()]
+    elif config == "diverse-ref":
+        pods = diverse_reference_mix(n_pods)
+        pools = [example_nodepool()]
+    elif config == "spot-od-limits":
+        pods = mixed_pods(n_pods)
+        pools = spot_od_pools()
+    else:
+        raise ValueError(config)
+
+    its = corpus.generate(n_types)
+    its_by_pool = {p.name: list(its) for p in pools}
+    cache = EncodeCache()
+
+    def make_solver(force_oracle: bool = False):
+        from karpenter_tpu.solver.driver import SolverConfig
+
+        topology = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+        return TpuSolver(
+            pools,
+            its_by_pool,
+            topology,
+            config=SolverConfig(force_oracle=force_oracle),
+            encode_cache=cache,
+        )
+
+    return make_solver, pods
+
+
+def _routed_fraction(solver, pods) -> float:
+    from karpenter_tpu.solver import encode as enc
+
+    groups, rest = enc.partition_and_group(pods, topology=solver.oracle.topology)
+    routed = sum(g.count for g in groups)
+    return routed / max(len(pods), 1)
+
+
+def run_config(
+    config: str, n_pods: int, n_types: int, trials: int, with_oracle: bool
+) -> Dict:
+    make_solver, pods = _build(config, n_pods, n_types)
+    solver = make_solver()
+    routed = _routed_fraction(solver, pods)
+
+    # warm-up compiles the kernels for this shape bucket
+    warm = make_solver().solve(pods)
+    if warm.pod_errors:
         print(
-            f"bench: {len(results.pod_errors)} pods failed to schedule",
+            f"bench[{config}]: {len(warm.pod_errors)} pods failed to schedule",
             file=sys.stderr,
         )
         sys.exit(1)
-    return dt, results
+
+    times: List[float] = []
+    tpu_results = warm
+    for _ in range(trials):
+        s = make_solver()
+        t0 = time.perf_counter()
+        tpu_results = s.solve(pods)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    p99 = (
+        statistics.quantiles(times, n=100)[98]
+        if len(times) >= 5
+        else max(times)
+    )
+
+    entry = {
+        "config": config,
+        "pods": n_pods,
+        "types": n_types,
+        "pods_per_sec": round(n_pods / best, 1),
+        "best_ms": round(best * 1000, 1),
+        "p99_ms": round(p99 * 1000, 1),
+        "nodes": tpu_results.node_count(),
+        "cost": round(tpu_results.total_price(), 4),
+        "tpu_routed_fraction": round(routed, 4),
+    }
+
+    if with_oracle and n_pods <= ORACLE_POD_BUDGET:
+        t0 = time.perf_counter()
+        oracle_results = make_solver(force_oracle=True).solve(pods)
+        entry["oracle_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+        o_cost = oracle_results.total_price()
+        t_cost = tpu_results.total_price()
+        delta = (t_cost - o_cost) / o_cost if o_cost > 0 else 0.0
+        entry["oracle_cost"] = round(o_cost, 4)
+        entry["cost_delta"] = round(delta, 5)
+        entry["oracle_nodes"] = oracle_results.node_count()
+        if delta > COST_DELTA_BOUND:
+            print(
+                f"bench[{config}]: cost delta {delta:.4f} exceeds"
+                f" {COST_DELTA_BOUND:.2f} bound",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+    return entry
 
 
-def main():
+def run_consolidation(n_nodes: int) -> Dict:
+    """BASELINE config[3]: multi-node consolidation over an underutilized
+    cluster — the binary search's O(log n) scheduling probes share one
+    EncodeCache (multinodeconsolidation.go:112-167)."""
+    from karpenter_tpu.solver.workloads import build_consolidation_env
+
+    ctx, method, candidates, budgets = build_consolidation_env(n_nodes)
+    t0 = time.perf_counter()
+    cmd = method.compute_command(candidates, budgets)
+    dt = time.perf_counter() - t0
+    return {
+        "config": "consolidation",
+        "nodes": n_nodes,
+        "candidates": len(candidates),
+        "decision": cmd.decision if cmd else "no-op",
+        "disrupted": len(cmd.candidates) if cmd else 0,
+        "best_ms": round(dt * 1000, 1),
+        "pods_per_sec": None,
+        "p99_ms": round(dt * 1000, 1),
+    }
+
+
+def main() -> None:
     plat, fell_back = init_backend()
-    # warm-up: compile the kernels for the bench shapes
-    run_once()
-    best = min(run_once()[0] for _ in range(3))
-    value = N_PODS / best
+    full_grid = os.environ.get("BENCH_FULL_GRID", "1") != "0"
+
+    grid: List[Dict] = []
+
+    # BASELINE configs 0, 1, 4 (oracle cost-delta asserted)
+    grid.append(run_config("identical", 500, 10, trials=10, with_oracle=True))
+    grid.append(run_config("mixed", 10_000, 400, trials=7, with_oracle=True))
+    grid.append(
+        run_config("spot-od-limits", 5_000, 400, trials=7, with_oracle=True)
+    )
+    # the reference's literal 5-class diverse mix (cross-selecting spread
+    # serializes via the host oracle by design; routed fraction reported)
+    grid.append(run_config("diverse-ref", 5_000, 400, trials=5, with_oracle=True))
+
+    # size grid (reference harness shape, scheduling_benchmark_test.go:70-96)
+    if full_grid:
+        for n_pods, n_types, trials in (
+            (500, 400, 10),
+            (5_000, 400, 7),
+            (10_000, 800, 5),
+            (50_000, 10, 5),
+            (50_000, 400, 5),
+        ):
+            grid.append(
+                run_config("mixed", n_pods, n_types, trials=trials,
+                           with_oracle=False)
+            )
+
+    # BASELINE config[3]: consolidation search over 2k nodes
+    try:
+        grid.append(run_consolidation(2_000))
+    except Exception as exc:  # pragma: no cover - bench resilience
+        print(f"bench: consolidation config failed: {exc}", file=sys.stderr)
+
+    # the north star: 50k constrained pods x 800 types (BASELINE config[2])
+    headline = run_config(
+        "constrained", N_HEADLINE_PODS, N_HEADLINE_TYPES, trials=5,
+        with_oracle=False,
+    )
+    grid.append(headline)
+
+    for e in grid:
+        print(
+            "bench: "
+            + " ".join(f"{k}={v}" for k, v in e.items() if v is not None),
+            file=sys.stderr,
+        )
+    with open(os.path.join(os.path.dirname(__file__) or ".", "bench_grid.json"), "w") as fh:
+        json.dump({"platform": plat, "grid": grid}, fh, indent=1)
+
+    value = headline["pods_per_sec"]
     suffix = "-cpufallback" if fell_back else ""
     print(
         json.dumps(
             {
-                "metric": f"scheduling-throughput-{N_PODS}pods-{N_TYPES}types{suffix}",
-                "value": round(value, 1),
+                "metric": (
+                    f"scheduling-throughput-{N_HEADLINE_PODS}pods-"
+                    f"{N_HEADLINE_TYPES}types-constrained{suffix}"
+                ),
+                "value": value,
                 "unit": "pods/sec",
                 "vs_baseline": round(value / BASELINE_PODS_PER_SEC, 2),
             }

@@ -60,6 +60,18 @@ class DisruptionContext:
     clock: object
     recorder: Recorder
     spot_to_spot_enabled: bool = False
+    # one catalog-fingerprinted encode cache shared by every scheduling
+    # simulation this engine runs: the multi-node binary search's O(log n)
+    # probes (methods.py) and the 15s-TTL validation re-simulations all hit
+    # the same instance-type/template catalog, so the vocab + static arrays
+    # encode once per catalog change instead of once per probe
+    encode_cache: object = None
+
+    def __post_init__(self):
+        if self.encode_cache is None:
+            from ...solver.driver import EncodeCache
+
+            self.encode_cache = EncodeCache()
 
 
 @dataclass

@@ -114,6 +114,7 @@ def simulate_scheduling(
     cloud_provider,
     candidates: Sequence[Candidate],
     solver_config=None,
+    encode_cache=None,
 ) -> Results:
     """Re-run the scheduler as if the candidates were gone
     (helpers.go:49-117): state snapshot minus candidates, their
@@ -153,6 +154,7 @@ def simulate_scheduling(
         topology,
         state_nodes=state_nodes,
         config=solver_config,
+        encode_cache=encode_cache,
         volume_resolver=VolumeResolver(client),
     )
     return solver.solve(pods)

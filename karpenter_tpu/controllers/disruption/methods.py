@@ -107,7 +107,8 @@ class Drift(Method):
         # else per-candidate simulate + replace
         for c in candidates:
             results = simulate_scheduling(
-                self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, [c]
+                self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, [c],
+                encode_cache=self.ctx.encode_cache,
             )
             if results.pod_errors:
                 continue
@@ -152,7 +153,8 @@ class ConsolidationBase(Method):
 
     def compute_consolidation(self, candidates: List[Candidate]) -> Command:
         results = simulate_scheduling(
-            self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, candidates
+            self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, candidates,
+            encode_cache=self.ctx.encode_cache,
         )
         if results.pod_errors:
             return Command()

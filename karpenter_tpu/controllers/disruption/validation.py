@@ -78,6 +78,7 @@ class Validator:
             self.ctx.cluster,
             self.ctx.cloud_provider,
             [fresh_by_pid[c.provider_id] for c in command.candidates],
+            encode_cache=self.ctx.encode_cache,
         )
         if results.pod_errors:
             return "pods are no longer fully re-schedulable"

@@ -81,11 +81,13 @@ def _ptr(arr: np.ndarray):
 def solve_core_native(
     g_count, g_req, g_def, g_neg, g_mask, g_hcap,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+    g_hstg, g_hscap, g_dtg,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc,
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
+    nh_cnt0, dd0,
     well_known,
     nmax: int,
     zone_kid: int,
@@ -112,6 +114,11 @@ def solve_core_native(
     g_drank = _as(g_drank, np.int32)
     n_dzone = _as(n_dzone, np.int32)
     n_dct = _as(n_dct, np.int32)
+    g_hstg = _as(g_hstg, np.int32)
+    g_hscap = _as(g_hscap, np.int32)
+    g_dtg = _as(g_dtg, np.int32)
+    nh_cnt0 = _as(nh_cnt0, np.int32)
+    dd0 = _as(dd0, np.int32)
     g_def, g_neg, g_mask = (_as(x, np.uint8) for x in (g_def, g_neg, g_mask))
     p_def, p_neg, p_mask = (_as(x, np.uint8) for x in (p_def, p_neg, p_mask))
     p_daemon = _as(p_daemon, np.float32)
@@ -135,6 +142,8 @@ def solve_core_native(
     T, R = t_alloc.shape
     O = o_avail.shape[1] if o_avail.size else 0
     N = n_avail.shape[0]
+    JH = nh_cnt0.shape[1] if nh_cnt0.ndim == 2 else 1
+    JD = dd0.shape[0] if dd0.ndim == 2 else 1
 
     c_pool = np.zeros(nmax, np.int32)
     c_tmask = np.zeros((nmax, T), np.uint8)
@@ -150,10 +159,12 @@ def solve_core_native(
         ctypes.c_int(G), ctypes.c_int(T), ctypes.c_int(P), ctypes.c_int(N),
         ctypes.c_int(R), ctypes.c_int(K), ctypes.c_int(V1), ctypes.c_int(O),
         ctypes.c_int(nmax), ctypes.c_int(zone_kid), ctypes.c_int(ct_kid),
+        ctypes.c_int(JH), ctypes.c_int(JD),
         _ptr(g_count), _ptr(g_req), _ptr(g_def), _ptr(g_neg), _ptr(g_mask),
         _ptr(g_hcap),
         _ptr(g_dmode), _ptr(g_dkey), _ptr(g_dskew), _ptr(g_dmin0),
         _ptr(g_dprior), _ptr(g_dreg), _ptr(g_drank),
+        _ptr(g_hstg), _ptr(g_hscap), _ptr(g_dtg),
         _ptr(p_def), _ptr(p_neg), _ptr(p_mask), _ptr(p_daemon), _ptr(p_limit),
         _ptr(p_has_limit), _ptr(p_tol), _ptr(p_titype_ok),
         _ptr(t_def), _ptr(t_mask), _ptr(t_alloc), _ptr(t_cap),
@@ -162,6 +173,7 @@ def solve_core_native(
         _ptr(n_def), _ptr(n_mask), _ptr(n_avail), _ptr(n_base), _ptr(n_tol),
         _ptr(n_hcnt),
         _ptr(n_dzone), _ptr(n_dct),
+        _ptr(nh_cnt0), _ptr(dd0),
         _ptr(well_known),
         _ptr(c_pool), _ptr(c_tmask), _ptr(n_open), _ptr(overflow),
         _ptr(exist_fills), _ptr(claim_fills), _ptr(unplaced),

@@ -173,7 +173,7 @@ extern "C" {
 int kt_solve(
     // dims
     int G, int T, int P, int N, int R, int K, int V1, int O, int NMAX,
-    int zone_kid, int ct_kid,
+    int zone_kid, int ct_kid, int JH, int JD,
     // groups (FFD order)
     const int32_t* g_count, const float* g_req, const uint8_t* g_def,
     const uint8_t* g_neg, const uint8_t* g_mask,
@@ -184,6 +184,8 @@ int kt_solve(
     const int32_t* g_dprior,  // [G, V1]
     const uint8_t* g_dreg,    // [G, V1]
     const int32_t* g_drank,   // [G, V1]
+    // shared-constraint slots + caps
+    const int32_t* g_hstg, const int32_t* g_hscap, const int32_t* g_dtg,
     // templates
     const uint8_t* p_def, const uint8_t* p_neg, const uint8_t* p_mask,
     const float* p_daemon, const float* p_limit, const uint8_t* p_has_limit,
@@ -199,6 +201,8 @@ int kt_solve(
     const float* n_base, const uint8_t* n_tol,
     const int32_t* n_hcnt,  // [N, G] prior selected-pod counts
     const int32_t* n_dzone, const int32_t* n_dct,  // [N] domain value ids
+    const int32_t* nh_cnt0,  // [N, JH] shared hostname-constraint priors
+    const int32_t* dd0,      // [JD, V1] shared domain carry init
     const uint8_t* well_known,
     // outputs
     int32_t* out_c_pool,      // [NMAX]
@@ -289,6 +293,10 @@ int kt_solve(
   std::vector<uint8_t> c_neg(static_cast<size_t>(NMAX) * K, 0);
   std::vector<uint8_t> c_mask(static_cast<size_t>(NMAX) * KV, 1);
   std::vector<int32_t> c_dzone(NMAX, -1), c_dct(NMAX, -1);
+  // shared-constraint carries (counts accumulate across groups)
+  std::vector<int32_t> ch_cnt(static_cast<size_t>(NMAX) * JH, 0);
+  std::vector<int32_t> nhc(nh_cnt0, nh_cnt0 + static_cast<size_t>(N) * JH);
+  std::vector<int32_t> ddc(dd0, dd0 + static_cast<size_t>(JD) * V1);
   std::vector<float> pool_rem(p_limit, p_limit + static_cast<size_t>(P) * R);
   int32_t n_open = 0;
   bool overflow = false;
@@ -324,9 +332,19 @@ int kt_solve(
     const int other_kid = (dkey == 0) ? ct_kid : zone_kid;
     const int32_t skew = g_dskew[gi];
     const bool min0 = g_dmin0[gi];
-    const int32_t* D0 = g_dprior + static_cast<size_t>(gi) * V1;
     const uint8_t* reg = g_dreg + static_cast<size_t>(gi) * V1;
     const int32_t* drank = g_drank + static_cast<size_t>(gi) * V1;
+    // shared constraints: counts from the carries
+    const int32_t jh = g_hstg[gi];
+    const bool has_h = jh >= 0;
+    const int32_t scap_h = g_hscap[gi];
+    const int32_t jd = g_dtg[gi];
+    const bool has_d = jd >= 0;
+    std::vector<int32_t> D0v(V1);
+    for (int v = 0; v < V1; ++v)
+      D0v[v] = g_dprior[static_cast<size_t>(gi) * V1 + v] +
+               (has_d ? ddc[static_cast<size_t>(jd) * V1 + v] : 0);
+    const int32_t* D0 = D0v.data();
 
     // ---- 1. existing nodes, fixed priority order ----
     for (int n = 0; n < N; ++n) {
@@ -337,6 +355,10 @@ int kt_solve(
       exist_cap[n] = std::min(
           exist_cap[n],
           std::max(hc - n_hcnt[static_cast<size_t>(n) * G + gi], 0));
+      if (has_h)
+        exist_cap[n] = std::min(
+            exist_cap[n],
+            std::max(scap_h - nhc[static_cast<size_t>(n) * JH + jh], 0));
     }
 
     // node domain slot on the constrained axis
@@ -397,8 +419,15 @@ int kt_solve(
         std::vector<int32_t> qfill(V1);
         waterfill(npods, scap, count, qfill);
         for (int d = 0; d < V1; ++d) qd[d] = qfill[d];
-      } else {  // DMODE_AFFINITY: bootstrap pins the group to one domain
+      } else {  // DMODE_AFFINITY: bootstrap pins the group to one domain;
+        // with a shared carry, a nonempty domain binds every follower
         int32_t d_aff = -1;
+        int32_t best_follow = kBigDom;
+        for (int d = 0; d < V1; ++d)
+          if (D0[d] > 0 && reg[d] && drank[d] < best_follow) {
+            best_follow = drank[d];
+            d_aff = d;
+          }
         for (int n = 0; n < N && d_aff < 0; ++n)
           if (exist_cap[n] >= 1 && nd_slot[n] < V1) d_aff = nd_slot[n];
         if (d_aff < 0) {
@@ -430,6 +459,7 @@ int kt_solve(
             exist_used[static_cast<size_t>(n) * R + r] += exist_fill[n] * req[r];
           out_exist_fills[static_cast<size_t>(gi) * N + n] = exist_fill[n];
           qrem[nd_slot[n]] -= exist_fill[n];
+          if (has_h) nhc[static_cast<size_t>(n) * JH + jh] += exist_fill[n];
         }
       }
     }
@@ -518,6 +548,10 @@ int kt_solve(
         claim_cap[s] = best;
       }
       claim_cap[s] = std::min(claim_cap[s], hc);  // open claims carry no prior
+      if (has_h)
+        claim_cap[s] = std::min(
+            claim_cap[s],
+            std::max(scap_h - ch_cnt[static_cast<size_t>(s) * JH + jh], 0));
     }
     // per-slot water-fill with the slot's remaining quota as budget
     for (int sl = 0; sl < NSLOT; ++sl) {
@@ -541,6 +575,7 @@ int kt_solve(
       if (claim_fill[s] <= 0) continue;
       got[s] = 1;
       c_npods[s] += claim_fill[s];
+      if (has_h) ch_cnt[static_cast<size_t>(s) * JH + jh] += claim_fill[s];
       for (int r = 0; r < R; ++r)
         c_used[static_cast<size_t>(s) * R + r] += claim_fill[s] * req[r];
       out_claim_fills[static_cast<size_t>(gi) * NMAX + s] = claim_fill[s];
@@ -661,6 +696,7 @@ int kt_solve(
           debit[r] = std::max(debit[r], t_cap[t * R + r]);
       }
       n_per = std::min(n_per, hc);
+      if (has_h) n_per = std::min(n_per, scap_h);
       if (n_per <= 0) {
         ddead[d_sel] = 1;
         continue;
@@ -715,6 +751,7 @@ int kt_solve(
             c_dct[slot] = d_sel;
         }
         out_claim_fills[static_cast<size_t>(gi) * NMAX + slot] = n_take;
+        if (has_h) ch_cnt[static_cast<size_t>(slot) * JH + jh] = n_take;
         placed += n_take;
       }
       if (p_has_limit[p_star])
@@ -724,6 +761,11 @@ int kt_solve(
       qrem[d_sel] -= placed;
       if (placed == 0) ddead[d_sel] = 1;
     }
+    // shared domain carry: this group's per-domain placements feed the
+    // next sharing group's counts
+    if (has_d)
+      for (int d = 0; d < V1; ++d)
+        ddc[static_cast<size_t>(jd) * V1 + d] += qd[d] - qrem[d];
     int32_t left = 0;
     for (int sl = 0; sl < NSLOT; ++sl) left += qrem[sl];
     // pods never granted quota (domain water-fill ran out of capacity)

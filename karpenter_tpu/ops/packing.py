@@ -117,6 +117,11 @@ class PackState(NamedTuple):
     c_mask: jnp.ndarray  # [NMAX, K, V1] bool
     c_dzone: jnp.ndarray  # [NMAX] int32 pinned zone value id (-1 = unpinned)
     c_dct: jnp.ndarray  # [NMAX] int32 pinned capacity-type value id
+    # shared-constraint carries: counts accumulate ACROSS scan steps because
+    # several groups feed the same constraint
+    ch_cnt: jnp.ndarray  # [NMAX, JH] int32 per-claim shared hostname counts
+    nhc: jnp.ndarray  # [N, JH] int32 per-node shared hostname counts
+    ddc: jnp.ndarray  # [JD, V1] int32 shared domain counts
     pool_rem: jnp.ndarray  # [P, R]
     n_open: jnp.ndarray  # scalar int32
     overflow: jnp.ndarray  # scalar bool
@@ -129,6 +134,7 @@ def pack(
     g_hcap,  # [G] int32 per-entity cap (hostname spread/anti; 2**30 = none)
     g_dmode, g_dkey, g_dskew, g_dmin0,  # [G] domain-constraint descriptors
     g_dprior, g_dreg, g_drank,  # [G, V1] prior counts / registered / rank
+    g_hstg, g_hscap, g_dtg,  # [G] shared-constraint slots (-1 = none) + caps
     # precomputed feasibility tables
     compat_pg, type_ok_pgt, n_fit_pgt,  # [P,G], [P,G,T], [P,G,T]
     cap_ng,  # [N, G] existing-node capacity at t0 (compat ∧ taints)
@@ -142,6 +148,8 @@ def pack(
     n_avail, n_base,
     n_hcnt,  # [N, G] int32 prior selected-pod counts (hostname topology)
     n_dzone, n_dct,  # [N] int32 zone / capacity-type value id (-1 = none)
+    nh_cnt0,  # [N, JH] int32 shared hostname-constraint node priors
+    dd0,  # [JD, V1] int32 shared domain-count carry init
     well_known,
     nmax: int,
     zone_kid: int,
@@ -177,6 +185,9 @@ def pack(
         c_mask=jnp.ones((nmax, K, V1), bool),
         c_dzone=jnp.full((nmax,), -1, jnp.int32),
         c_dct=jnp.full((nmax,), -1, jnp.int32),
+        ch_cnt=jnp.zeros((nmax, nh_cnt0.shape[1]), jnp.int32),
+        nhc=nh_cnt0.astype(jnp.int32),
+        ddc=dd0.astype(jnp.int32),
         pool_rem=p_limit,
         n_open=jnp.int32(0),
         overflow=jnp.bool_(False),
@@ -188,13 +199,27 @@ def pack(
         req = g_req[gi]
         gdef, gneg, gmask = g_def[gi], g_neg[gi], g_mask[gi]
         hcap = g_hcap[gi]
+        # shared hostname constraint: the cap applies against counts that
+        # accumulate across groups in the carry
+        JH = nh_cnt0.shape[1]
+        jh = g_hstg[gi]
+        has_h = jh >= 0
+        jhc = jnp.clip(jh, 0, JH - 1)
+        jh_oh = jax.nn.one_hot(jhc, JH, dtype=jnp.int32) * has_h  # [JH]
+        scap_h = g_hscap[gi]
+        # shared domain constraint: counts from the domain carry add to the
+        # group's static cluster priors
+        JD = dd0.shape[0]
+        jd = g_dtg[gi]
+        has_d = jd >= 0
+        jdc = jnp.clip(jd, 0, JD - 1)
         mode = g_dmode[gi]
         dyn = mode > 0
         dkey = g_dkey[gi]  # 0 = zone axis, 1 = capacity-type axis
         kid_sel = jnp.where(dkey == 0, zone_kid, ct_kid)
         skew = g_dskew[gi]
         min0 = g_dmin0[gi]
-        D0 = g_dprior[gi]  # [V1]
+        D0 = g_dprior[gi] + jnp.where(has_d, state.ddc[jdc], 0)  # [V1]
         reg = g_dreg[gi]  # [V1]
         drank = g_drank[gi]  # [V1]
 
@@ -229,6 +254,13 @@ def pack(
             0,
         )
         exist_cap = jnp.minimum(exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0))
+        if N:
+            exist_cap = jnp.minimum(
+                exist_cap,
+                jnp.where(
+                    has_h, jnp.maximum(scap_h - state.nhc[:, jhc], 0), _BIGI
+                ),
+            )
 
         if has_domains:
             # node domain slot on the constrained axis
@@ -271,8 +303,16 @@ def pack(
                 d_exist = jnp.int32(0)
             fresh_feas = fresh_ok_d & reg
             d_fresh = jnp.argmin(jnp.where(fresh_feas, drank, _BIGI))
-            aff_feasible = has_exist | jnp.any(fresh_feas)
-            d_aff = jnp.where(has_exist, d_exist, d_fresh)
+            # shared affinity: once a sharing group has placed pods, the
+            # nonempty domain binds every follower (the oracle's options
+            # rule, topologygroup.go:277-290)
+            nonempty = (D0 > 0) & reg
+            d_follow = jnp.argmin(jnp.where(nonempty, drank, _BIGI))
+            follow = jnp.any(nonempty)
+            aff_feasible = follow | has_exist | jnp.any(fresh_feas)
+            d_aff = jnp.where(
+                follow, d_follow, jnp.where(has_exist, d_exist, d_fresh)
+            )
             q_aff = jnp.where(
                 aff_feasible,
                 jax.nn.one_hot(d_aff, V1, dtype=jnp.int32) * count,
@@ -306,6 +346,7 @@ def pack(
             exist_fill = greedy_prefix_fill(exist_cap, count)
             qrem = qd.at[ANY].add(-jnp.sum(exist_fill))
         exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
+        nhc = state.nhc + exist_fill[:, None] * jh_oh[None, :]
 
         # ---- 2. open claims, least-loaded first ----
         # claim-level compatibility with the group
@@ -358,22 +399,33 @@ def pack(
             c_slot = jnp.full((nmax,), ANY, jnp.int32)
             claim_cap = cap_any
         claim_cap = jnp.minimum(claim_cap, hcap)  # open claims carry no prior
+        claim_cap = jnp.minimum(
+            claim_cap,
+            jnp.where(
+                has_h, jnp.maximum(scap_h - state.ch_cnt[:, jhc], 0), _BIGI
+            ),
+        )
 
-        def wf_slot(slot_idx, slot_budget):
-            m = c_slot == slot_idx
-            return waterfill(
-                jnp.where(m, state.c_npods, _BIGI),
-                jnp.where(m, claim_cap, 0),
-                slot_budget,
-            )
+        if has_domains:
+            def wf_slot(slot_idx, slot_budget):
+                m = c_slot == slot_idx
+                return waterfill(
+                    jnp.where(m, state.c_npods, _BIGI),
+                    jnp.where(m, claim_cap, 0),
+                    slot_budget,
+                )
 
-        fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)  # [NSLOT, NMAX]
-        claim_fill = jnp.sum(fills_sd, axis=0)  # each claim in exactly one slot
-        qrem = qrem - jnp.sum(fills_sd, axis=1)
+            fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)  # [NSLOT, NMAX]
+            claim_fill = jnp.sum(fills_sd, axis=0)  # each claim in one slot
+            qrem = qrem - jnp.sum(fills_sd, axis=1)
+        else:
+            claim_fill = waterfill(state.c_npods, claim_cap, qrem[ANY])
+            qrem = qrem.at[ANY].add(-jnp.sum(claim_fill))
 
         got = claim_fill > 0
         c_used = state.c_used + claim_fill[:, None] * req[None, :]
         c_npods = state.c_npods + claim_fill
+        ch_cnt = state.ch_cnt + claim_fill[:, None] * jh_oh[None, :]
         c_def = state.c_def | (got[:, None] & gdef[None, :])
         c_neg = jnp.where(got[:, None], state.c_neg & gneg[None, :], state.c_neg)
         still_fits = jnp.all(t_alloc[None, :, :] >= c_used[:, None, :], axis=-1)
@@ -439,6 +491,7 @@ def pack(
             n_per = jnp.minimum(
                 jnp.max(jnp.where(avail[p_star], n_fit_pgt[p_star, gi], 0)), hcap
             )
+            n_per = jnp.minimum(n_per, jnp.where(has_h, scap_h, _BIGI))
 
             # pessimistic limit debit: max capacity over the claim's options
             debit = jnp.max(
@@ -515,6 +568,7 @@ def pack(
                     st.c_dzone, jnp.where(dkey == 0, d_pin, -1)
                 ),
                 c_dct=write(st.c_dct, jnp.where(dkey == 1, d_pin, -1)),
+                ch_cnt=write(st.ch_cnt, takes[:, None] * jh_oh[None, :]),
                 pool_rem=pool_rem,
                 n_open=slot + k,
                 overflow=st.overflow
@@ -541,10 +595,19 @@ def pack(
             c_tmask=c_tmask,
             c_dzone=c_dzone2,
             c_dct=c_dct2,
+            ch_cnt=ch_cnt,
+            nhc=nhc,
         )
         ddead0 = jnp.zeros((NSLOT,), bool).at[DEAD].set(True)
-        new_state, qrem, claim_fill, _ = jax.lax.while_loop(
+        new_state, qrem_fin, claim_fill, _ = jax.lax.while_loop(
             cond2, body, (new_state, qrem, claim_fill, ddead0)
+        )
+        # shared domain carry: this group's per-domain placements feed the
+        # next sharing group's counts
+        new_state = new_state._replace(
+            ddc=new_state.ddc.at[jdc].add(
+                jnp.where(has_d, qd[:V1] - qrem_fin[:V1], 0)
+            )
         )
         unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
         return new_state, (exist_fill, claim_fill, unplaced)

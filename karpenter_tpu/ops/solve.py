@@ -21,11 +21,13 @@ from .packing import pack
 def solve_core(
     g_count, g_req, g_def, g_neg, g_mask, g_hcap,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+    g_hstg, g_hscap, g_dtg,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc,
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
+    nh_cnt0, dd0,
     well_known,
     nmax: int,
     zone_kid: int,
@@ -54,6 +56,7 @@ def solve_core(
         g_count, g_req, g_def, g_neg, g_mask,
         g_hcap,
         g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+        g_hstg, g_hscap, g_dtg,
         compat_pg, type_ok, n_fit,
         cap_ng,
         t_alloc, t_cap,
@@ -62,6 +65,7 @@ def solve_core(
         n_avail, n_base,
         n_hcnt,
         n_dzone, n_dct,
+        nh_cnt0, dd0,
         well_known,
         nmax=nmax,
         zone_kid=zone_kid,

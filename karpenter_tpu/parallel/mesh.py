@@ -69,6 +69,9 @@ def snapshot_shardings(mesh) -> Tuple:
         g,  # g_dprior [G, V1]
         g,  # g_dreg [G, V1]
         g,  # g_drank [G, V1]
+        g,  # g_hstg [G]
+        g,  # g_hscap [G]
+        g,  # g_dtg [G]
         rep,  # p_def
         rep,  # p_neg
         rep,  # p_mask
@@ -93,6 +96,8 @@ def snapshot_shardings(mesh) -> Tuple:
         S(None, "data"),  # n_hcnt [N, G]
         rep,  # n_dzone [N]
         rep,  # n_dct [N]
+        rep,  # nh_cnt0 [N, JH]
+        rep,  # dd0 [JD, V1]
         rep,  # well_known [K]
     )
 

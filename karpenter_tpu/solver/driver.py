@@ -249,9 +249,11 @@ class TpuSolver:
                  exist_fills, claim_fills, unplaced, c_dzone, c_dct) = [
                     np.asarray(x) for x in jax.device_get(out)
                 ]
-                c_tmask = np.unpackbits(packed, axis=1)[:, :n_types].astype(bool)
+                # the type mask stays bit-packed: _decode unpacks only the
+                # distinct rows it actually touches (n_open can be in the
+                # thousands; a global unpack costs ~20 ms on the 50k shape)
                 return (
-                    c_pool.astype(np.int32), c_tmask, n_open, overflow,
+                    c_pool.astype(np.int32), packed, n_open, overflow,
                     exist_fills.astype(np.int32),
                     claim_fills.astype(np.int32), unplaced,
                     c_dzone.astype(np.int32), c_dct.astype(np.int32),
@@ -297,8 +299,8 @@ class TpuSolver:
                     np.inf,
                 )
             best = np.maximum(best, np.min(per_n, axis=-1).max(axis=1))
-        # the hostname-topology cap bounds every fill regardless of source
-        best = np.minimum(best, snap.g_hcap)
+        # the hostname-topology caps (private and shared) bound every fill
+        best = np.minimum(np.minimum(best, snap.g_hcap), snap.g_hscap)
         capped = np.minimum(best, snap.g_count.astype(np.float64))
         return int(capped.max()) if capped.size else 0
 
@@ -309,7 +311,10 @@ class TpuSolver:
         can only shrink the real fit, so this may undershoot; the overflow
         retry doubles NMAX in that case."""
         n_fit = np.where(np.isfinite(fit), fit, 0)
-        best = np.maximum(np.minimum(n_fit.max(axis=1), snap.g_hcap), 1)
+        best = np.maximum(
+            np.minimum(np.minimum(n_fit.max(axis=1), snap.g_hcap), snap.g_hscap),
+            1,
+        )
         # domain-constrained groups open claims per domain (zonal spread
         # water-fills across zones), so each may leave one partial claim per
         # registered domain instead of one overall
@@ -372,13 +377,18 @@ class TpuSolver:
         claims: List[DecodedClaim] = []
         claim_by_slot: Dict[int, DecodedClaim] = {}
         type_ids_cache: Dict[bytes, List[cp.InstanceType]] = {}
+        T = len(snap.instance_types)
+        packed = c_tmask.dtype == np.uint8 and c_tmask.shape[1] != T
         for slot in range(n_open):
             nct = snap.templates[int(c_pool[slot])]
-            tkey = c_tmask[slot].tobytes()
+            row = c_tmask[slot]
+            tkey = row.tobytes()
             options = type_ids_cache.get(tkey)
             if options is None:
+                if packed:
+                    row = np.unpackbits(row)[:T]
                 options = [
-                    snap.instance_types[t] for t in np.nonzero(c_tmask[slot])[0]
+                    snap.instance_types[t] for t in np.nonzero(row)[0]
                 ]
                 type_ids_cache[tkey] = options
             claim = DecodedClaim(

@@ -96,6 +96,31 @@ def _node_domain_id(vocab: "Vocab", en, key: str) -> int:
 
 
 @dataclass
+class SharedHostTG:
+    """A hostname-keyed constraint shared by several pod groups (e.g. one
+    Deployment's anti-affinity across request shapes). Counts live in the
+    kernel carry, indexed by the slot encode() assigns; ``counts`` are the
+    cluster priors per hostname."""
+
+    cap: int
+    counts: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class SharedDomainTG:
+    """A zone/capacity-type-keyed constraint shared by several pod groups.
+    Descriptor fields mirror TopoSpec's d* fields; the evolving counts ride
+    the kernel's domain carry."""
+
+    key: str
+    mode: int
+    skew: int = 0
+    min0: bool = False
+    prior: Dict[str, int] = field(default_factory=dict)
+    reg: frozenset = frozenset()
+
+
+@dataclass
 class TopoSpec:
     """Tensorized topology state for one pod group.
 
@@ -128,6 +153,10 @@ class TopoSpec:
     dmin0: bool = False  # minDomains unsatisfied: global min pinned to 0
     dprior: Dict[str, int] = field(default_factory=dict)  # domain -> count
     dreg: frozenset = frozenset()  # registered ∧ pod-admissible domains
+    # constraints shared across groups: same descriptor object on every
+    # sharing group's spec; encode() assigns carry slots by object identity
+    shared_h: Optional[SharedHostTG] = None
+    shared_d: Optional[SharedDomainTG] = None
 
 
 @dataclass
@@ -283,6 +312,12 @@ class EncodedSnapshot:
     g_drank: np.ndarray  # [G, V1] int32 sorted-domain rank (bootstrap order)
     n_dzone: np.ndarray  # [N] int32 node zone value id (-1 = none)
     n_dct: np.ndarray  # [N] int32 node capacity-type value id (-1 = none)
+    # shared-constraint carries (cross-group counting)
+    g_hstg: np.ndarray  # [G] int32 shared hostname-constraint slot (-1 none)
+    g_hscap: np.ndarray  # [G] int32 per-entity cap of the shared constraint
+    g_dtg: np.ndarray  # [G] int32 shared domain-constraint slot (-1 none)
+    nh_cnt0: np.ndarray  # [N, JH] int32 shared-constraint node priors
+    dd0: np.ndarray  # [JD, V1] int32 shared domain-count carry init (zeros)
 
     # instance types
     t_alloc: np.ndarray  # [T, R] f32
@@ -327,6 +362,7 @@ class EncodedSnapshot:
             self.g_hcap,
             self.g_dmode, self.g_dkey, self.g_dskew, self.g_dmin0,
             self.g_dprior, self.g_dreg, self.g_drank,
+            self.g_hstg, self.g_hscap, self.g_dtg,
             self.p_def, self.p_neg, self.p_mask, self.p_daemon,
             self.p_limit, self.p_has_limit, self.p_tol, self.p_titype_ok,
             self.t_def, self.t_mask, self.t_alloc, self.t_cap,
@@ -335,6 +371,7 @@ class EncodedSnapshot:
             self.n_def, self.n_mask, self.n_avail, self.n_base, self.n_tol,
             self.n_hcnt,
             self.n_dzone, self.n_dct,
+            self.nh_cnt0, self.dd0,
             self.well_known,
         )
 
@@ -441,6 +478,28 @@ def encode(
     g_dprior = np.zeros((G, V1), np.int32)
     g_dreg = np.zeros((G, V1), bool)
     g_drank = np.full((G, V1), _DRANK_NONE, np.int32)
+    # shared-constraint carry slots, assigned by descriptor identity
+    g_hstg = np.full((G,), -1, np.int32)
+    g_hscap = np.full((G,), HCAP_NONE, np.int32)
+    g_dtg = np.full((G,), -1, np.int32)
+    shared_h_descs: List[SharedHostTG] = []
+    _h_slots: Dict[int, int] = {}
+    _d_slots: Dict[int, int] = {}
+    for i, g in enumerate(groups):
+        t = g.topo
+        if t is None:
+            continue
+        if t.shared_h is not None:
+            j = _h_slots.setdefault(id(t.shared_h), len(_h_slots))
+            if j == len(shared_h_descs):
+                shared_h_descs.append(t.shared_h)
+            g_hstg[i] = j
+            g_hscap[i] = t.shared_h.cap
+        if t.shared_d is not None:
+            g_dtg[i] = _d_slots.setdefault(id(t.shared_d), len(_d_slots))
+    JH = max(len(shared_h_descs), 1)
+    JD = max(len(_d_slots), 1)
+    dd0 = np.zeros((JD, V1), np.int32)
     for i, g in enumerate(groups):
         g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
         if g.topo is not None:
@@ -545,6 +604,7 @@ def encode(
     n_hcnt = np.zeros((N, max(G, 1)), np.int32)
     n_dzone = np.full((N,), -1, np.int32)
     n_dct = np.full((N,), -1, np.int32)
+    nh_cnt0 = np.zeros((N, JH), np.int32)
     existing_names = []
     for i, en in enumerate(existing_nodes):
         # `en` is a scheduling.inflight.ExistingNode (carries the remaining
@@ -555,6 +615,12 @@ def encode(
         n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
         n_dzone[i] = _node_domain_id(vocab, en, labels_mod.TOPOLOGY_ZONE)
         n_dct[i] = _node_domain_id(vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY)
+        if shared_h_descs:
+            hostname = (
+                en.state_node.hostname() if hasattr(en, "state_node") else en.name
+            )
+            for j, desc in enumerate(shared_h_descs):
+                nh_cnt0[i, j] = desc.counts.get(hostname, 0)
         for gi, g in enumerate(groups):
             n_tol[i, gi] = (
                 taints_mod.tolerates(en.cached_taints, g.pods[0].spec.tolerations)
@@ -593,6 +659,11 @@ def encode(
         g_drank=g_drank,
         n_dzone=n_dzone,
         n_dct=n_dct,
+        g_hstg=g_hstg,
+        g_hscap=g_hscap,
+        g_dtg=g_dtg,
+        nh_cnt0=nh_cnt0,
+        dd0=dd0,
         t_alloc=t_alloc,
         t_cap=t_cap,
         t_def=t_def,
@@ -651,15 +722,50 @@ def partition_and_group(
     by_key: Dict[tuple, PodGroup] = {}
     rest: List[Pod] = []
     allow_topo = topology is not None
+    # fused per-pod check + key build: this loop walks every spec in a 50k
+    # batch, so the common no-constraint shape takes one attribute sweep
+    # (is_tensorizable + group_key stay the semantic reference and serve
+    # the uncommon shapes)
+    rest_append = rest.append
+    get_group = by_key.get
     for pod in pods:
-        if not is_tensorizable(pod, allow_topology=allow_topo):
-            rest.append(pod)
-            continue
-        key = group_key(pod)
-        g = by_key.get(key)
+        spec = pod.spec
+        affinity = spec.node_affinity
+        if (
+            spec.topology_spread_constraints
+            or spec.pod_anti_affinity
+            or spec.pod_affinity
+            or spec.preferred_pod_affinity
+            or spec.preferred_pod_anti_affinity
+            or spec.host_ports
+            or spec.volumes
+        ):
+            if not is_tensorizable(pod, allow_topology=allow_topo):
+                rest_append(pod)
+                continue
+            key = group_key(pod)
+        else:
+            # constraint-free fast shape: only selector/affinity/tolerations
+            if affinity is not None:
+                if not is_tensorizable(pod, allow_topology=allow_topo):
+                    rest_append(pod)
+                    continue
+                key = group_key(pod)
+            else:
+                sel = spec.node_selector
+                tol = spec.tolerations
+                key = (
+                    frozenset(spec.requests.items()),
+                    frozenset(sel.items()) if sel else (),
+                    (),
+                    frozenset(
+                        (t.key, t.operator, t.value, t.effect) for t in tol
+                    ) if tol else (),
+                )
+        g = get_group(key)
         if g is None:
             by_key[key] = PodGroup(
-                [pod], pod_requirements(pod), dict(pod.spec.requests)
+                [pod], pod_requirements(pod), dict(spec.requests)
             )
         else:
             g.pods.append(pod)
@@ -758,6 +864,15 @@ def _resolve_topology(
                 if gi >= 0
             )
 
+    uid2gi: Dict[str, int] = {}
+    for gi, uids in enumerate(group_uids):
+        for uid in uids:
+            uid2gi[uid] = gi
+    # tg identity -> tg: constraints whose owners span groups (or select
+    # beyond their own group) resolve in a second pass (shared carries)
+    shared_pending: Dict[int, object] = {}
+    group_specs: Dict[int, TopoSpec] = {}
+
     for gi, g in enumerate(groups):
         if gi in demote:
             continue
@@ -774,15 +889,14 @@ def _resolve_topology(
         ]
         constraints = []  # (cap, counts) per hostname constraint
         spec = TopoSpec()
+        group_specs[gi] = spec
         for tg in owned:
-            # shared TopologyGroup across groups -> coupled counting
-            if not tg.owners <= uids:
-                demote.add(gi)
-                break
+            # a TopologyGroup shared across groups (or selecting beyond its
+            # own group) is deferred to the shared-constraint pass
             matched = matched_owners(tg.namespaces, tg.selector)
-            if matched - {gi}:
-                demote.add(gi)  # selects pods outside this group
-                break
+            if not tg.owners <= uids or matched - {gi}:
+                shared_pending.setdefault(id(tg), tg)
+                continue
             self_sel = tg.selects(rep)
             if tg.key == labels_mod.HOSTNAME:
                 if tg.type is TopologyType.POD_AFFINITY:
@@ -908,13 +1022,148 @@ def _resolve_topology(
                 spec.host_counts[d] = spec.host_cap - max(residual, 0)
         g.topo = spec
 
-    # transitive closure: a demoted group's constraints join the oracle side
+    # -- shared constraints: one TopologyGroup spanning several groups -----
+    # (e.g. a Deployment's anti-affinity across request shapes). Tensorized
+    # via kernel carries when counting stays fully inside the tensorized
+    # groups: every owner pod grouped, the selector matches exactly the
+    # owner groups, and every owner group is selected (a mixed
+    # selected/unselected split would make the gate evolve mid-solve).
+    partners: Dict[int, set] = {}  # gi -> co-owners of any shared constraint
+    for tg in shared_pending.values():
+        owner_gis = set()
+        oracle_owner = False
+        for uid in tg.owners:
+            gi = uid2gi.get(uid)
+            if gi is None:
+                oracle_owner = True  # an owner pod routed to the oracle
+            else:
+                owner_gis.add(gi)
+        matched = matched_owners(tg.namespaces, tg.selector)
+        reps = {gi: groups[gi].pods[0] for gi in owner_gis}
+
+        def _admit() -> Optional[Tuple[str, object]]:
+            if oracle_owner or not owner_gis:
+                return None
+            if matched != owner_gis:
+                return None  # selects outside its owners (or misses some)
+            if not all(tg.selects(rep) for rep in reps.values()):
+                return None
+            if tg.key == labels_mod.HOSTNAME:
+                if tg.type is TopologyType.POD_AFFINITY:
+                    return None
+                cap = tg.max_skew if tg.type is TopologyType.SPREAD else 1
+                return (
+                    "h",
+                    SharedHostTG(
+                        cap=cap,
+                        counts={d: c for d, c in tg.domains.items() if c > 0},
+                    ),
+                )
+            if (
+                tg.key in DOMAIN_KEYS
+                and tg.type is not TopologyType.POD_ANTI_AFFINITY
+            ):
+                # the min/selection universe must be identical across the
+                # sharing groups (it is pod-admissibility-dependent)
+                universes = set()
+                for gi in owner_gis:
+                    gr = groups[gi].requirements
+                    pod_dom = (
+                        gr.get(tg.key)
+                        if gr.has(tg.key)
+                        else Requirement(tg.key, Operator.EXISTS)
+                    )
+                    universes.add(
+                        frozenset(d for d in tg.domains if pod_dom.has(d))
+                    )
+                if len(universes) != 1:
+                    return None
+                universe = next(iter(universes))
+                counts = {d: tg.domains[d] for d in universe}
+                if tg.type is TopologyType.SPREAD:
+                    min0 = (
+                        tg.min_domains is not None
+                        and len(counts) < tg.min_domains
+                    )
+                    return (
+                        "d",
+                        SharedDomainTG(
+                            key=tg.key,
+                            mode=DMODE_SPREAD,
+                            skew=tg.max_skew,
+                            min0=min0,
+                            prior=counts,
+                            reg=frozenset(counts),
+                        ),
+                    )
+                nonempty = [d for d, c in counts.items() if c > 0]
+                if nonempty:
+                    # compatible pods already placed: the options rule is a
+                    # STATIC gate to all nonempty domains — placements never
+                    # shrink it, and multi-domain placements are not
+                    # recorded (topologygroup.go:277-290) — so no carry;
+                    # gate every owner group like the single-group path
+                    return ("gate", (tg.key, nonempty))
+                return (
+                    "d",
+                    SharedDomainTG(
+                        key=tg.key,
+                        mode=DMODE_AFFINITY,
+                        prior=counts,
+                        reg=frozenset(counts),
+                    ),
+                )
+            return None
+
+        admitted = _admit()
+        if admitted is not None:
+            kind, desc = admitted
+            for gi in owner_gis:
+                spec = group_specs.get(gi)
+                if spec is None or gi in demote:
+                    admitted = None
+                    break
+                if kind == "h" and spec.shared_h is not None:
+                    admitted = None  # one shared hostname constraint/group
+                    break
+                if kind == "d" and (
+                    spec.shared_d is not None or spec.dmode != DMODE_NONE
+                ):
+                    admitted = None  # one domain-dynamic per group
+                    break
+            if admitted is not None:
+                for gi in owner_gis:
+                    spec = group_specs[gi]
+                    if kind == "h":
+                        spec.shared_h = desc
+                    elif kind == "gate":
+                        key, allowed = desc
+                        groups[gi].requirements.add(
+                            Requirement(key, Operator.IN, allowed)
+                        )
+                        continue  # static gate: no carry, no partner coupling
+                    else:
+                        spec.shared_d = desc
+                        spec.dmode = desc.mode
+                        spec.dkey = desc.key
+                        spec.dskew = desc.skew
+                        spec.dmin0 = desc.min0
+                        spec.dprior = desc.prior
+                        spec.dreg = desc.reg
+                    partners.setdefault(gi, set()).update(owner_gis - {gi})
+        if admitted is None:
+            demote.update(owner_gis)
+
+    # transitive closure: a demoted group's constraints join the oracle
+    # side, and a demoted group drags every partner of its shared
+    # constraints with it (split counting would be wrong)
     pending = set(demote)
     while pending:
         gi = pending.pop()
         before = set(demote)
         for p in groups[gi].pods:
             demote_by_selectors(p)
+        demote.update(partners.get(gi, ()))
         pending |= demote - before
 
     kept = [g for gi, g in enumerate(groups) if gi not in demote]

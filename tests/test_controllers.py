@@ -347,3 +347,50 @@ class TestClaimCRHygiene:
         provision_cycle(env)
         claim = client.list(NodeClaim)[0]
         assert all(r.key != labels.HOSTNAME for r in claim.spec.requirements)
+
+
+class TestDisruptionEncodeCache:
+    def test_probes_reuse_static_encode(self, env):
+        """Every scheduling simulation the disruption engine runs shares one
+        catalog-fingerprinted EncodeCache: the second probe must find (and
+        keep) the static arrays the first probe encoded, instead of paying
+        the full vocab+table encode per binary-search step."""
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 10.0
+        client.create(pool)
+        for _ in range(2):
+            client.create(make_pod(cpu="750m", memory="1Gi"))
+            provision_cycle(env)
+        clock.step(25)  # past the pod-nomination window
+        operator.nodeclaim_disruption.reconcile_all()
+
+        from karpenter_tpu.controllers.disruption.helpers import (
+            get_candidates, simulate_scheduling,
+        )
+
+        ctx = operator.disruption.ctx
+        assert ctx.encode_cache is not None
+        cands = get_candidates(ctx.client, ctx.cluster, ctx.cloud_provider, clock)
+        assert cands
+
+        simulate_scheduling(
+            ctx.client, ctx.cluster, ctx.cloud_provider, cands[:1],
+            encode_cache=ctx.encode_cache,
+        )
+        cache1 = ctx.encode_cache.cache
+        static_ids = {
+            k: id(v)
+            for k, v in cache1.items()
+            if isinstance(k, tuple) and k and k[0] != "a_tzc"
+        }
+        assert static_ids, "first probe must populate the shared static cache"
+
+        simulate_scheduling(
+            ctx.client, ctx.cluster, ctx.cloud_provider, cands[:1],
+            encode_cache=ctx.encode_cache,
+        )
+        # same catalog -> same cache dict, same static array objects
+        assert ctx.encode_cache.cache is cache1
+        for k, obj_id in static_ids.items():
+            assert id(cache1[k]) == obj_id, f"static entry {k} was re-encoded"

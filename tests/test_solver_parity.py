@@ -658,3 +658,173 @@ class TestZonalTopology:
         oracle_r, tpu_r = run_both(pods)
         assert tpu_r.all_pods_scheduled()
         assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+
+
+class TestSharedConstraints:
+    """One TopologyGroup spanning several pod groups (multi-shape
+    deployments): counting rides the kernel's shared carries instead of
+    demoting to the oracle."""
+
+    def _mk(self, pods):
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        return node_pools, its_by_pool, topo
+
+    def test_multi_shape_anti_affinity_rides_fast_path(self):
+        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+        from karpenter_tpu.solver import encode as enc
+
+        app = {"app": "santi"}
+        term = PodAffinityTerm(
+            topology_key=labels.HOSTNAME,
+            label_selector=LabelSelector(match_labels=dict(app)),
+        )
+        # three request shapes -> three groups sharing one anti constraint
+        pods = (
+            make_pods(3, cpu="1", memory="1Gi", labels=app, pod_anti_affinity=[term])
+            + make_pods(3, cpu="2", memory="2Gi", labels=app, pod_anti_affinity=[term])
+            + make_pods(2, cpu="500m", memory="512Mi", labels=app, pod_anti_affinity=[term])
+        )
+        node_pools, its_by_pool, topo = self._mk(pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not rest and len(groups) == 3
+        assert all(g.topo is not None and g.topo.shared_h is not None for g in groups)
+        shared = {id(g.topo.shared_h) for g in groups}
+        assert len(shared) == 1  # one descriptor across all three groups
+
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+        # every pod on its own claim, across shapes
+        assert tpu_r.node_count() == 8
+        for claim in tpu_r.new_node_claims:
+            assert len(claim.pods) <= 1
+
+    def test_multi_shape_hostname_spread_parity(self):
+        from helpers import spread_constraint
+
+        app = {"app": "shspread"}
+        spread = [spread_constraint(labels.HOSTNAME, max_skew=2, labels=app)]
+        pods = (
+            make_pods(4, cpu="1", memory="1Gi", labels=app, spread=list(spread))
+            + make_pods(4, cpu="2", memory="2Gi", labels=app, spread=list(spread))
+        )
+        oracle_r, tpu_r = run_both(pods)
+        assert_parity(oracle_r, tpu_r, cost_tol=0.02)
+        # <=2 selected pods per claim ACROSS both shapes
+        for claim in tpu_r.new_node_claims:
+            assert len(claim.pods) <= 2
+
+    def test_multi_shape_zonal_spread_carry(self):
+        from helpers import spread_constraint
+        from karpenter_tpu.solver import encode as enc
+
+        app = {"app": "szonal"}
+        spread = [spread_constraint(labels.TOPOLOGY_ZONE, labels=app)]
+        pods = (
+            make_pods(5, cpu="1", memory="1Gi", labels=app, spread=list(spread))
+            + make_pods(4, cpu="2", memory="2Gi", labels=app, spread=list(spread))
+        )
+        node_pools, its_by_pool, topo = self._mk(pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not rest and len(groups) == 2
+        assert all(g.topo is not None and g.topo.shared_d is not None for g in groups)
+
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        # counts accumulate across both groups: 9 pods over 3 zones, skew 1
+        dist = {}
+        for claim in results.new_node_claims:
+            zr = claim.requirements.get(labels.TOPOLOGY_ZONE)
+            assert not zr.complement and len(zr.values) == 1
+            z = next(iter(zr.values))
+            dist[z] = dist.get(z, 0) + len(claim.pods)
+        assert sum(dist.values()) == 9
+        assert max(dist.values()) - min(dist.values()) <= 1
+
+    def test_multi_shape_zonal_affinity_follows_leader(self):
+        from helpers import affinity_term
+        from karpenter_tpu.solver import encode as enc
+
+        app = {"app": "saff"}
+        terms = [affinity_term(labels.TOPOLOGY_ZONE, app)]
+        pods = (
+            make_pods(3, cpu="1", memory="1Gi", labels=app, pod_affinity=list(terms))
+            + make_pods(3, cpu="2", memory="2Gi", labels=app, pod_affinity=list(terms))
+        )
+        node_pools, its_by_pool, topo = self._mk(pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not rest and len(groups) == 2
+
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        zones = set()
+        for claim in results.new_node_claims:
+            zr = claim.requirements.get(labels.TOPOLOGY_ZONE)
+            if not zr.complement and len(zr.values) == 1:
+                zones.add(next(iter(zr.values)))
+        assert len(zones) == 1  # the second group followed the first's domain
+
+    def test_shared_selector_mismatch_still_demotes(self):
+        from helpers import spread_constraint
+        from karpenter_tpu.solver import encode as enc
+
+        # the shared constraint also selects a plain group -> oracle
+        app = {"app": "smix"}
+        spread = [spread_constraint(labels.HOSTNAME, labels=app)]
+        pods = (
+            make_pods(3, cpu="1", memory="1Gi", labels=app, spread=list(spread))
+            + make_pods(3, cpu="2", memory="2Gi", labels=app, spread=list(spread))
+            + make_pods(2, cpu="3", memory="3Gi", labels=app)  # selected, no constraint
+        )
+        node_pools, its_by_pool, topo = self._mk(pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 8
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_multi_shape_affinity_with_priors_gates_not_pins(self):
+        """Shared affinity whose compatible pods already sit in TWO zones
+        must gate to BOTH (the options rule), not pin to one — pods must
+        still schedule when the lowest-rank nonempty zone is unusable."""
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from helpers import affinity_term
+
+        app = {"app": "sgate"}
+        client = Client(TestClock())
+        for zone in ("test-zone-a", "test-zone-b"):
+            node = Node(
+                metadata=ObjectMeta(
+                    name=f"prior-{zone}",
+                    labels={labels.TOPOLOGY_ZONE: zone,
+                            labels.HOSTNAME: f"prior-{zone}"},
+                ),
+            )
+            node.status.capacity = {
+                "cpu": res.parse_quantity("4"),
+                "memory": res.parse_quantity("16Gi"),
+            }
+            node.status.allocatable = dict(node.status.capacity)
+            node.status.ready = True
+            client.create(node)
+            client.create(
+                make_pod(labels=app, node_name=node.metadata.name, phase="Running")
+            )
+
+        terms = [affinity_term(labels.TOPOLOGY_ZONE, app)]
+        pods = (
+            make_pods(3, cpu="1", memory="1Gi", labels=app, pod_affinity=list(terms))
+            + make_pods(3, cpu="2", memory="2Gi", labels=app, pod_affinity=list(terms))
+        )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(client, [], node_pools, its_by_pool, pods)
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            zr = claim.requirements.get(labels.TOPOLOGY_ZONE)
+            assert set(zr.values) <= {"test-zone-a", "test-zone-b"}

@@ -48,7 +48,11 @@ DISRUPTED_TAINT_KEY = f"{GROUP}/disrupted"
 UNREGISTERED_TAINT_KEY = f"{GROUP}/unregistered"
 
 # WellKnownLabels: restricted-domain labels that pods/nodepools may still
-# constrain (reference: labels.go:79-92).
+# constrain (reference: labels.go:79-92). The reservation-id label is
+# provider-registered in the reference (fake/cloudprovider.go:44 inserts it
+# into WellKnownLabels so reserved-offering compatibility checks pass);
+# this build's providers all use the one label, so it is registered here.
+RESERVATION_ID_LABEL = f"{GROUP}/reservation-id"
 WELL_KNOWN_LABELS = frozenset(
     {
         NODEPOOL_LABEL_KEY,
@@ -59,6 +63,7 @@ WELL_KNOWN_LABELS = frozenset(
         OS,
         CAPACITY_TYPE_LABEL_KEY,
         WINDOWS_BUILD,
+        RESERVATION_ID_LABEL,
     }
 )
 

@@ -16,7 +16,7 @@ from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api.requirements import Operator, Requirement, Requirements
 
-RESERVATION_ID_LABEL = f"{labels_mod.GROUP}/reservation-id"
+RESERVATION_ID_LABEL = labels_mod.RESERVATION_ID_LABEL
 
 _MAX_PRICE = math.inf
 

@@ -85,7 +85,7 @@ def solve_core_native(
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
-    a_tzc,
+    a_tzc, res_cap0, a_res,
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     nh_cnt0, dd0,
     well_known,
@@ -119,6 +119,8 @@ def solve_core_native(
     g_dtg = _as(g_dtg, np.int32)
     nh_cnt0 = _as(nh_cnt0, np.int32)
     dd0 = _as(dd0, np.int32)
+    res_cap0 = _as(res_cap0, np.int32)
+    a_res = _as(a_res, np.uint8)
     g_def, g_neg, g_mask = (_as(x, np.uint8) for x in (g_def, g_neg, g_mask))
     p_def, p_neg, p_mask = (_as(x, np.uint8) for x in (p_def, p_neg, p_mask))
     p_daemon = _as(p_daemon, np.float32)
@@ -144,6 +146,7 @@ def solve_core_native(
     N = n_avail.shape[0]
     JH = nh_cnt0.shape[1] if nh_cnt0.ndim == 2 else 1
     JD = dd0.shape[0] if dd0.ndim == 2 else 1
+    NRES = res_cap0.shape[0]
 
     c_pool = np.zeros(nmax, np.int32)
     c_tmask = np.zeros((nmax, T), np.uint8)
@@ -154,12 +157,13 @@ def solve_core_native(
     unplaced = np.zeros(G, np.int32)
     c_dzone = np.full(nmax, -1, np.int32)
     c_dct = np.full(nmax, -1, np.int32)
+    c_resv = np.zeros(nmax, np.uint8)
 
     lib.kt_solve(
         ctypes.c_int(G), ctypes.c_int(T), ctypes.c_int(P), ctypes.c_int(N),
         ctypes.c_int(R), ctypes.c_int(K), ctypes.c_int(V1), ctypes.c_int(O),
         ctypes.c_int(nmax), ctypes.c_int(zone_kid), ctypes.c_int(ct_kid),
-        ctypes.c_int(JH), ctypes.c_int(JD),
+        ctypes.c_int(JH), ctypes.c_int(JD), ctypes.c_int(NRES),
         _ptr(g_count), _ptr(g_req), _ptr(g_def), _ptr(g_neg), _ptr(g_mask),
         _ptr(g_hcap),
         _ptr(g_dmode), _ptr(g_dkey), _ptr(g_dskew), _ptr(g_dmin0),
@@ -169,7 +173,7 @@ def solve_core_native(
         _ptr(p_has_limit), _ptr(p_tol), _ptr(p_titype_ok),
         _ptr(t_def), _ptr(t_mask), _ptr(t_alloc), _ptr(t_cap),
         _ptr(o_avail), _ptr(o_zone), _ptr(o_ct),
-        _ptr(a_tzc),
+        _ptr(a_tzc), _ptr(res_cap0), _ptr(a_res),
         _ptr(n_def), _ptr(n_mask), _ptr(n_avail), _ptr(n_base), _ptr(n_tol),
         _ptr(n_hcnt),
         _ptr(n_dzone), _ptr(n_dct),
@@ -177,7 +181,7 @@ def solve_core_native(
         _ptr(well_known),
         _ptr(c_pool), _ptr(c_tmask), _ptr(n_open), _ptr(overflow),
         _ptr(exist_fills), _ptr(claim_fills), _ptr(unplaced),
-        _ptr(c_dzone), _ptr(c_dct),
+        _ptr(c_dzone), _ptr(c_dct), _ptr(c_resv),
     )
     return (
         c_pool,
@@ -189,4 +193,5 @@ def solve_core_native(
         unplaced,
         c_dzone,
         c_dct,
+        c_resv.astype(bool),
     )

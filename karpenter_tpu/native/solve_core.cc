@@ -173,7 +173,7 @@ extern "C" {
 int kt_solve(
     // dims
     int G, int T, int P, int N, int R, int K, int V1, int O, int NMAX,
-    int zone_kid, int ct_kid, int JH, int JD,
+    int zone_kid, int ct_kid, int JH, int JD, int NRES,
     // groups (FFD order)
     const int32_t* g_count, const float* g_req, const uint8_t* g_def,
     const uint8_t* g_neg, const uint8_t* g_mask,
@@ -195,7 +195,10 @@ int kt_solve(
     const float* t_cap,
     // offerings
     const uint8_t* o_avail, const int32_t* o_zone, const int32_t* o_ct,
-    const uint8_t* a_tzc,  // [T, V1, V1]
+    const uint8_t* a_tzc,   // [T, V1, V1] (reserved offerings excluded
+                            // when the reservation ledger is active)
+    const int32_t* res_cap0,  // [NRES] reservation capacities
+    const uint8_t* a_res,     // [NRES, T, V1, V1] per-reservation availability
     // existing nodes
     const uint8_t* n_def, const uint8_t* n_mask, const float* n_avail,
     const float* n_base, const uint8_t* n_tol,
@@ -213,7 +216,8 @@ int kt_solve(
     int32_t* out_claim_fills, // [G, NMAX]
     int32_t* out_unplaced,    // [G]
     int32_t* out_c_dzone,     // [NMAX] pinned zone value id (-1 = unpinned)
-    int32_t* out_c_dct        // [NMAX] pinned capacity-type value id
+    int32_t* out_c_dct,       // [NMAX] pinned capacity-type value id
+    uint8_t* out_c_resv       // [NMAX] claim holds its reservations
 ) {
   const int KV = K * V1;
   const int NSLOT = V1 + 2;  // V1 domains + ANY + DEAD
@@ -297,6 +301,30 @@ int kt_solve(
   std::vector<int32_t> ch_cnt(static_cast<size_t>(NMAX) * JH, 0);
   std::vector<int32_t> nhc(nh_cnt0, nh_cnt0 + static_cast<size_t>(N) * JH);
   std::vector<int32_t> ddc(dd0, dd0 + static_cast<size_t>(JD) * V1);
+  // reservation ledger (reservationmanager.go:28-85): availability views
+  // for unheld placements (a_step: live reservations only) and for claims
+  // already holding reservations (a_held: all reservations)
+  const size_t a_sz = static_cast<size_t>(T) * V1 * V1;
+  std::vector<int32_t> res_rem(res_cap0, res_cap0 + NRES);
+  std::vector<uint8_t> c_resv(NMAX, 0);
+  std::vector<uint8_t> a_step(a_tzc, a_tzc + a_sz);
+  std::vector<uint8_t> a_held(a_tzc, a_tzc + a_sz);
+  auto refresh_a_step = [&]() {
+    std::copy(a_tzc, a_tzc + a_sz, a_step.begin());
+    for (int r = 0; r < NRES; ++r) {
+      if (res_rem[r] <= 0) continue;
+      const uint8_t* ar = a_res + static_cast<size_t>(r) * a_sz;
+      for (size_t i = 0; i < a_sz; ++i) a_step[i] |= ar[i];
+    }
+  };
+  refresh_a_step();
+  for (int r = 0; r < NRES; ++r) {
+    const uint8_t* ar = a_res + static_cast<size_t>(r) * a_sz;
+    for (size_t i = 0; i < a_sz; ++i) a_held[i] |= ar[i];
+  }
+  auto a_for_claim = [&](int s) -> const uint8_t* {
+    return (NRES && c_resv[s]) ? a_held.data() : a_step.data();
+  };
   std::vector<float> pool_rem(p_limit, p_limit + static_cast<size_t>(P) * R);
   int32_t n_open = 0;
   bool overflow = false;
@@ -387,7 +415,7 @@ int kt_solve(
           other_row[v] = pm[other_kid * V1 + v] && gmask[other_kid * V1 + v];
         for (int t = 0; t < T; ++t) {
           if (!type_ok_pgt[(static_cast<size_t>(p) * G + gi) * T + t]) continue;
-          const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
+          const uint8_t* az = a_step.data() + static_cast<size_t>(t) * V1 * V1;
           for (int d = 0; d < V1; ++d) {
             if (fresh_ok[d]) continue;
             if (!(pm[kid_sel * V1 + d] && gmask[kid_sel * V1 + d])) continue;
@@ -504,9 +532,9 @@ int kt_solve(
                                  c_used.data() + static_cast<size_t>(s) * R,
                                  req, R);
         if (add < 1) continue;
-        // offering over merged zone/ct masks via a_tzc
+        // offering over merged zone/ct masks via the ledger-aware view
         bool off = false;
-        const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
+        const uint8_t* az = a_for_claim(s) + static_cast<size_t>(t) * V1 * V1;
         for (int z = 0; z < V1 && !off; ++z) {
           if (!(sm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z])) continue;
           for (int c = 0; c < V1; ++c) {
@@ -609,7 +637,8 @@ int kt_solve(
         if (keep) {
           // offering under the (now merged, possibly pinned) masks
           bool off = false;
-          const uint8_t* az = a_tzc + static_cast<size_t>(t) * V1 * V1;
+          const uint8_t* az =
+              a_for_claim(s) + static_cast<size_t>(t) * V1 * V1;
           for (int z = 0; z < V1 && !off; ++z) {
             if (!sm[zone_kid * V1 + z]) continue;
             for (int c = 0; c < V1; ++c)
@@ -663,9 +692,26 @@ int kt_solve(
           for (int v = 0; v < V1; ++v)
             other_row[v] =
                 pm[other_kid * V1 + v] && gmask[other_kid * V1 + v];
-          if (!off_in_domain(a_tzc + static_cast<size_t>(t) * V1 * V1, dkey,
-                             d_sel, other_row.data(), V1))
+          if (!off_in_domain(a_step.data() + static_cast<size_t>(t) * V1 * V1,
+                             dkey, d_sel, other_row.data(), V1))
             return false;
+        }
+        if (NRES) {
+          // the static type_ok table saw the full catalog; re-gate on the
+          // ledger-aware view under the template∪group zone/ct masks
+          const uint8_t* pm = p_mask + static_cast<size_t>(p) * KV;
+          const uint8_t* az = a_step.data() + static_cast<size_t>(t) * V1 * V1;
+          bool any = false;
+          for (int z = 0; z < V1 && !any; ++z) {
+            if (!(pm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z])) continue;
+            for (int c = 0; c < V1; ++c)
+              if (az[z * V1 + c] && pm[ct_kid * V1 + c] &&
+                  gmask[ct_kid * V1 + c]) {
+                any = true;
+                break;
+              }
+          }
+          if (!any) return false;
         }
         return true;
       };
@@ -702,6 +748,38 @@ int kt_solve(
         continue;
       }
       const int32_t rem_d = qrem[d_sel];
+      // reservation clamp: every claim of the bulk reserves one slot per
+      // compatible reservation (idempotent per hostname)
+      bool any_resv = false;
+      std::vector<uint8_t> r_compat(NRES ? NRES : 1, 0);
+      int64_t k_resv = kBigFit;
+      if (NRES) {
+        const uint8_t* pm = p_mask + static_cast<size_t>(p_star) * KV;
+        for (int r = 0; r < NRES; ++r) {
+          if (res_rem[r] <= 0) continue;
+          bool compat = false;
+          for (int t = 0; t < T && !compat; ++t) {
+            if (!avail_t[t]) continue;
+            const uint8_t* ar =
+                a_res + (static_cast<size_t>(r) * T + t) * V1 * V1;
+            for (int z = 0; z < V1 && !compat; ++z) {
+              if (!(pm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z]))
+                continue;
+              for (int c = 0; c < V1; ++c)
+                if (ar[z * V1 + c] && pm[ct_kid * V1 + c] &&
+                    gmask[ct_kid * V1 + c]) {
+                  compat = true;
+                  break;
+                }
+            }
+          }
+          if (compat) {
+            r_compat[r] = 1;
+            any_resv = true;
+            k_resv = std::min<int64_t>(k_resv, res_rem[r]);
+          }
+        }
+      }
       int64_t k_limit = kBigFit;
       if (p_has_limit[p_star]) {
         for (int r = 0; r < R; ++r)
@@ -714,6 +792,7 @@ int kt_solve(
       }
       int64_t k_want = std::min<int64_t>(
           (rem_d + n_per - 1) / n_per, std::max<int64_t>(k_limit, 0));
+      if (any_resv) k_want = std::min(k_want, k_resv);
       int64_t k_slots = NMAX - n_open;
       if (k_want > k_slots) overflow = true;
       int64_t k = std::min(k_want, k_slots);
@@ -752,7 +831,13 @@ int kt_solve(
         }
         out_claim_fills[static_cast<size_t>(gi) * NMAX + slot] = n_take;
         if (has_h) ch_cnt[static_cast<size_t>(slot) * JH + jh] = n_take;
+        c_resv[slot] = any_resv;
         placed += n_take;
+      }
+      if (any_resv) {
+        for (int r = 0; r < NRES; ++r)
+          if (r_compat[r]) res_rem[r] -= static_cast<int32_t>(k);
+        refresh_a_step();
       }
       if (p_has_limit[p_star])
         for (int r = 0; r < R; ++r)
@@ -778,6 +863,7 @@ int kt_solve(
   std::memcpy(out_c_tmask, c_tmask.data(), sizeof(uint8_t) * NMAX * T);
   std::memcpy(out_c_dzone, c_dzone.data(), sizeof(int32_t) * NMAX);
   std::memcpy(out_c_dct, c_dct.data(), sizeof(int32_t) * NMAX);
+  std::memcpy(out_c_resv, c_resv.data(), sizeof(uint8_t) * NMAX);
   out_n_open[0] = n_open;
   out_overflow[0] = overflow ? 1 : 0;
   return overflow ? 1 : 0;

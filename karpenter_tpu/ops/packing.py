@@ -122,6 +122,8 @@ class PackState(NamedTuple):
     ch_cnt: jnp.ndarray  # [NMAX, JH] int32 per-claim shared hostname counts
     nhc: jnp.ndarray  # [N, JH] int32 per-node shared hostname counts
     ddc: jnp.ndarray  # [JD, V1] int32 shared domain counts
+    res_rem: jnp.ndarray  # [NRES] int32 remaining reservation capacity
+    c_resv: jnp.ndarray  # [NMAX] bool claim holds its reservations
     pool_rem: jnp.ndarray  # [P, R]
     n_open: jnp.ndarray  # scalar int32
     overflow: jnp.ndarray  # scalar bool
@@ -140,8 +142,11 @@ def pack(
     cap_ng,  # [N, G] existing-node capacity at t0 (compat ∧ taints)
     # instance types
     t_alloc, t_cap,
-    # offerings zone×ct availability per type
+    # offerings zone×ct availability per type (excludes reserved offerings
+    # when the reservation ledger is active — those ride a_res)
     a_tzc,  # [T, Vz, Vc] bool
+    res_cap0,  # [NRES] int32 reservation capacities (reservationmanager.go)
+    a_res,  # [NRES, T, Vz, Vc] bool per-reservation availability
     # templates
     p_mask, p_daemon, p_limit, p_has_limit, p_tol,
     # existing nodes
@@ -172,6 +177,12 @@ def pack(
     ANY, DEAD = V1, V1 + 1
 
     a_tzc_f = a_tzc.astype(jnp.float32)
+    # reservation ledger (reservationmanager.go:28-85): reserved offerings
+    # are available only while their reservation has remaining capacity;
+    # claims HOLDING reservations keep seeing them regardless (a_held)
+    NRES = res_cap0.shape[0]
+    if NRES:
+        a_held_f = (a_tzc | jnp.any(a_res, axis=0)).astype(jnp.float32)
 
     state = PackState(
         exist_used=n_base,
@@ -188,6 +199,8 @@ def pack(
         ch_cnt=jnp.zeros((nmax, nh_cnt0.shape[1]), jnp.int32),
         nhc=nh_cnt0.astype(jnp.int32),
         ddc=dd0.astype(jnp.int32),
+        res_rem=res_cap0.astype(jnp.int32),
+        c_resv=jnp.zeros((nmax,), bool),
         pool_rem=p_limit,
         n_open=jnp.int32(0),
         overflow=jnp.bool_(False),
@@ -228,23 +241,44 @@ def pack(
         cz = jnp.take(state.c_mask, zone_kid, axis=1) & gz[None, :]  # [NMAX,V1]
         cc = jnp.take(state.c_mask, ct_kid, axis=1) & gc[None, :]
 
+        # ledger-aware availability for this step's placements
+        if NRES:
+            a_step_f = (
+                a_tzc
+                | jnp.any(a_res & (state.res_rem > 0)[:, None, None, None], axis=0)
+            ).astype(jnp.float32)
+        else:
+            a_step_f = a_tzc_f
+        if NRES or has_domains:
+            pzm = p_mask[:, zone_kid, :] & gz[None, :]  # [P, V1]
+            pcm = p_mask[:, ct_kid, :] & gc[None, :]
+
         if has_domains:
             # ---- per-domain offering availability ----------------------
             # For each claim/template and type: is an offering available in
             # domain slot d of the constrained axis, under the entity's
             # mask on the OTHER axis (offering_ok resolved per domain).
-            av_z = jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_tzc_f) > 0
-            av_c = jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_tzc_f) > 0
+            av_z = jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_step_f) > 0
+            av_c = jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_step_f) > 0
+            if NRES:
+                av_z = jnp.where(
+                    state.c_resv[:, None, None],
+                    jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_held_f) > 0,
+                    av_z,
+                )
+                av_c = jnp.where(
+                    state.c_resv[:, None, None],
+                    jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_held_f) > 0,
+                    av_c,
+                )
             toff_nt = jnp.where(
                 dkey == 0, av_z & cz[:, None, :], av_c & cc[:, None, :]
             )  # [NMAX, T, V1]
 
-            pz = p_mask[:, zone_kid, :] & gz[None, :]  # [P, V1]
-            pc = p_mask[:, ct_kid, :] & gc[None, :]
-            pav_z = jnp.einsum("pc,tzc->ptz", pc.astype(jnp.float32), a_tzc_f) > 0
-            pav_c = jnp.einsum("pz,tzc->ptc", pz.astype(jnp.float32), a_tzc_f) > 0
+            pav_z = jnp.einsum("pc,tzc->ptz", pcm.astype(jnp.float32), a_step_f) > 0
+            pav_c = jnp.einsum("pz,tzc->ptc", pzm.astype(jnp.float32), a_step_f) > 0
             toff_pt = jnp.where(
-                dkey == 0, pav_z & pz[:, None, :], pav_c & pc[:, None, :]
+                dkey == 0, pav_z & pzm[:, None, :], pav_c & pcm[:, None, :]
             )  # [P, T, V1]
 
         # ---- 1. existing nodes, fixed priority order ----
@@ -374,10 +408,19 @@ def pack(
             off = (
                 jnp.einsum(
                     "nz,tzc,nc->nt",
-                    cz.astype(jnp.float32), a_tzc_f, cc.astype(jnp.float32),
+                    cz.astype(jnp.float32), a_step_f, cc.astype(jnp.float32),
                 )
                 > 0
             )
+            if NRES:
+                off_held = (
+                    jnp.einsum(
+                        "nz,tzc,nc->nt",
+                        cz.astype(jnp.float32), a_held_f, cc.astype(jnp.float32),
+                    )
+                    > 0
+                )
+                off = jnp.where(state.c_resv[:, None], off_held, off)
         tm = tm & off & (add_fit >= 1)
 
         cap_any = jnp.where(claim_live, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0)
@@ -485,6 +528,34 @@ def pack(
                 True,
             )  # [P, T]
             avail = type_ok_pgt[:, gi, :] & within_limits & tdok  # [P, T]
+            if NRES:
+                # the static type_ok table (and the step-start toff_pt) saw
+                # the full offering catalog; re-gate types on what the
+                # CURRENT ledger still offers — overall, and specifically in
+                # the selected domain (a bulk may have just drained the only
+                # reservation backing it)
+                a_b = a_tzc | jnp.any(
+                    a_res & (st.res_rem > 0)[:, None, None, None], axis=0
+                )
+                a_b_f = a_b.astype(jnp.float32)
+                t_eff = (
+                    jnp.einsum(
+                        "pz,tzc,pc->pt",
+                        pzm.astype(jnp.float32), a_b_f, pcm.astype(jnp.float32),
+                    )
+                    > 0
+                )
+                d_c = jnp.clip(d_sel, 0, V1 - 1)
+                eff_z = (
+                    jnp.einsum("pc,tc->pt", pcm.astype(jnp.float32), a_b_f[:, d_c, :])
+                    > 0
+                ) & pzm[:, d_c][:, None]
+                eff_c = (
+                    jnp.einsum("pz,tz->pt", pzm.astype(jnp.float32), a_b_f[:, :, d_c])
+                    > 0
+                ) & pcm[:, d_c][:, None]
+                eff_dom = jnp.where(dkey == 0, eff_z, eff_c)
+                avail = avail & jnp.where(is_any, t_eff, eff_dom)
             feas_p = jnp.any(avail, axis=-1)
             p_star = jnp.argmax(feas_p)  # first True in weight order
             any_feasible = jnp.any(feas_p)
@@ -514,6 +585,30 @@ def pack(
                 jnp.ceil(rem_d / jnp.maximum(n_per, 1)).astype(jnp.int32),
                 jnp.where(jnp.isinf(k_limit), 2**30, k_limit).astype(jnp.int32),
             )
+            if NRES:
+                # every claim of the bulk reserves one slot per compatible
+                # reservation (idempotent per hostname,
+                # reservationmanager.go:28-48); the ledger bounds the bulk
+                r_has = (
+                    jnp.einsum(
+                        "z,rtzc,c->rt",
+                        pzm[p_star].astype(jnp.float32),
+                        a_res.astype(jnp.float32),
+                        pcm[p_star].astype(jnp.float32),
+                    )
+                    > 0
+                )  # [NRES, T]
+                r_compat = jnp.any(r_has & avail[p_star][None, :], axis=1) & (
+                    st.res_rem > 0
+                )
+                any_resv = jnp.any(r_compat)
+                k_resv = jnp.min(jnp.where(r_compat, st.res_rem, 2**30))
+                k_want = jnp.minimum(
+                    k_want, jnp.where(any_resv, k_resv, 2**30)
+                )
+            else:
+                any_resv = jnp.bool_(False)
+                r_compat = None
             slot = st.n_open
             k_slots = jnp.maximum(nmax - slot, 0)
             k = jnp.minimum(k_want, k_slots)
@@ -569,6 +664,12 @@ def pack(
                 ),
                 c_dct=write(st.c_dct, jnp.where(dkey == 1, d_pin, -1)),
                 ch_cnt=write(st.ch_cnt, takes[:, None] * jh_oh[None, :]),
+                c_resv=write(st.c_resv, any_resv),
+                res_rem=(
+                    st.res_rem - jnp.where(r_compat, k, 0)
+                    if NRES
+                    else st.res_rem
+                ),
                 pool_rem=pool_rem,
                 n_open=slot + k,
                 overflow=st.overflow

@@ -25,7 +25,7 @@ def solve_core(
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
-    a_tzc,
+    a_tzc, res_cap0, a_res,
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     nh_cnt0, dd0,
     well_known,
@@ -60,7 +60,7 @@ def solve_core(
         compat_pg, type_ok, n_fit,
         cap_ng,
         t_alloc, t_cap,
-        a_tzc,
+        a_tzc, res_cap0, a_res,
         p_mask, p_daemon, p_limit, p_has_limit, p_tol,
         n_avail, n_base,
         n_hcnt,
@@ -82,6 +82,7 @@ def solve_core(
         unplaced,
         state.c_dzone,
         state.c_dct,
+        state.c_resv,
     )
 
 
@@ -105,7 +106,7 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
     is static per snapshot).
     """
     (c_pool, c_tmask, n_open, overflow,
-     exist_fills, claim_fills, unplaced, c_dzone, c_dct) = solve_core(
+     exist_fills, claim_fills, unplaced, c_dzone, c_dct, c_resv) = solve_core(
         *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid,
         has_domains=has_domains)
     n, t = c_tmask.shape
@@ -122,6 +123,7 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
         unplaced,
         c_dzone.astype(jnp.int16),
         c_dct.astype(jnp.int16),
+        c_resv,
     )
 
 

@@ -88,6 +88,8 @@ def snapshot_shardings(mesh) -> Tuple:
         t,  # o_zone [T, O]
         t,  # o_ct [T, O]
         t,  # a_tzc [T, V1, V1]
+        rep,  # res_cap0 [NRES]
+        S(None, "model"),  # a_res [NRES, T, V1, V1]
         rep,  # n_def [N, K]
         rep,  # n_mask
         rep,  # n_avail

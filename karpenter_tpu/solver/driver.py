@@ -27,6 +27,7 @@ from ..api import resources as res
 from ..api.objects import NodePool, Pod
 from ..api.requirements import Operator, Requirement, Requirements
 from ..cloudprovider import types as cp
+from ..scheduling.inflight import RESERVED_OFFERING_MODE_STRICT
 from ..scheduling.scheduler import Results, Scheduler
 from ..scheduling.template import NodeClaimTemplate
 from ..scheduling.topology import Topology
@@ -109,12 +110,13 @@ class SolverConfig:
 class DecodedClaim:
     """A claim produced by the TPU path; duck-types InFlightNodeClaim for
     Results consumers (pods, instance_type_options, requirements,
-    template)."""
+    template, reserved_offerings)."""
 
     template: NodeClaimTemplate
     pods: List[Pod]
     instance_type_options: List[cp.InstanceType]
     requirements: Requirements
+    reserved_offerings: List[cp.Offering] = field(default_factory=list)
 
     def finalize(self) -> None:  # parity with InFlightNodeClaim
         pass
@@ -159,12 +161,28 @@ class TpuSolver:
     def solve(self, pods: Sequence[Pod]) -> Results:
         if self.config.force_oracle:
             return self.oracle.solve(pods)
+        if (
+            self.oracle.reserved_capacity_enabled
+            and self.oracle.reserved_offering_mode
+            == RESERVED_OFFERING_MODE_STRICT
+        ):
+            # strict reservation policy raises mid-Add and blocks pool
+            # fallback (scheduler.py:244-258) — inherently sequential;
+            # the kernel ledger covers the default fallback mode
+            return self.oracle.solve(pods)
         groups, rest = enc.partition_and_group(pods, topology=self.oracle.topology)
 
         tpu_claims: List[DecodedClaim] = []
         tpu_errors: Dict[str, object] = {}
         if groups:
             tpu_claims, tpu_errors = self._solve_fast(groups)
+            # the oracle's ReservationManager must see the fast path's
+            # holdings before it solves the remainder, or a mixed batch
+            # double-books reservation capacity
+            rm = self.oracle.reservation_manager
+            for i, claim in enumerate(tpu_claims):
+                for o in claim.reserved_offerings:
+                    rm.reserve(f"tpu-claim-{i}", o)
 
         results = self.oracle.solve(rest) if rest else Results(
             new_node_claims=[], existing_nodes=self.oracle.existing_nodes, pod_errors={}
@@ -201,10 +219,14 @@ class TpuSolver:
             vocab=vocab,
             cache=cache,
         )
-        avail_key = ("a_tzc",) + snap.vocab.padded_shape()
-        a_tzc = cache.get(avail_key)
-        if a_tzc is None:
-            a_tzc = cache[avail_key] = self._offering_availability(snap)
+        reserved_enabled = self.oracle.reserved_capacity_enabled
+        avail_key = ("a_tzc", reserved_enabled) + snap.vocab.padded_shape()
+        avail = cache.get(avail_key)
+        if avail is None:
+            avail = cache[avail_key] = self._offering_availability(
+                snap, reserved_enabled
+            )
+        a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
         statics = dict(
@@ -214,7 +236,7 @@ class TpuSolver:
             # offering tensors and quota machinery entirely
             has_domains=bool((snap.g_dmode > 0).any()),
         )
-        args = snap.solve_args(a_tzc)
+        args = snap.solve_args(a_tzc, res_cap0, a_res)
 
         if self.config.backend == "native":
             from .. import native
@@ -246,7 +268,8 @@ class TpuSolver:
                     *args, nmax=nmax, fills_dtype=fills_dtype, **statics
                 )
                 (c_pool, packed, n_open, overflow,
-                 exist_fills, claim_fills, unplaced, c_dzone, c_dct) = [
+                 exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                 c_resv) = [
                     np.asarray(x) for x in jax.device_get(out)
                 ]
                 # the type mask stays bit-packed: _decode unpacks only the
@@ -257,6 +280,7 @@ class TpuSolver:
                     exist_fills.astype(np.int32),
                     claim_fills.astype(np.int32), unplaced,
                     c_dzone.astype(np.int32), c_dct.astype(np.int32),
+                    c_resv.astype(bool),
                 )
 
         else:
@@ -267,13 +291,14 @@ class TpuSolver:
 
         while True:
             (c_pool, c_tmask, n_open, overflow,
-             exist_fills, claim_fills, unplaced, c_dzone, c_dct) = call(nmax)
+             exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+             c_resv) = call(nmax)
             if not overflow:
                 break
             nmax *= 2
         return self._decode(
             snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills,
-            unplaced, c_dzone, c_dct,
+            unplaced, c_dzone, c_dct, c_resv,
         )
 
     def _fit_matrix(self, snap: enc.EncodedSnapshot) -> np.ndarray:
@@ -324,13 +349,42 @@ class TpuSolver:
             floor=8,
         )
 
-    def _offering_availability(self, snap: enc.EncodedSnapshot) -> np.ndarray:
-        """A[T, Vz, Vc]: type t has an available offering in (zone z, ct c)."""
+    def _offering_availability(
+        self, snap: enc.EncodedSnapshot, reserved_enabled: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(A[T, Vz, Vc], res_cap0[NRES], a_res[NRES, T, Vz, Vc]).
+
+        A: type t has an available offering in (zone z, ct c). With the
+        reservation ledger active, reserved offerings are EXCLUDED from A
+        and contribute per-reservation availability in a_res instead; the
+        kernel re-admits them while their ledger capacity lasts
+        (reservationmanager.go:28-85)."""
         T, O = snap.o_avail.shape
         _, V1 = snap.vocab.padded_shape()
         A = np.zeros((T, V1, V1), dtype=bool)
-        for t in range(T):
-            for o in range(O):
+        rids: Dict[str, int] = {}
+        caps: List[int] = []
+        res_cells: List[Tuple[int, int, int, int]] = []  # (rid, t, z, c)
+        for t, it in enumerate(snap.instance_types):
+            for o, off in enumerate(it.offerings):
+                if (
+                    reserved_enabled
+                    and off.capacity_type() == labels_mod.CAPACITY_TYPE_RESERVED
+                ):
+                    # the ledger tracks the least capacity seen per id over
+                    # ALL offerings, available or not (reservation.py:15-23)
+                    rid = off.reservation_id()
+                    r = rids.setdefault(rid, len(rids))
+                    if r == len(caps):
+                        caps.append(off.reservation_capacity)
+                    else:
+                        caps[r] = min(caps[r], off.reservation_capacity)
+                    if not snap.o_avail[t, o]:
+                        continue
+                    z, c = snap.o_zone[t, o], snap.o_ct[t, o]
+                    if z >= 0 and c >= 0:
+                        res_cells.append((r, t, z, c))
+                    continue
                 if not snap.o_avail[t, o]:
                     continue
                 z, c = snap.o_zone[t, o], snap.o_ct[t, o]
@@ -342,7 +396,11 @@ class TpuSolver:
                     A[t, :, c] = True
                 else:
                     A[t, :, :] = True
-        return A
+        nres = len(caps)
+        a_res = np.zeros((nres, T, V1, V1), dtype=bool)
+        for r, t, z, c in res_cells:
+            a_res[r, t, z, c] = True
+        return A, np.asarray(caps, dtype=np.int32), a_res
 
     # -- decode -----------------------------------------------------------
 
@@ -357,6 +415,7 @@ class TpuSolver:
         unplaced: np.ndarray,  # [G]
         c_dzone: Optional[np.ndarray] = None,  # [NMAX] pinned zone value ids
         c_dct: Optional[np.ndarray] = None,  # [NMAX] pinned capacity-type ids
+        c_resv: Optional[np.ndarray] = None,  # [NMAX] claim holds reservations
     ) -> Tuple[List[DecodedClaim], Dict[str, object]]:
         self._cursors = {}
 
@@ -377,6 +436,7 @@ class TpuSolver:
         claims: List[DecodedClaim] = []
         claim_by_slot: Dict[int, DecodedClaim] = {}
         type_ids_cache: Dict[bytes, List[cp.InstanceType]] = {}
+        resv_ledger: Optional[Dict[str, int]] = None
         T = len(snap.instance_types)
         packed = c_tmask.dtype == np.uint8 and c_tmask.shape[1] != T
         for slot in range(n_open):
@@ -410,6 +470,41 @@ class TpuSolver:
                         key, Operator.IN, [snap.vocab.values[kid][int(pins[slot])]]
                     )
                 )
+            if c_resv is not None and c_resv[slot]:
+                # mirror the oracle's InFlightNodeClaim surface by replaying
+                # the ledger in slot order (claims open in scan order, so
+                # this reproduces the kernel's debits): a claim holds only
+                # the compatible reserved offerings that still had capacity
+                # when it opened
+                if resv_ledger is None:
+                    resv_ledger = {}
+                    for it in snap.instance_types:
+                        for o in it.offerings:
+                            if (
+                                o.capacity_type()
+                                == labels_mod.CAPACITY_TYPE_RESERVED
+                            ):
+                                rid = o.reservation_id()
+                                resv_ledger[rid] = min(
+                                    resv_ledger.get(rid, o.reservation_capacity),
+                                    o.reservation_capacity,
+                                )
+                held = []
+                for it in options:
+                    for o in it.offerings:
+                        if (
+                            o.available
+                            and o.capacity_type()
+                            == labels_mod.CAPACITY_TYPE_RESERVED
+                            and resv_ledger.get(o.reservation_id(), 0) > 0
+                            and claim.requirements.is_compatible(
+                                o.requirements, labels_mod.WELL_KNOWN_LABELS
+                            )
+                        ):
+                            held.append(o)
+                for o in held:
+                    resv_ledger[o.reservation_id()] -= 1
+                claim.reserved_offerings = held
             claim_by_slot[slot] = claim
             claims.append(claim)
         for gi, slot in zip(*np.nonzero(claim_fills)):

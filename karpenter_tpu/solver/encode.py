@@ -353,10 +353,19 @@ class EncodedSnapshot:
     ct_kid: int
     well_known: np.ndarray  # [K] bool
 
-    def solve_args(self, a_tzc: np.ndarray) -> tuple:
+    def solve_args(
+        self,
+        a_tzc: np.ndarray,
+        res_cap0: Optional[np.ndarray] = None,
+        a_res: Optional[np.ndarray] = None,
+    ) -> tuple:
         """The positional argument tuple for ops/solve.py:solve_core — the
         single authority on that ordering (driver, examples, and the
         multi-chip padding all build from this)."""
+        if res_cap0 is None:
+            res_cap0 = np.zeros((0,), np.int32)
+        if a_res is None:
+            a_res = np.zeros((0,) + a_tzc.shape, bool)
         return (
             self.g_count, self.g_req, self.g_def, self.g_neg, self.g_mask,
             self.g_hcap,
@@ -367,7 +376,7 @@ class EncodedSnapshot:
             self.p_limit, self.p_has_limit, self.p_tol, self.p_titype_ok,
             self.t_def, self.t_mask, self.t_alloc, self.t_cap,
             self.o_avail, self.o_zone, self.o_ct,
-            a_tzc,
+            a_tzc, res_cap0, a_res,
             self.n_def, self.n_mask, self.n_avail, self.n_base, self.n_tol,
             self.n_hcnt,
             self.n_dzone, self.n_dct,

@@ -88,7 +88,7 @@ def example_snapshot_arrays(
         {t.node_pool_name: t.instance_type_options for t in templates},
         daemon_overhead=solver.oracle.daemon_overhead,
     )
-    a_tzc = solver._offering_availability(snap)
+    a_tzc, res_cap0, a_res = solver._offering_availability(snap)
     nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
     statics = dict(
         nmax=nmax,
@@ -96,4 +96,4 @@ def example_snapshot_arrays(
         ct_kid=snap.ct_kid,
         has_domains=bool((snap.g_dmode > 0).any()),
     )
-    return snap.solve_args(a_tzc), statics
+    return snap.solve_args(a_tzc, res_cap0, a_res), statics

@@ -101,10 +101,10 @@ def _topo_snapshot_args(pods):
         {t.node_pool_name: t.instance_type_options for t in templates},
         daemon_overhead=solver.oracle.daemon_overhead,
     )
-    a_tzc = solver._offering_availability(snap)
+    a_tzc, res_cap0, a_res = solver._offering_availability(snap)
     nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
     statics = dict(nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
-    return snap.solve_args(a_tzc), statics
+    return snap.solve_args(a_tzc, res_cap0, a_res), statics
 
 
 @requires_native

@@ -828,3 +828,144 @@ class TestSharedConstraints:
         for claim in results.new_node_claims:
             zr = claim.requirements.get(labels.TOPOLOGY_ZONE)
             assert set(zr.values) <= {"test-zone-a", "test-zone-b"}
+
+
+class TestReservedLedgerFastPath:
+    """The reservation ledger rides the kernel carry (SURVEY §7.4.5,
+    reservationmanager.go:28-85): reserved-capacity snapshots in the
+    default fallback mode use the fast path, with reserved offerings
+    admitted only while ledger capacity lasts."""
+
+    def _reserved_types(self, capacity=1, n=4):
+        from karpenter_tpu.api.requirements import Operator, Requirement
+        from karpenter_tpu.cloudprovider import types as cp
+
+        its = corpus.generate(n)
+        # reserved offerings on the LARGEST types (the ones the pods'
+        # requests actually land on; small types can't fit them)
+        for it in its[-2:]:
+            res_req = __import__(
+                "karpenter_tpu.api.requirements", fromlist=["Requirements"]
+            ).Requirements(
+                Requirement(labels.CAPACITY_TYPE_LABEL_KEY, Operator.IN,
+                            [labels.CAPACITY_TYPE_RESERVED]),
+                Requirement(labels.TOPOLOGY_ZONE, Operator.IN, ["test-zone-a"]),
+                Requirement(cp.RESERVATION_ID_LABEL, Operator.IN,
+                            [f"res-{it.name}"]),
+            )
+            it.offerings.append(cp.Offering(
+                requirements=res_req, price=0.001, available=True,
+                reservation_capacity=capacity,
+            ))
+        return its
+
+    def _solve(self, pods, its, backend="tpu", force_oracle=False):
+        from karpenter_tpu.solver.driver import SolverConfig
+
+        pool = make_nodepool()
+        its_by_pool = {pool.name: its}
+        topo = Topology(Client(TestClock()), [], [pool], its_by_pool, pods)
+        solver = TpuSolver(
+            [pool], its_by_pool, topo,
+            config=SolverConfig(backend=backend, force_oracle=force_oracle),
+            reserved_capacity_enabled=True,
+        )
+        return solver, solver.solve(pods)
+
+    def test_ledger_caps_reserved_claims_on_fast_path(self):
+        from karpenter_tpu.solver import encode as enc
+
+        its = self._reserved_types(capacity=1)
+        pods = make_pods(6, cpu="1")
+        solver, results = self._solve(pods, its)
+        # the fast path handled everything (no oracle fallback)
+        groups, rest = enc.partition_and_group(
+            pods, topology=solver.oracle.topology
+        )
+        assert not rest
+        assert results.all_pods_scheduled()
+        held = [c for c in results.new_node_claims if c.reserved_offerings]
+        # reservation is pessimistic: each claim reserves EVERY compatible
+        # offering (reservationmanager.go:28-48), so the first claim drains
+        # both capacity-1 reservations and holds two offerings
+        assert len(held) == 1
+        assert len(held[0].reserved_offerings) == 2
+        # the oracle agrees on the held-claim count
+        _, oracle_r = self._solve(pods, its, force_oracle=True)
+        assert (
+            sum(1 for c in oracle_r.new_node_claims if c.reserved_offerings)
+            == 1
+        )
+
+    def test_ledger_parity_with_oracle(self):
+        its = self._reserved_types(capacity=2)
+        pods = make_pods(8, cpu="1")
+        _, tpu_r = self._solve(pods, its)
+        _, oracle_r = self._solve(pods, its, force_oracle=True)
+        assert tpu_r.all_pods_scheduled() and oracle_r.all_pods_scheduled()
+        assert tpu_r.node_count() == oracle_r.node_count()
+
+    def test_native_backend_ledger_agreement(self):
+        its = self._reserved_types(capacity=1)
+        pods = make_pods(6, cpu="1")
+        _, r_t = self._solve(pods, its, backend="tpu")
+        its2 = self._reserved_types(capacity=1)
+        _, r_n = self._solve(pods, its2, backend="native")
+        assert r_n.node_count() == r_t.node_count()
+        held_t = sum(1 for c in r_t.new_node_claims if c.reserved_offerings)
+        held_n = sum(1 for c in r_n.new_node_claims if c.reserved_offerings)
+        assert held_t == held_n
+
+    def test_strict_mode_routes_to_oracle(self):
+        from karpenter_tpu.scheduling.inflight import (
+            RESERVED_OFFERING_MODE_STRICT,
+        )
+
+        its = self._reserved_types(capacity=1)
+        pods = make_pods(3, cpu="1")
+        pool = make_nodepool()
+        its_by_pool = {pool.name: its}
+        topo = Topology(Client(TestClock()), [], [pool], its_by_pool, pods)
+        solver = TpuSolver(
+            [pool], its_by_pool, topo,
+            reserved_capacity_enabled=True,
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
+        called = []
+        orig = solver.oracle.solve
+
+        def spy(p):
+            called.append(len(p))
+            return orig(p)
+
+        solver.oracle.solve = spy
+        solver.solve(pods)
+        assert called == [3]  # the whole batch went through the oracle
+
+    def test_mixed_batch_does_not_double_book(self):
+        """Fast-path holdings must debit the oracle's ReservationManager
+        before the oracle solves the non-tensorizable remainder — a mixed
+        batch may not hand the same reserved slot to two claims."""
+        from karpenter_tpu.api.objects import HostPort
+
+        its = self._reserved_types(capacity=1)
+        oracle_side = make_pods(2, cpu="1")
+        for i, p in enumerate(oracle_side):
+            # host ports route to the host oracle (is_tensorizable)
+            p.spec.host_ports.append(HostPort(port=6000 + i))
+        pods = make_pods(4, cpu="1") + oracle_side
+        solver, results = self._solve(pods, its)
+        assert results.all_pods_scheduled()
+        held = [
+            c for c in results.new_node_claims
+            if getattr(c, "reserved_offerings", None)
+        ]
+        # 2 reservation ids x capacity 1: at most... each claim reserves
+        # every compatible offering, so ONE claim drains both; no second
+        # claim (from either path) may hold the same slots
+        total_by_rid = {}
+        for c in held:
+            for o in c.reserved_offerings:
+                rid = o.reservation_id()
+                total_by_rid[rid] = total_by_rid.get(rid, 0) + 1
+        assert all(v <= 1 for v in total_by_rid.values()), total_by_rid

@@ -124,6 +124,10 @@ def _build(config: str, n_pods: int, n_types: int):
     elif config == "mixed":
         pods = mixed_pods(n_pods)
         pools = [example_nodepool()]
+    elif config == "mixed-cpu":
+        # small type corpora carry no GPU types; keep the mix schedulable
+        pods = mixed_pods(n_pods, gpu_fraction=0.0)
+        pools = [example_nodepool()]
     elif config == "constrained":
         pods = constrained_mix(n_pods)
         pools = [example_nodepool()]
@@ -265,15 +269,15 @@ def main() -> None:
 
     # size grid (reference harness shape, scheduling_benchmark_test.go:70-96)
     if full_grid:
-        for n_pods, n_types, trials in (
-            (500, 400, 10),
-            (5_000, 400, 7),
-            (10_000, 800, 5),
-            (50_000, 10, 5),
-            (50_000, 400, 5),
+        for cfg, n_pods, n_types, trials in (
+            ("mixed", 500, 400, 10),
+            ("mixed", 5_000, 400, 7),
+            ("mixed", 10_000, 800, 5),
+            ("mixed-cpu", 50_000, 10, 5),
+            ("mixed", 50_000, 400, 5),
         ):
             grid.append(
-                run_config("mixed", n_pods, n_types, trials=trials,
+                run_config(cfg, n_pods, n_types, trials=trials,
                            with_oracle=False)
             )
 

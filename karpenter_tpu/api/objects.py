@@ -114,10 +114,21 @@ class LabelSelector:
         return True
 
     def key(self) -> tuple:
-        return (
-            tuple(sorted(self.match_labels.items())),
-            tuple(sorted((e.key, e.operator, tuple(sorted(e.values))) for e in self.match_expressions)),
-        )
+        # memoized: group_key hashes every constraint-carrying pod's
+        # selectors in the 50k-pod hot loop
+        k = getattr(self, "_key_cache", None)
+        if k is None:
+            k = (
+                tuple(sorted(self.match_labels.items())),
+                tuple(
+                    sorted(
+                        (e.key, e.operator, tuple(sorted(e.values)))
+                        for e in self.match_expressions
+                    )
+                ),
+            )
+            object.__setattr__(self, "_key_cache", k)
+        return k
 
 
 @dataclass

@@ -755,6 +755,9 @@ int kt_solve(
       int64_t k_resv = kBigFit;
       if (NRES) {
         const uint8_t* pm = p_mask + static_cast<size_t>(p_star) * KV;
+        // domain-pinned bulks only count reservations usable in the pin
+        const bool pin_z = !is_any && dkey == 0;
+        const bool pin_c = !is_any && dkey == 1;
         for (int r = 0; r < NRES; ++r) {
           if (res_rem[r] <= 0) continue;
           bool compat = false;
@@ -763,14 +766,17 @@ int kt_solve(
             const uint8_t* ar =
                 a_res + (static_cast<size_t>(r) * T + t) * V1 * V1;
             for (int z = 0; z < V1 && !compat; ++z) {
+              if (pin_z && z != d_sel) continue;
               if (!(pm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z]))
                 continue;
-              for (int c = 0; c < V1; ++c)
+              for (int c = 0; c < V1; ++c) {
+                if (pin_c && c != d_sel) continue;
                 if (ar[z * V1 + c] && pm[ct_kid * V1 + c] &&
                     gmask[ct_kid * V1 + c]) {
                   compat = true;
                   break;
                 }
+              }
             }
           }
           if (compat) {

@@ -47,11 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from .feasibility import fits_count
-
-# domain modes (solver/encode.py:TopoSpec.dmode)
-DMODE_NONE = 0
-DMODE_SPREAD = 1
-DMODE_AFFINITY = 2
+from ..solver.encode import DMODE_AFFINITY, DMODE_NONE, DMODE_SPREAD
 
 _BIGI = 2**28  # "unbounded" domain capacity; keeps int32 bisection safe
 
@@ -258,28 +254,55 @@ def pack(
             # For each claim/template and type: is an offering available in
             # domain slot d of the constrained axis, under the entity's
             # mask on the OTHER axis (offering_ok resolved per domain).
-            av_z = jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_step_f) > 0
-            av_c = jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_step_f) > 0
-            if NRES:
-                av_z = jnp.where(
-                    state.c_resv[:, None, None],
-                    jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_held_f) > 0,
-                    av_z,
+            # Wrapped in lax.cond so non-dynamic groups (the majority of a
+            # realistic mix) skip the O(NMAX*T*V1) contractions at runtime.
+            def _domain_avail(_):
+                av_z = (
+                    jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_step_f) > 0
                 )
-                av_c = jnp.where(
-                    state.c_resv[:, None, None],
-                    jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_held_f) > 0,
-                    av_c,
+                av_c = (
+                    jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_step_f) > 0
                 )
-            toff_nt = jnp.where(
-                dkey == 0, av_z & cz[:, None, :], av_c & cc[:, None, :]
-            )  # [NMAX, T, V1]
+                if NRES:
+                    av_z = jnp.where(
+                        state.c_resv[:, None, None],
+                        jnp.einsum(
+                            "nc,tzc->ntz", cc.astype(jnp.float32), a_held_f
+                        )
+                        > 0,
+                        av_z,
+                    )
+                    av_c = jnp.where(
+                        state.c_resv[:, None, None],
+                        jnp.einsum(
+                            "nz,tzc->ntc", cz.astype(jnp.float32), a_held_f
+                        )
+                        > 0,
+                        av_c,
+                    )
+                toff_nt = jnp.where(
+                    dkey == 0, av_z & cz[:, None, :], av_c & cc[:, None, :]
+                )  # [NMAX, T, V1]
+                pav_z = (
+                    jnp.einsum("pc,tzc->ptz", pcm.astype(jnp.float32), a_step_f)
+                    > 0
+                )
+                pav_c = (
+                    jnp.einsum("pz,tzc->ptc", pzm.astype(jnp.float32), a_step_f)
+                    > 0
+                )
+                toff_pt = jnp.where(
+                    dkey == 0, pav_z & pzm[:, None, :], pav_c & pcm[:, None, :]
+                )  # [P, T, V1]
+                return toff_nt, toff_pt
 
-            pav_z = jnp.einsum("pc,tzc->ptz", pcm.astype(jnp.float32), a_step_f) > 0
-            pav_c = jnp.einsum("pz,tzc->ptc", pzm.astype(jnp.float32), a_step_f) > 0
-            toff_pt = jnp.where(
-                dkey == 0, pav_z & pzm[:, None, :], pav_c & pcm[:, None, :]
-            )  # [P, T, V1]
+            def _no_domain(_):
+                return (
+                    jnp.zeros((nmax, T, V1), bool),
+                    jnp.zeros((P, T, V1), bool),
+                )
+
+            toff_nt, toff_pt = jax.lax.cond(dyn, _domain_avail, _no_domain, None)
 
         # ---- 1. existing nodes, fixed priority order ----
         exist_cap = jnp.where(
@@ -320,7 +343,12 @@ def pack(
             emax = jnp.where(reg, D0 + realcap, _BIGI)
             mfloor = jnp.where(min0, 0, jnp.min(emax))
             lstar = skew + mfloor
-            scap = jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0)
+            # per-domain caps clamp at the group count: exact (a group never
+            # places more than count pods) and it keeps waterfill's int32
+            # sums from overflowing when many domains carry _BIGI capacity
+            scap = jnp.minimum(
+                jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0), count
+            )
             q_spread = waterfill(jnp.where(reg, D0, _BIGI), scap, count)  # [V1]
 
             # AFFINITY bootstrap: all pods pin to ONE viable domain — the
@@ -401,69 +429,87 @@ def pack(
         add_fit = fits_count(
             t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
         )  # [NMAX, T]
-        if has_domains:
-            off = jnp.any(toff_nt, axis=-1)  # [NMAX, T] any admissible domain
-        else:
-            # joint zone×ct offering admissibility, one einsum
-            off = (
+        # joint zone×ct offering admissibility, one einsum (identical to
+        # any-domain of toff_nt, but computed for every step — toff_nt is
+        # zeros on non-dynamic steps)
+        off = (
+            jnp.einsum(
+                "nz,tzc,nc->nt",
+                cz.astype(jnp.float32), a_step_f, cc.astype(jnp.float32),
+            )
+            > 0
+        )
+        if NRES:
+            off_held = (
                 jnp.einsum(
                     "nz,tzc,nc->nt",
-                    cz.astype(jnp.float32), a_step_f, cc.astype(jnp.float32),
+                    cz.astype(jnp.float32), a_held_f, cc.astype(jnp.float32),
                 )
                 > 0
             )
-            if NRES:
-                off_held = (
-                    jnp.einsum(
-                        "nz,tzc,nc->nt",
-                        cz.astype(jnp.float32), a_held_f, cc.astype(jnp.float32),
-                    )
-                    > 0
-                )
-                off = jnp.where(state.c_resv[:, None], off_held, off)
+            off = jnp.where(state.c_resv[:, None], off_held, off)
         tm = tm & off & (add_fit >= 1)
 
         cap_any = jnp.where(claim_live, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0)
+
+        def _clamp(cap):
+            cap = jnp.minimum(cap, hcap)  # open claims carry no prior
+            cap = jnp.minimum(cap, count)  # keeps int32 waterfill sums safe
+            return jnp.minimum(
+                cap,
+                jnp.where(
+                    has_h, jnp.maximum(scap_h - state.ch_cnt[:, jhc], 0), _BIGI
+                ),
+            )
+
+        def _tier2_any(_):
+            c_slot = jnp.full((nmax,), ANY, jnp.int32)
+            claim_cap = _clamp(cap_any)
+            claim_fill = waterfill(state.c_npods, claim_cap, qrem[ANY])
+            return c_slot, claim_fill, qrem.at[ANY].add(-jnp.sum(claim_fill))
+
         if has_domains:
             # per-claim per-domain capacity, and a single domain assignment
             # per claim (the admissible domain with the largest remaining
-            # quota)
-            percap = jnp.max(
-                jnp.where(tm[:, :, None] & toff_nt, add_fit[:, :, None], 0), axis=1
-            )  # [NMAX, V1]
-            adm = claim_live[:, None] & (percap >= 1) & (qrem[:V1] > 0)[None, :]
-            d_star = jnp.argmax(jnp.where(adm, qrem[:V1][None, :], -1), axis=1)
-            c_slot = jnp.where(
-                dyn, jnp.where(jnp.any(adm, axis=1), d_star, DEAD), ANY
-            )  # [NMAX]
-            cap_dom = jnp.take_along_axis(percap, d_star[:, None], axis=1)[:, 0]
-            claim_cap = jnp.where(dyn, jnp.where(c_slot < V1, cap_dom, 0), cap_any)
-        else:
-            c_slot = jnp.full((nmax,), ANY, jnp.int32)
-            claim_cap = cap_any
-        claim_cap = jnp.minimum(claim_cap, hcap)  # open claims carry no prior
-        claim_cap = jnp.minimum(
-            claim_cap,
-            jnp.where(
-                has_h, jnp.maximum(scap_h - state.ch_cnt[:, jhc], 0), _BIGI
-            ),
-        )
-
-        if has_domains:
-            def wf_slot(slot_idx, slot_budget):
-                m = c_slot == slot_idx
-                return waterfill(
-                    jnp.where(m, state.c_npods, _BIGI),
-                    jnp.where(m, claim_cap, 0),
-                    slot_budget,
+            # quota); runtime-skipped for non-dynamic groups
+            def _tier2_domains(_):
+                percap = jnp.max(
+                    jnp.where(tm[:, :, None] & toff_nt, add_fit[:, :, None], 0),
+                    axis=1,
+                )  # [NMAX, V1]
+                adm = (
+                    claim_live[:, None]
+                    & (percap >= 1)
+                    & (qrem[:V1] > 0)[None, :]
                 )
+                d_star = jnp.argmax(
+                    jnp.where(adm, qrem[:V1][None, :], -1), axis=1
+                )
+                c_slot = jnp.where(jnp.any(adm, axis=1), d_star, DEAD)  # [NMAX]
+                cap_dom = jnp.take_along_axis(percap, d_star[:, None], axis=1)[
+                    :, 0
+                ]
+                claim_cap = _clamp(jnp.where(c_slot < V1, cap_dom, 0))
 
-            fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)  # [NSLOT, NMAX]
-            claim_fill = jnp.sum(fills_sd, axis=0)  # each claim in one slot
-            qrem = qrem - jnp.sum(fills_sd, axis=1)
+                def wf_slot(slot_idx, slot_budget):
+                    m = c_slot == slot_idx
+                    return waterfill(
+                        jnp.where(m, state.c_npods, _BIGI),
+                        jnp.where(m, claim_cap, 0),
+                        slot_budget,
+                    )
+
+                fills_sd = jax.vmap(wf_slot)(
+                    jnp.arange(NSLOT), qrem
+                )  # [NSLOT, NMAX]
+                claim_fill = jnp.sum(fills_sd, axis=0)  # one slot per claim
+                return c_slot, claim_fill, qrem - jnp.sum(fills_sd, axis=1)
+
+            c_slot, claim_fill, qrem = jax.lax.cond(
+                dyn, _tier2_domains, _tier2_any, None
+            )
         else:
-            claim_fill = waterfill(state.c_npods, claim_cap, qrem[ANY])
-            qrem = qrem.at[ANY].add(-jnp.sum(claim_fill))
+            c_slot, claim_fill, qrem = _tier2_any(None)
 
         got = claim_fill > 0
         c_used = state.c_used + claim_fill[:, None] * req[None, :]
@@ -588,13 +634,24 @@ def pack(
             if NRES:
                 # every claim of the bulk reserves one slot per compatible
                 # reservation (idempotent per hostname,
-                # reservationmanager.go:28-48); the ledger bounds the bulk
+                # reservationmanager.go:28-48); the ledger bounds the bulk.
+                # Domain-pinned bulks only count reservations usable in the
+                # pinned domain.
+                d_oh_sel = jax.nn.one_hot(
+                    jnp.clip(d_sel, 0, V1 - 1), V1, dtype=bool
+                )
+                pz_eff = jnp.where(
+                    ~is_any & (dkey == 0), pzm[p_star] & d_oh_sel, pzm[p_star]
+                )
+                pc_eff = jnp.where(
+                    ~is_any & (dkey == 1), pcm[p_star] & d_oh_sel, pcm[p_star]
+                )
                 r_has = (
                     jnp.einsum(
                         "z,rtzc,c->rt",
-                        pzm[p_star].astype(jnp.float32),
+                        pz_eff.astype(jnp.float32),
                         a_res.astype(jnp.float32),
-                        pcm[p_star].astype(jnp.float32),
+                        pc_eff.astype(jnp.float32),
                     )
                     > 0
                 )  # [NRES, T]

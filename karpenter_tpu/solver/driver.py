@@ -340,12 +340,22 @@ class TpuSolver:
             np.minimum(np.minimum(n_fit.max(axis=1), snap.g_hcap), snap.g_hscap),
             1,
         )
+        per_group = np.ceil(snap.g_count / best)
+        # hostname-capped groups (spread/anti) SHARE claims: each claim
+        # takes up to cap pods from EVERY such group, so their demand is
+        # the max, not the sum (summing overestimated a 20-deployment
+        # hostname-spread mix 30x, quadrupling kernel time). Resource
+        # pressure that breaks sharing is caught by the overflow retry.
+        capped = (snap.g_hcap < enc.HCAP_NONE) | (snap.g_hscap < enc.HCAP_NONE)
+        base = int(per_group[~capped].sum())
+        if capped.any():
+            base += int(per_group[capped].max())
         # domain-constrained groups open claims per domain (zonal spread
         # water-fills across zones), so each may leave one partial claim per
         # registered domain instead of one overall
         extra = int(snap.g_dreg[snap.g_dmode > 0].sum()) if len(snap.groups) else 0
         return enc._next_pow2(
-            int(np.ceil(snap.g_count / best).sum()) + len(snap.groups) + extra + 8,
+            base + len(snap.groups) + extra + 8,
             floor=8,
         )
 
@@ -502,8 +512,11 @@ class TpuSolver:
                             )
                         ):
                             held.append(o)
-                for o in held:
-                    resv_ledger[o.reservation_id()] -= 1
+                # one slot per reservation ID per claim (a rid may back
+                # offerings on several instance types), matching the
+                # kernel's res_rem[r] -= k
+                for rid in {o.reservation_id() for o in held}:
+                    resv_ledger[rid] -= 1
                 claim.reserved_offerings = held
             claim_by_slot[slot] = claim
             claims.append(claim)

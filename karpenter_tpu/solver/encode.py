@@ -173,6 +173,33 @@ class PodGroup:
         return len(self.pods)
 
 
+def _tsc_key(t) -> tuple:
+    """Memoized identity tuple for a TopologySpreadConstraint (hot in the
+    50k-pod grouping loop; constraint objects are immutable in practice)."""
+    k = getattr(t, "_key_cache", None)
+    if k is None:
+        k = (
+            t.max_skew, t.topology_key, t.when_unsatisfiable,
+            t.label_selector.key() if t.label_selector else None,
+            t.min_domains, t.node_affinity_policy, t.node_taints_policy,
+        )
+        object.__setattr__(t, "_key_cache", k)
+    return k
+
+
+def _term_key(t) -> tuple:
+    """Memoized identity tuple for a PodAffinityTerm."""
+    k = getattr(t, "_key_cache", None)
+    if k is None:
+        k = (
+            t.topology_key,
+            t.label_selector.key() if t.label_selector else None,
+            t.namespaces,
+        )
+        object.__setattr__(t, "_key_cache", k)
+    return k
+
+
 def group_key(pod: Pod) -> tuple:
     """Equivalence key from raw spec primitives — no Requirements objects
     are built per pod (hot for 50k-pod snapshots); the group's Requirements
@@ -202,22 +229,9 @@ def group_key(pod: Pod) -> tuple:
     topo = (
         pod.metadata.namespace,
         frozenset(pod.metadata.labels.items()),
-        tuple(
-            (
-                t.max_skew, t.topology_key, t.when_unsatisfiable,
-                t.label_selector.key() if t.label_selector else None,
-                t.min_domains, t.node_affinity_policy, t.node_taints_policy,
-            )
-            for t in spec.topology_spread_constraints
-        ),
-        tuple(
-            (t.topology_key, t.label_selector.key() if t.label_selector else None, t.namespaces)
-            for t in spec.pod_affinity
-        ),
-        tuple(
-            (t.topology_key, t.label_selector.key() if t.label_selector else None, t.namespaces)
-            for t in spec.pod_anti_affinity
-        ),
+        tuple(_tsc_key(t) for t in spec.topology_spread_constraints),
+        tuple(_term_key(t) for t in spec.pod_affinity),
+        tuple(_term_key(t) for t in spec.pod_anti_affinity),
     )
     return base + topo
 
@@ -818,16 +832,21 @@ def _resolve_topology(
     """Global cross-group checks + TopoSpec construction (see
     partition_and_group docstring). Returns (kept groups, demoted pods)."""
     # distinct (namespace, labels) -> owning group indices (-1 = oracle side)
+    _empty = frozenset()
     label_owners: Dict[tuple, set] = {}
+
+    def _owner_key(p: Pod) -> tuple:
+        labels = p.metadata.labels
+        return (
+            p.metadata.namespace,
+            frozenset(labels.items()) if labels else _empty,
+        )
+
     for gi, g in enumerate(groups):
         for p in g.pods:
-            label_owners.setdefault(
-                (p.metadata.namespace, frozenset(p.metadata.labels.items())), set()
-            ).add(gi)
+            label_owners.setdefault(_owner_key(p), set()).add(gi)
     for p in rest:
-        label_owners.setdefault(
-            (p.metadata.namespace, frozenset(p.metadata.labels.items())), set()
-        ).add(-1)
+        label_owners.setdefault(_owner_key(p), set()).add(-1)
 
     def matched_owners(namespaces: set, selector) -> set:
         out: set = set()

@@ -394,3 +394,300 @@ class TestDisruptionEncodeCache:
         assert ctx.encode_cache.cache is cache1
         for k, obj_id in static_ids.items():
             assert id(cache1[k]) == obj_id, f"static entry {k} was re-encoded"
+
+
+def _consolidatable_two_node_env(env):
+    """Two underutilized nodes ready for consolidation (shared setup)."""
+    clock, client, provider, operator, binder = env
+    operator.disruption.ctx.spot_to_spot_enabled = True
+    pool = make_nodepool()
+    pool.spec.disruption.consolidate_after = 10.0
+    client.create(pool)
+    rounds = []
+    for _ in range(2):
+        batch = [make_pod(cpu="750m", memory="1Gi") for _ in range(2)]
+        for p in batch:
+            client.create(p)
+        provision_cycle(env)
+        rounds.append(batch)
+    for batch in rounds:
+        batch[0].status.phase = "Succeeded"
+        client.update(batch[0])
+    clock.step(25)
+    operator.nodeclaim_disruption.reconcile_all()
+    return pool
+
+
+class TestOrchestrationQueue:
+    """Failure/un-taint/requeue behavior (orchestration/queue.go:51-189)."""
+
+    def _queued_command(self, env):
+        clock, client, provider, operator, binder = env
+        _consolidatable_two_node_env(env)
+        cmd = operator.disruption.reconcile(force=True)
+        assert cmd is not None and cmd.decision in ("delete", "replace")
+        # run past the validation TTL so the command executes + enqueues
+        for _ in range(20):
+            clock.step(1)
+            operator.disruption.reconcile(force=True)
+            if operator.disruption.queue.items:
+                break
+        return cmd
+
+    def test_replacement_disappearance_untaints_and_releases(self, env):
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.controllers.disruption.helpers import get_candidates
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        clock, client, provider, operator, binder = env
+        _consolidatable_two_node_env(env)
+        ctx = operator.disruption.ctx
+        cands = get_candidates(ctx.client, ctx.cluster, ctx.cloud_provider, clock)
+        assert cands
+        cand = cands[0]
+        # execution state: candidate tainted + marked for deletion
+        node = client.get(Node, cand.node.name)
+        node.taints.append(
+            Taint(key=labels.DISRUPTED_TAINT_KEY, effect="NoSchedule")
+        )
+        client.update(node)
+        ctx.cluster.mark_for_deletion(cand.provider_id)
+        queue = operator.disruption.queue
+        # the replacement NodeClaim does not exist -> the queue must fail
+        # the item, un-taint the candidate, and release the deletion mark
+        queue.add(
+            Command(candidates=[cand], reason="Underutilized"),
+            ["replacement-that-never-was"],
+        )
+        queue.reconcile()
+        assert not queue.items
+        node = client.try_get(Node, cand.node.name)
+        assert node is not None, "failed command must not delete candidates"
+        assert not any(
+            t.key == labels.DISRUPTED_TAINT_KEY for t in node.taints
+        )
+        sn = ctx.cluster.node_for_provider_id(cand.provider_id)
+        assert sn is not None and not sn.mark_for_deletion
+
+    def test_uninitialized_replacement_backs_off_then_times_out(self, env):
+        from karpenter_tpu.controllers.disruption.controller import (
+            QueueItem, QUEUE_TIMEOUT,
+        )
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        clock, client, provider, operator, binder = env
+        _consolidatable_two_node_env(env)
+        queue = operator.disruption.queue
+        # fabricate an in-flight command whose replacement never initializes
+        from karpenter_tpu.api.objects import NodeClaimSpec, ObjectMeta
+
+        stuck = NodeClaim(
+            metadata=ObjectMeta(name="stuck-replacement"), spec=NodeClaimSpec()
+        )
+        client.create(stuck)
+        cands = []
+        from karpenter_tpu.controllers.disruption.helpers import get_candidates
+
+        ctx = operator.disruption.ctx
+        cands = get_candidates(ctx.client, ctx.cluster, ctx.cloud_provider, clock)
+        assert cands
+        queue.add(
+            Command(candidates=cands[:1], reason="Underutilized"),
+            ["stuck-replacement"],
+        )
+        item = queue.items[0]
+        queue.reconcile()
+        assert item.attempts == 1 and item.next_try > clock.now()
+        before = item.next_try
+        clock.step(2)
+        queue.reconcile()
+        assert item.attempts == 2 and item.next_try >= before  # backoff grows
+        # past the 10-minute deadline the item fails out of the queue
+        clock.step(QUEUE_TIMEOUT + 1)
+        queue.reconcile()
+        assert not queue.items
+        node = client.try_get(Node, cands[0].node.name)
+        assert node is not None  # candidate survived
+
+
+class TestCronBudgetWindows:
+    """Budget schedule windows (nodepool.go:296-367, 5-field cron)."""
+
+    _seq = iter(range(1000))
+
+    def _allowed(self, env, budget, at_epoch):
+        from karpenter_tpu.controllers.disruption.helpers import (
+            allowed_disruptions,
+        )
+
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool(name=f"budget-{next(self._seq)}")
+        pool.spec.disruption.budgets = [budget]
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        nodes = operator.disruption.ctx.cluster.nodes()
+        return allowed_disruptions(pool, nodes, "Underutilized", at_epoch)
+
+    def test_budget_outside_window_is_inactive(self, env):
+        import calendar
+        import time as _time
+
+        # zero-budget active 09:00-10:00 daily; at 12:00 it must not apply
+        budget = Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)
+        noon = calendar.timegm(_time.strptime("2026-01-05 12:00", "%Y-%m-%d %H:%M"))
+        assert self._allowed(env, budget, noon) == 1
+
+    def test_budget_inside_window_applies(self, env):
+        import calendar
+        import time as _time
+
+        budget = Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)
+        t930 = calendar.timegm(_time.strptime("2026-01-05 09:30", "%Y-%m-%d %H:%M"))
+        assert self._allowed(env, budget, t930) == 0
+
+    def test_window_edge_inclusive_start_exclusive_end(self, env):
+        import calendar
+        import time as _time
+
+        from karpenter_tpu.controllers.disruption.helpers import budget_active
+
+        budget = Budget(nodes="0", schedule="0 9 * * *", duration=1800.0)
+
+        def at(hm):
+            return calendar.timegm(
+                _time.strptime(f"2026-01-05 {hm}", "%Y-%m-%d %H:%M")
+            )
+
+        assert budget_active(budget, at("09:00"))  # opens AT the tick
+        assert budget_active(budget, at("09:29"))
+        assert not budget_active(budget, at("09:35"))  # 35min > 30min window
+        assert not budget_active(budget, at("08:59"))
+
+    def test_reason_scoped_budget_ignores_other_reasons(self, env):
+        from karpenter_tpu.controllers.disruption.helpers import (
+            allowed_disruptions,
+        )
+
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        pool.spec.disruption.budgets = [Budget(nodes="0", reasons=("Drifted",))]
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        nodes = operator.disruption.ctx.cluster.nodes()
+        assert allowed_disruptions(pool, nodes, "Drifted", clock.now()) == 0
+        assert allowed_disruptions(pool, nodes, "Underutilized", clock.now()) == 1
+
+
+class TestEvictionBlockedByPDB:
+    def test_pdb_blocks_drain_until_disruptions_allowed(self, env):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, PodDisruptionBudget,
+        )
+
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        app = {"app": "guarded"}
+        pod = make_pod(labels=app)
+        client.create(pod)
+        provision_cycle(env)
+        pdb = PodDisruptionBudget(
+            metadata=__import__(
+                "karpenter_tpu.api.objects", fromlist=["ObjectMeta"]
+            ).ObjectMeta(name="pdb-guard"),
+            selector=LabelSelector(match_labels=dict(app)),
+            min_available="1",
+        )
+        client.create(pdb)
+        node = client.list(Node)[0]
+        node.metadata.finalizers.append(labels.TERMINATION_FINALIZER)
+        client.delete(node)
+        for _ in range(5):
+            operator.step()
+            clock.step(1)
+        # the PDB admits zero disruptions: the pod survives, the node's
+        # finalizer holds (termination loops, terminator.go:94-138)
+        assert client.try_get(Node, node.metadata.name) is not None
+        live = client.get_by_uid(pod.uid)
+        assert live.metadata.deletion_timestamp is None
+        # relax the PDB; drain completes
+        pdb.min_available = "0"
+        client.update(pdb)
+        for _ in range(6):
+            operator.step()
+            clock.step(1)
+        assert client.try_get(Node, node.metadata.name) is None
+
+
+class TestDriftEdges:
+    def test_hash_annotation_mismatch_drifts(self, env):
+        from karpenter_tpu.controllers.nodeclaim_disruption import nodepool_hash
+
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        claim = client.list(NodeClaim)[0]
+        claim.metadata.annotations[labels.NODEPOOL_HASH_ANNOTATION_KEY] = "stale"
+        operator.nodeclaim_disruption.reconcile_all()
+        assert claim.conds().is_true(COND_DRIFTED)
+        # re-stamping the current hash clears the condition
+        claim.metadata.annotations[labels.NODEPOOL_HASH_ANNOTATION_KEY] = (
+            nodepool_hash(pool)
+        )
+        operator.nodeclaim_disruption.reconcile_all()
+        assert not claim.conds().is_true(COND_DRIFTED)
+
+    def test_requirement_drift(self, env):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        claim = client.list(NodeClaim)[0]
+        operator.nodeclaim_disruption.reconcile_all()
+        assert not claim.conds().is_true(COND_DRIFTED)
+        # the pool now requires a zone the claim is not in
+        other = (
+            "test-zone-b"
+            if claim.metadata.labels.get(labels.TOPOLOGY_ZONE) != "test-zone-b"
+            else "test-zone-c"
+        )
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(labels.TOPOLOGY_ZONE, "In", (other,))
+        ]
+        client.update(pool)
+        # clear hash drift so requirement drift is what fires
+        from karpenter_tpu.controllers.nodeclaim_disruption import nodepool_hash
+
+        claim.metadata.annotations[labels.NODEPOOL_HASH_ANNOTATION_KEY] = (
+            nodepool_hash(pool)
+        )
+        operator.nodeclaim_disruption.reconcile_all()
+        assert claim.conds().is_true(COND_DRIFTED)
+
+    def test_instance_type_withdrawn_drifts(self, env):
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool()
+        client.create(pool)
+        client.create(make_pod())
+        provision_cycle(env)
+        claim = client.list(NodeClaim)[0]
+        from karpenter_tpu.controllers.nodeclaim_disruption import nodepool_hash
+
+        claim.metadata.annotations[labels.NODEPOOL_HASH_ANNOTATION_KEY] = (
+            nodepool_hash(pool)
+        )
+        operator.nodeclaim_disruption.reconcile_all()
+        assert not claim.conds().is_true(COND_DRIFTED)
+        # withdraw the claim's instance type from the provider catalog
+        it_name = claim.metadata.labels[labels.INSTANCE_TYPE]
+        provider._instance_types = [
+            it for it in provider._instance_types if it.name != it_name
+        ]
+        operator.nodeclaim_disruption.reconcile_all()
+        assert claim.conds().is_true(COND_DRIFTED)

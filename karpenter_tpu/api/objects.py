@@ -480,6 +480,21 @@ class StorageClass:
 
 
 @dataclass
+class VolumeAttachment:
+    """A CSI volume attached to a node (storagev1.VolumeAttachment). Its
+    existence blocks node termination until the attacher detaches it
+    (reference: termination/controller.go:193-243)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    node_name: str = ""
+    pv_name: str = ""  # spec.source.persistentVolumeName
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
 class CSINode:
     """Per-node CSI driver attach limits (reference: volumeusage.go reads
     CSINode.spec.drivers[].allocatable.count). ``metadata.name`` is the node

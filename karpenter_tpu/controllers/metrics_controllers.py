@@ -62,10 +62,8 @@ POD_SCHEDULING_UNDECIDED_TIME = Gauge(
     "pod_provisioning_scheduling_undecided_time_seconds",
     "seconds since ACK with no scheduling decision yet")
 
-# -- cluster state (state/metrics.go) ---------------------------------------
-
-CLUSTER_STATE_NODE_COUNT = Gauge("cluster_state_node_count", "Nodes tracked in cluster state")
-CLUSTER_STATE_SYNCED = Gauge("cluster_state_synced", "1 when cluster state is synced")
+# cluster-state gauges live with the Cluster itself (state.py), which also
+# tracks unsynced time; re-exported here for the reconcile below
 
 
 def _emit_resource_gauge(gauge: Gauge, rl, base_labels: Dict[str, str]) -> None:
@@ -127,8 +125,8 @@ class NodeMetricsController:
                 used = total_requests.get(name, 0)
                 NODE_UTILIZATION.set(
                     100.0 * used / alloc, {**base, "resource_type": name})
-        CLUSTER_STATE_NODE_COUNT.set(float(len(state_nodes)))
-        CLUSTER_STATE_SYNCED.set(1.0 if self.cluster.synced() else 0.0)
+        # cluster.synced() refreshes the cluster_state_* gauges (state.py)
+        self.cluster.synced()
 
 
 class NodePoolMetricsController:

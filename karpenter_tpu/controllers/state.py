@@ -29,8 +29,22 @@ from ..api.objects import (
 )
 from ..kube import Client, Event
 from ..kube.store import ADDED, DELETED, MODIFIED
+from ..metrics import Gauge
 from ..scheduling.hostports import HostPortUsage
 from ..scheduling.volumeusage import VolumeResolver, VolumeUsage
+
+# cluster-state sync gauges (reference: state/metrics.go)
+CLUSTER_STATE_NODE_COUNT = Gauge(
+    "cluster_state_node_count", "Current count of nodes in cluster state"
+)
+CLUSTER_STATE_SYNCED = Gauge(
+    "cluster_state_synced",
+    "1 if cluster state matches the API server's view, else 0",
+)
+CLUSTER_STATE_UNSYNCED_SECONDS = Gauge(
+    "cluster_state_unsynced_time_seconds",
+    "How long cluster state has been out of sync",
+)
 
 
 class StateNode:
@@ -234,11 +248,27 @@ class Cluster:
         self._pods_scheduling_attempted: Dict[str, float] = {}  # uid -> first attempt
         client.watch(self._on_event)
         self._synced_once = False
+        self._unsynced_since: Optional[float] = None
 
-    # -- sync gate (cluster.go:101-180) -----------------------------------
+    # -- sync gate (cluster.go:101-180; gauges state/metrics.go) ----------
 
     def synced(self) -> bool:
         """All NodeClaims with provider ids and all Nodes are tracked."""
+        ok = self._synced_inner()
+        now = self._client.clock.now()
+        with self._lock:
+            if ok:
+                self._unsynced_since = None
+            elif self._unsynced_since is None:
+                self._unsynced_since = now
+            CLUSTER_STATE_SYNCED.set(1.0 if ok else 0.0)
+            CLUSTER_STATE_UNSYNCED_SECONDS.set(
+                0.0 if ok else now - self._unsynced_since
+            )
+            CLUSTER_STATE_NODE_COUNT.set(float(len(self._nodes)))
+        return ok
+
+    def _synced_inner(self) -> bool:
         with self._lock:
             for claim in self._client.list(NodeClaim):
                 pid = claim.status.provider_id

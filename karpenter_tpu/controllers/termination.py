@@ -13,7 +13,14 @@ from typing import List, Optional, Sequence
 
 from ..api import labels as labels_mod
 from ..api import taints as taints_mod
-from ..api.objects import Node, NodeClaim, Pod, Taint
+from ..api.objects import (
+    Node,
+    NodeClaim,
+    PersistentVolumeClaim,
+    Pod,
+    Taint,
+    VolumeAttachment,
+)
 from ..events import Event, Recorder
 from ..kube import Client
 from ..metrics import Histogram
@@ -87,10 +94,45 @@ class TerminationController:
                     self.client.delete(pod)
                 except KeyError:
                     pass
+        # wait for drained pods' volumes to detach before terminating the
+        # instance so stateful pods re-attach cleanly elsewhere; the
+        # terminationGracePeriod deadline overrides the wait
+        # (termination/controller.go:143-153, 193-243)
+        if not self._volumes_detached(node) and not self._past_grace(node):
+            return  # requeue until the attacher catches up
         # instance termination via the claim finalizer path, or directly
         if claim is not None:
             return  # lifecycle controller finishes via claim finalizer
         self.client.remove_finalizer(node, labels_mod.TERMINATION_FINALIZER)
+
+    # -- volume detach wait (controller.go:193-243) -----------------------
+
+    def _volumes_detached(self, node: Node) -> bool:
+        """VolumeAttachments of DRAIN-ABLE pods must be gone; attachments
+        still backing non-drainable pods (e.g. do-not-disrupt stragglers
+        about to be force-deleted) never block."""
+        attachments = [
+            va
+            for va in self.client.list(VolumeAttachment)
+            if va.node_name == node.name
+        ]
+        if not attachments:
+            return True
+        blocked_pvs = set()
+        for p in self.client.list(Pod):
+            if p.spec.node_name != node.name or not pod_utils.is_active(p):
+                continue
+            if pod_utils.is_reschedulable(p):
+                continue  # drain-able pods' volumes must detach
+            for ref in p.spec.volumes:
+                pvc = self.client.try_get(
+                    PersistentVolumeClaim,
+                    ref.claim_name,
+                    namespace=p.metadata.namespace,
+                )
+                if pvc is not None and pvc.volume_name:
+                    blocked_pvs.add(pvc.volume_name)
+        return all(va.pv_name in blocked_pvs for va in attachments)
 
     # -- taint ("cordon", terminator.go:55-92) ----------------------------
 

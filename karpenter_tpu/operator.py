@@ -47,6 +47,16 @@ class OperatorOptions:
     node_repair: bool = False  # feature gate
     reserved_capacity: bool = False  # feature gate
     solver_config: Optional[SolverConfig] = None
+    # active/passive HA (operator.go:137-141); in-process default is a
+    # single operator, so election is opt-in via the CLI flags
+    leader_election: bool = False
+    leader_election_name: str = "karpenter-leader-election"
+    leader_election_namespace: str = "kube-system"
+    # the reference serves pprof behind --enable-profiling
+    # (operator.go:159-175); the TPU analog is the JAX profiler server,
+    # consumable by TensorBoard/XProf (SURVEY.md §5)
+    enable_profiling: bool = False
+    profiling_port: int = 9999
 
     @classmethod
     def from_options(cls, opts: "Options") -> "OperatorOptions":
@@ -57,6 +67,11 @@ class OperatorOptions:
             spot_to_spot_consolidation=opts.feature_gates.spot_to_spot_consolidation,
             node_repair=opts.feature_gates.node_repair,
             reserved_capacity=opts.feature_gates.reserved_capacity,
+            leader_election=not opts.disable_leader_election,
+            leader_election_name=opts.leader_election_name,
+            leader_election_namespace=opts.leader_election_namespace
+            or "kube-system",
+            enable_profiling=opts.enable_profiling,
         )
 
 
@@ -107,9 +122,38 @@ class Operator:
         self.node_metrics = NodeMetricsController(client, self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(client)
         self.pod_metrics = PodMetricsController(client, self.cluster)
+        self.leader_elector = None
+        if self.options.leader_election:
+            from .kube.leader import LeaderElector
+
+            self.leader_elector = LeaderElector(
+                client,
+                name=self.options.leader_election_name,
+                namespace=self.options.leader_election_namespace,
+            )
+        if self.options.enable_profiling:
+            self._start_profiler()
+
+    def _start_profiler(self) -> None:
+        """JAX profiler server — the pprof analog (operator.go:159-175):
+        point TensorBoard/XProf at the port for device traces of solver
+        steps."""
+        try:
+            import jax
+
+            jax.profiler.start_server(self.options.profiling_port)
+        except Exception:  # accelerator-less deployments still run
+            pass
+
+    def is_leader(self) -> bool:
+        return self.leader_elector is None or self.leader_elector.try_acquire()
 
     def step(self, force_provision: bool = False, force_disruption: bool = False) -> None:
-        """One reconcile pass over the roster."""
+        """One reconcile pass over the roster. Non-leader replicas keep
+        their watch-fed caches warm but do not reconcile
+        (operator.go:137-141)."""
+        if not self.is_leader():
+            return
         if hasattr(self.cloud_provider, "process_registrations"):
             self.cloud_provider.process_registrations()
         self.provisioner.reconcile(force=force_provision)

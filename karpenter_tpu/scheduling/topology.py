@@ -2,9 +2,11 @@
 
 Host-side mirror of the reference's topology engine
 (topology.go, topologygroup.go, topologynodefilter.go,
-topologydomaingroup.go). This is the semantic oracle; ops/topology.py holds
-the tensorized domain-count form used inside the TPU packing kernel, and
-tests assert agreement.
+topologydomaingroup.go). This is the semantic oracle; the tensorized forms
+live in solver/encode.py (TopoSpec distillation: hostname per-entity caps,
+domain-quota descriptors, shared-constraint carries) and ops/packing.py
+(the kernel's quota water-fill and count carries), and
+tests/test_solver_parity.py asserts agreement between the two.
 """
 
 from __future__ import annotations

@@ -691,3 +691,138 @@ class TestDriftEdges:
         ]
         operator.nodeclaim_disruption.reconcile_all()
         assert claim.conds().is_true(COND_DRIFTED)
+
+
+class TestVolumeDetachWait:
+    def test_termination_waits_for_volume_detach(self, env):
+        """Instance termination waits for drained pods' VolumeAttachments
+        to be cleaned up (termination/controller.go:193-243)."""
+        from karpenter_tpu.api.objects import ObjectMeta, VolumeAttachment
+
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        provision_cycle(env)
+        node = client.list(Node)[0]
+        va = VolumeAttachment(
+            metadata=ObjectMeta(name="va-1"),
+            node_name=node.metadata.name,
+            pv_name="pv-1",
+        )
+        client.create(va)
+        node.metadata.finalizers.append(labels.TERMINATION_FINALIZER)
+        client.delete(node)
+        for _ in range(5):
+            operator.step()
+            clock.step(1)
+        # drained, but the attachment still exists: the node must persist
+        assert client.try_get(Node, node.metadata.name) is not None
+        # the attacher detaches; termination completes
+        client.delete(va)
+        for _ in range(6):
+            operator.step()
+            clock.step(1)
+        assert client.try_get(Node, node.metadata.name) is None
+
+    def test_nondrainable_pod_volumes_do_not_block(self, env):
+        """Attachments backing NON-drainable pods (static/mirror pods) are
+        filtered out of the wait (termination/controller.go:208-243)."""
+        from karpenter_tpu.api.objects import (
+            ObjectMeta, PersistentVolumeClaim, PersistentVolumeClaimRef,
+            VolumeAttachment,
+        )
+
+        clock, client, provider, operator, binder = env
+        client.create(make_nodepool())
+        client.create(make_pod())
+        provision_cycle(env)
+        node = client.list(Node)[0]
+        # a static (node-owned) pod with a mounted volume stays through
+        # drain; its attachment must not block termination
+        static = make_pod(name="static-1", node_name=node.metadata.name)
+        static.metadata.annotations["kubernetes.io/config.source"] = "file"
+        static.spec.volumes.append(PersistentVolumeClaimRef(claim_name="pvc-1"))
+        static.status.phase = "Running"
+        client.create(static)
+        client.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="pvc-1"), volume_name="pv-keep"
+            )
+        )
+        client.create(
+            VolumeAttachment(
+                metadata=ObjectMeta(name="va-keep"),
+                node_name=node.metadata.name,
+                pv_name="pv-keep",
+            )
+        )
+        assert operator.termination._volumes_detached(node)
+        node.metadata.finalizers.append(labels.TERMINATION_FINALIZER)
+        client.delete(node)
+        for _ in range(6):
+            operator.step()
+            clock.step(1)
+        assert client.try_get(Node, node.metadata.name) is None
+
+
+class TestClusterStateGauges:
+    def test_sync_gauges_track_state(self, env):
+        from karpenter_tpu.controllers.state import (
+            CLUSTER_STATE_NODE_COUNT, CLUSTER_STATE_SYNCED,
+            CLUSTER_STATE_UNSYNCED_SECONDS,
+        )
+        from karpenter_tpu.api.objects import NodeClaimSpec, ObjectMeta
+
+        clock, client, provider, operator, binder = env
+        cluster = operator.disruption.ctx.cluster
+        assert cluster.synced()
+        assert CLUSTER_STATE_SYNCED.value() == 1.0
+        assert CLUSTER_STATE_UNSYNCED_SECONDS.value() == 0.0
+
+        # a NodeClaim with a provider id the cluster has never seen
+        ghost = NodeClaim(
+            metadata=ObjectMeta(name="ghost"), spec=NodeClaimSpec()
+        )
+        ghost.status.provider_id = "ghost://1"
+        # bypass the watch so state stays behind the store
+        client._objects[("NodeClaim", "default", "ghost")] = ghost
+        assert not cluster.synced()
+        assert CLUSTER_STATE_SYNCED.value() == 0.0
+        clock.step(7)
+        cluster.synced()
+        assert CLUSTER_STATE_UNSYNCED_SECONDS.value() >= 7.0
+
+
+class TestLeaderElection:
+    def test_single_leader_reconciles(self, env):
+        from karpenter_tpu.operator import Operator, OperatorOptions
+
+        clock, client, provider, operator, binder = env
+        opts = OperatorOptions(leader_election=True)
+        a = Operator(client, provider, options=opts)
+        b = Operator(client, provider, options=opts)
+        assert a.is_leader()
+        assert not b.is_leader()  # lease held by a
+        # a keeps renewing through steps
+        clock.step(5)
+        assert a.is_leader() and not b.is_leader()
+        # a goes dark past the lease duration: b steals the lease
+        clock.step(20)
+        assert b.is_leader()
+        assert not a.is_leader()
+
+    def test_nonleader_step_does_not_reconcile(self, env):
+        from karpenter_tpu.operator import Operator, OperatorOptions
+
+        clock, client, provider, operator, binder = env
+        opts = OperatorOptions(leader_election=True)
+        a = Operator(client, provider, options=opts)
+        b = Operator(client, provider, options=opts)
+        assert a.is_leader()
+        client.create(make_nodepool())
+        client.create(make_pod())
+        clock.step(1.1)
+        b.step(force_provision=True)  # standby: must not provision
+        assert client.list(NodeClaim) == []
+        a.step(force_provision=True)
+        assert len(client.list(NodeClaim)) == 1

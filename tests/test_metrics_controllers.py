@@ -5,9 +5,11 @@ import pytest
 from karpenter_tpu.api.objects import Node, NodeClaim, NodePool
 from karpenter_tpu.cloudprovider import corpus
 from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
-from karpenter_tpu.controllers.metrics_controllers import (
+from karpenter_tpu.controllers.state import (
     CLUSTER_STATE_NODE_COUNT,
     CLUSTER_STATE_SYNCED,
+)
+from karpenter_tpu.controllers.metrics_controllers import (
     NODE_ALLOCATABLE,
     NODE_TOTAL_POD_REQUESTS,
     NODE_UTILIZATION,

@@ -93,6 +93,7 @@ def solve_core_native(
     zone_kid: int,
     ct_kid: int,
     has_domains: bool = True,  # trace-time gate for the JAX twin; unused here
+    tile_feasibility: bool = False,  # JAX execution strategy; unused here
 ) -> Tuple[np.ndarray, ...]:
     """Same contract as ops/solve.py::solve_core (and solve_all), on host.
 

@@ -46,7 +46,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .feasibility import fits_count
+from .feasibility import (
+    fits_count,
+    merge_requirements,
+    offering_ok,
+    requirements_compatible,
+    requirements_intersect,
+)
 from ..solver.encode import DMODE_AFFINITY, DMODE_NONE, DMODE_SPREAD
 
 _BIGI = 2**28  # "unbounded" domain capacity; keeps int32 bisection safe
@@ -125,7 +131,12 @@ class PackState(NamedTuple):
     overflow: jnp.ndarray  # scalar bool
 
 
-@partial(jax.jit, static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "nmax", "zone_kid", "ct_kid", "has_domains", "tile_feasibility"
+    ),
+)
 def pack(
     # groups (FFD order)
     g_count, g_req, g_def, g_neg, g_mask,
@@ -144,9 +155,12 @@ def pack(
     res_cap0,  # [NRES] int32 reservation capacities (reservationmanager.go)
     a_res,  # [NRES, T, Vz, Vc] bool per-reservation availability
     # templates
-    p_mask, p_daemon, p_limit, p_has_limit, p_tol,
+    p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
+    # instance types (mask side, for tiled row feasibility)
+    t_def, t_mask,
+    o_avail, o_zone, o_ct,
     # existing nodes
-    n_avail, n_base,
+    n_def, n_mask, n_avail, n_base, n_tol,
     n_hcnt,  # [N, G] int32 prior selected-pod counts (hostname topology)
     n_dzone, n_dct,  # [N] int32 zone / capacity-type value id (-1 = none)
     nh_cnt0,  # [N, JH] int32 shared hostname-constraint node priors
@@ -156,6 +170,7 @@ def pack(
     zone_kid: int,
     ct_kid: int,
     has_domains: bool = True,
+    tile_feasibility: bool = False,
 ):
     """Run the grouped-FFD scan. Returns per-group placement matrices and the
     final claim state for decoding.
@@ -163,8 +178,15 @@ def pack(
     ``has_domains`` (static) gates the domain-quota machinery: when the host
     proves no group carries a domain-keyed constraint (all g_dmode == 0),
     the per-domain offering tensors and quota logic are traced out entirely,
-    keeping the topology-free hot path at its original per-step cost."""
-    P, G, T = type_ok_pgt.shape
+    keeping the topology-free hot path at its original per-step cost.
+
+    ``tile_feasibility`` (static) is the HBM-scaling mode (SURVEY §7.4.6):
+    instead of materialized [P, G, T] feasibility tables, each scan step
+    computes its own [P, T] row from the mask arrays — O(G·T) memory
+    becomes O(T), trading a small per-step recompute. The caller passes
+    zero-G placeholder tables in this mode."""
+    G = g_count.shape[0]
+    P, T = p_titype_ok.shape
     N = n_avail.shape[0]
     R = t_alloc.shape[1]
     K, V1 = g_mask.shape[1], g_mask.shape[2]
@@ -202,11 +224,64 @@ def pack(
         overflow=jnp.bool_(False),
     )
 
+    if tile_feasibility:
+        t_neg_z = jnp.zeros_like(t_def)
+
+        def _tile_rows(gi):
+            """Per-step feasibility rows — the tiled form of
+            fresh_claim_feasibility + existing_node_feasibility over one
+            group."""
+            gd, gn, gm = g_def[gi], g_neg[gi], g_mask[gi]
+            greq = g_req[gi]
+            c_def, c_neg, c_mask = merge_requirements(
+                p_def, p_neg, p_mask, gd[None, :], gn[None, :], gm[None, :, :]
+            )  # [P, K(,V1)]
+            compat_row = p_tol[:, gi] & requirements_compatible(
+                p_def, p_neg, p_mask, gd[None, :], gn[None, :], gm[None, :, :],
+                well_known,
+            )  # [P]
+            type_compat = requirements_intersect(
+                t_def[None, :, :], t_neg_z[None, :, :], t_mask[None, :, :, :],
+                c_def[:, None, :], c_neg[:, None, :], c_mask[:, None, :, :],
+            )  # [P, T]
+            off_row = offering_ok(
+                c_mask[:, None, zone_kid, :], c_mask[:, None, ct_kid, :],
+                o_avail[None, :, :], o_zone[None, :, :], o_ct[None, :, :],
+            )  # [P, T]
+            n_fit_row = fits_count(
+                t_alloc[None, :, :], p_daemon[:, None, :], greq[None, None, :]
+            )  # [P, T]
+            type_ok_row = (
+                type_compat
+                & off_row
+                & (n_fit_row >= 1)
+                & p_titype_ok
+                & compat_row[:, None]
+            )
+            if N:
+                n_neg_z = jnp.zeros_like(n_def)
+                ncompat = requirements_compatible(
+                    n_def, n_neg_z, n_mask, gd[None, :], gn[None, :],
+                    gm[None, :, :], jnp.zeros_like(well_known),
+                )  # [N]
+                ncap = fits_count(n_avail, n_base, greq[None, :])
+                cap_row = jnp.where(ncompat & n_tol[:, gi], ncap, 0)
+            else:
+                cap_row = jnp.zeros((0,), jnp.int32)
+            return compat_row, type_ok_row, n_fit_row, cap_row
+
     def step(state: PackState, xs):
         (gi,) = xs
         count = g_count[gi]
         req = g_req[gi]
         gdef, gneg, gmask = g_def[gi], g_neg[gi], g_mask[gi]
+        if tile_feasibility:
+            compat_row, type_ok_row, n_fit_row, cap_row = _tile_rows(gi)
+        else:
+            compat_row = compat_pg[:, gi]  # [P]
+            type_ok_row = type_ok_pgt[:, gi, :]  # [P, T]
+            n_fit_row = n_fit_pgt[:, gi, :]  # [P, T]
+            cap_row = cap_ng[:, gi]  # [N]
         hcap = g_hcap[gi]
         # shared hostname constraint: the cap applies against counts that
         # accumulate across groups in the carry
@@ -306,7 +381,7 @@ def pack(
 
         # ---- 1. existing nodes, fixed priority order ----
         exist_cap = jnp.where(
-            cap_ng[:, gi] > 0,
+            cap_row > 0,
             fits_count(n_avail, state.exist_used, req[None, :]),
             0,
         )
@@ -329,7 +404,7 @@ def pack(
             # ---- domain quota qd[NSLOT] --------------------------------
             czcap_exist = jnp.sum(exist_cap[:, None] * nd_oh, axis=0)[:V1]
             fresh_ok_d = jnp.any(
-                type_ok_pgt[:, gi, :, None] & toff_pt, axis=(0, 1)
+                type_ok_row[:, :, None] & toff_pt, axis=(0, 1)
             )  # [V1]
             realcap = jnp.minimum(
                 czcap_exist + jnp.where(fresh_ok_d, _BIGI, 0), _BIGI
@@ -419,13 +494,13 @@ def pack(
             ~gdef[None, :] | well_known[None, :] | state.c_def | gneg[None, :], axis=-1
         )
         claim_compat = jnp.all(key_ok, axis=-1) & custom_ok
-        claim_compat &= p_tol[state.c_pool, gi] & compat_pg[state.c_pool, gi]
+        claim_compat &= p_tol[state.c_pool, gi] & compat_row[state.c_pool]
         claim_live = state.c_active & claim_compat
 
         # per-type feasibility on each claim: current options ∧ (template ∪
         # group) table ∧ fits under current load ∧ offering under merged masks
         merged_mask = state.c_mask & gmask[None, :, :]
-        tm = state.c_tmask & type_ok_pgt[state.c_pool, gi, :]
+        tm = state.c_tmask & type_ok_row[state.c_pool]
         add_fit = fits_count(
             t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
         )  # [NMAX, T]
@@ -518,7 +593,7 @@ def pack(
         c_def = state.c_def | (got[:, None] & gdef[None, :])
         c_neg = jnp.where(got[:, None], state.c_neg & gneg[None, :], state.c_neg)
         still_fits = jnp.all(t_alloc[None, :, :] >= c_used[:, None, :], axis=-1)
-        surv = type_ok_pgt[state.c_pool, gi, :] & off & still_fits
+        surv = type_ok_row[state.c_pool] & off & still_fits
         if has_domains:
             # dynamic groups pin the claim to the selected domain (the
             # oracle tightens the node requirement to the chosen single
@@ -573,7 +648,7 @@ def pack(
                 jnp.all(t_cap[None, :, :] <= st.pool_rem[:, None, :], axis=-1),
                 True,
             )  # [P, T]
-            avail = type_ok_pgt[:, gi, :] & within_limits & tdok  # [P, T]
+            avail = type_ok_row & within_limits & tdok  # [P, T]
             if NRES:
                 # the static type_ok table (and the step-start toff_pt) saw
                 # the full offering catalog; re-gate types on what the
@@ -606,7 +681,7 @@ def pack(
             p_star = jnp.argmax(feas_p)  # first True in weight order
             any_feasible = jnp.any(feas_p)
             n_per = jnp.minimum(
-                jnp.max(jnp.where(avail[p_star], n_fit_pgt[p_star, gi], 0)), hcap
+                jnp.max(jnp.where(avail[p_star], n_fit_row[p_star], 0)), hcap
             )
             n_per = jnp.minimum(n_per, jnp.where(has_h, scap_h, _BIGI))
 
@@ -679,7 +754,7 @@ def pack(
             takes = jnp.where(in_bulk, takes, 0)  # [NMAX]
             placed = jnp.sum(takes)
 
-            tmask_new = avail[p_star] & (n_fit_pgt[p_star, gi] >= takes[:, None])
+            tmask_new = avail[p_star] & (n_fit_row[p_star] >= takes[:, None])
             used_new = p_daemon[p_star][None, :] + takes[:, None].astype(jnp.float32) * req[None, :]
             if has_domains:
                 # claims opened for a dynamic group are domain-pinned at birth

@@ -33,24 +33,36 @@ def solve_core(
     zone_kid: int,
     ct_kid: int,
     has_domains: bool = True,
+    tile_feasibility: bool = False,
 ):
-    compat_pg, type_ok, n_fit = fresh_claim_feasibility(
-        g_def, g_neg, g_mask, g_req,
-        p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
-        t_def, t_mask, t_alloc,
-        o_avail, o_zone, o_ct,
-        well_known,
-        zone_kid=zone_kid,
-        ct_kid=ct_kid,
-    )
-    if n_avail.shape[0]:
-        cap_ng = existing_node_feasibility(
-            g_def, g_neg, g_mask, g_req,
-            n_def, n_mask, n_avail, n_base, n_tol,
-            well_known,
-        )
+    if tile_feasibility:
+        # HBM-scaling mode (SURVEY §7.4.6): the packing scan computes each
+        # group's feasibility row in-step; only zero-G placeholders ride
+        # the table slots
+        P, T = p_titype_ok.shape
+        N = n_avail.shape[0]
+        compat_pg = jnp.zeros((P, 0), bool)
+        type_ok = jnp.zeros((P, 0, T), bool)
+        n_fit = jnp.zeros((P, 0, T), jnp.int32)
+        cap_ng = jnp.zeros((N, 0), jnp.int32)
     else:
-        cap_ng = jnp.zeros((0, g_count.shape[0]), jnp.int32)
+        compat_pg, type_ok, n_fit = fresh_claim_feasibility(
+            g_def, g_neg, g_mask, g_req,
+            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+            t_def, t_mask, t_alloc,
+            o_avail, o_zone, o_ct,
+            well_known,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+        )
+        if n_avail.shape[0]:
+            cap_ng = existing_node_feasibility(
+                g_def, g_neg, g_mask, g_req,
+                n_def, n_mask, n_avail, n_base, n_tol,
+                well_known,
+            )
+        else:
+            cap_ng = jnp.zeros((0, g_count.shape[0]), jnp.int32)
 
     state, exist_fills, claim_fills, unplaced = pack(
         g_count, g_req, g_def, g_neg, g_mask,
@@ -61,8 +73,11 @@ def solve_core(
         cap_ng,
         t_alloc, t_cap,
         a_tzc, res_cap0, a_res,
-        p_mask, p_daemon, p_limit, p_has_limit, p_tol,
-        n_avail, n_base,
+        p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
+        p_titype_ok,
+        t_def, t_mask,
+        o_avail, o_zone, o_ct,
+        n_def, n_mask, n_avail, n_base, n_tol,
         n_hcnt,
         n_dzone, n_dct,
         nh_cnt0, dd0,
@@ -71,6 +86,7 @@ def solve_core(
         zone_kid=zone_kid,
         ct_kid=ct_kid,
         has_domains=has_domains,
+        tile_feasibility=tile_feasibility,
     )
     return (
         state.c_pool,
@@ -87,7 +103,8 @@ def solve_core(
 
 
 solve_all = jax.jit(
-    solve_core, static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains")
+    solve_core,
+    static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains", "tile_feasibility"),
 )
 
 # MSB-first bit weights, matching numpy's unpackbits(bitorder="big")
@@ -95,7 +112,8 @@ _BIT_WEIGHTS = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
 
 
 def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
-                      has_domains: bool = True, fills_dtype=jnp.int32):
+                      has_domains: bool = True, tile_feasibility: bool = False,
+                      fills_dtype=jnp.int32):
     """solve_core with a wire-compact output layout.
 
     The axon tunnel charges ~60 ms fixed latency per readback plus
@@ -108,7 +126,7 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
     (c_pool, c_tmask, n_open, overflow,
      exist_fills, claim_fills, unplaced, c_dzone, c_dct, c_resv) = solve_core(
         *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid,
-        has_domains=has_domains)
+        has_domains=has_domains, tile_feasibility=tile_feasibility)
     n, t = c_tmask.shape
     t_pad = -(-t // 8) * 8
     padded = jnp.pad(c_tmask, ((0, 0), (0, t_pad - t))).reshape(n, t_pad // 8, 8)
@@ -129,5 +147,8 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
 
 solve_all_packed = jax.jit(
     solve_core_packed,
-    static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains", "fills_dtype"),
+    static_argnames=(
+        "nmax", "zone_kid", "ct_kid", "has_domains", "tile_feasibility",
+        "fills_dtype",
+    ),
 )

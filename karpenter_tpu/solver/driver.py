@@ -229,12 +229,18 @@ class TpuSolver:
         a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
+        G = len(snap.groups)
+        P = len(snap.templates)
+        T = len(snap.instance_types)
         statics = dict(
             zone_kid=snap.zone_kid,
             ct_kid=snap.ct_kid,
             # static gate: topology-free batches trace out the per-domain
             # offering tensors and quota machinery entirely
             has_domains=bool((snap.g_dmode > 0).any()),
+            # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
+            # feasibility tables, the scan computes per-group rows instead
+            tile_feasibility=P * G * T * 5 > (3 << 29),
         )
         args = snap.solve_args(a_tzc, res_cap0, a_res)
 

@@ -123,3 +123,49 @@ def affinity_term(topology_key: str, labels: Dict[str, str]) -> PodAffinityTerm:
         topology_key=topology_key,
         label_selector=LabelSelector(match_labels=dict(labels)),
     )
+
+
+def snapshot_args(
+    pods,
+    node_pools=None,
+    n_types: int = 20,
+    state_nodes=(),
+    require_full_routing: bool = True,
+):
+    """Kernel solve_args + statics for a pod batch — the one shared
+    scaffold for tests that drive solve_core/solve_all directly."""
+    from karpenter_tpu.cloudprovider import corpus as _corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver import encode as enc
+
+    node_pools = node_pools or [make_nodepool()]
+    its_by_pool = {np_.name: _corpus.generate(n_types) for np_ in node_pools}
+    topo = Topology(
+        Client(TestClock()), list(state_nodes), node_pools, its_by_pool, pods
+    )
+    solver = TpuSolver(
+        node_pools, its_by_pool, topo, state_nodes=list(state_nodes)
+    )
+    groups, rest = enc.partition_and_group(pods, topology=topo)
+    if require_full_routing:
+        assert not rest, "batch must tensorize fully"
+    templates = solver.oracle.templates
+    snap = enc.encode(
+        groups,
+        templates,
+        {t.node_pool_name: t.instance_type_options for t in templates},
+        existing_nodes=solver.oracle.existing_nodes,
+        daemon_overhead=solver.oracle.daemon_overhead,
+        pool_limits=solver.pool_limits,
+    )
+    a_tzc, res_cap0, a_res = solver._offering_availability(snap)
+    nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
+    statics = dict(
+        nmax=nmax,
+        zone_kid=snap.zone_kid,
+        ct_kid=snap.ct_kid,
+        has_domains=bool((snap.g_dmode > 0).any()),
+    )
+    return snap.solve_args(a_tzc, res_cap0, a_res), statics

@@ -77,34 +77,14 @@ class TestDriverBackend:
 
 def _topo_snapshot_args(pods):
     """Kernel args for a topology-carrying pod batch (zonal/hostname
-    constraints active), mirroring example_snapshot_arrays."""
-    from karpenter_tpu.cloudprovider import corpus
-    from karpenter_tpu.kube import Client, TestClock
-    from karpenter_tpu.scheduling.topology import Topology
-    from karpenter_tpu.solver import TpuSolver
-    from karpenter_tpu.solver import encode as enc
-
+    constraints active)."""
     import sys, os
     sys.path.insert(0, os.path.dirname(__file__))
-    from helpers import make_nodepool
+    from helpers import snapshot_args
 
-    node_pools = [make_nodepool()]
-    its_by_pool = {"default": corpus.generate(20)}
-    topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
-    solver = TpuSolver(node_pools, its_by_pool, topo)
-    groups, rest = enc.partition_and_group(pods, topology=topo)
-    assert not rest, "test batch must tensorize fully"
-    templates = solver.oracle.templates
-    snap = enc.encode(
-        groups,
-        templates,
-        {t.node_pool_name: t.instance_type_options for t in templates},
-        daemon_overhead=solver.oracle.daemon_overhead,
-    )
-    a_tzc, res_cap0, a_res = solver._offering_availability(snap)
-    nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
-    statics = dict(nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid)
-    return snap.solve_args(a_tzc, res_cap0, a_res), statics
+    args, statics = snapshot_args(pods, n_types=20)
+    statics.pop("has_domains", None)  # native core branches at runtime
+    return args, statics
 
 
 @requires_native

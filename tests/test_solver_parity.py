@@ -969,3 +969,81 @@ class TestReservedLedgerFastPath:
                 rid = o.reservation_id()
                 total_by_rid[rid] = total_by_rid.get(rid, 0) + 1
         assert all(v <= 1 for v in total_by_rid.values()), total_by_rid
+
+
+class TestTiledFeasibility:
+    """tile_feasibility (SURVEY §7.4.6): the HBM-scaling mode computes
+    per-group feasibility rows inside the scan instead of materializing
+    [P, G, T] tables — an execution strategy, so outputs must be
+    IDENTICAL to the precomputed-table program."""
+
+    def _state_node(self, name="tiled-n1", zone="test-zone-a"):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.controllers.state import StateNode
+
+        node = Node(
+            metadata=ObjectMeta(
+                name=name,
+                labels={labels.TOPOLOGY_ZONE: zone, labels.HOSTNAME: name},
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("8"),
+            "memory": res.parse_quantity("32Gi"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        return StateNode(node=node)
+
+    @pytest.mark.parametrize(
+        "workload", ["plain", "topology", "existing-nodes"]
+    )
+    def test_tiled_outputs_identical(self, workload):
+        import jax
+
+        from karpenter_tpu.ops.solve import solve_all
+        from helpers import snapshot_args, spread_constraint
+
+        state_nodes = ()
+        node_pools = [
+            make_nodepool("low", weight=1),
+            make_nodepool("high", weight=50, limits={"cpu": "64"}),
+        ]
+        if workload == "plain":
+            pods = make_pods(60, cpu="1", memory="1Gi") + make_pods(
+                30, cpu="2", memory="4Gi"
+            )
+        elif workload == "existing-nodes":
+            pods = make_pods(20, cpu="1", memory="1Gi") + make_pods(
+                6, cpu="2", memory="4Gi"
+            )
+            state_nodes = (self._state_node("t-n1"), self._state_node("t-n2"))
+        else:
+            app = {"t": "zs"}
+            pods = (
+                make_pods(40, cpu="1")
+                + make_pods(
+                    12, cpu="1", labels=app,
+                    spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=app)],
+                )
+                + make_pods(
+                    8, cpu="2", labels={"t": "hs"},
+                    spread=[
+                        spread_constraint(labels.HOSTNAME, labels={"t": "hs"})
+                    ],
+                )
+            )
+        args, statics = snapshot_args(
+            pods, node_pools=node_pools, n_types=24, state_nodes=state_nodes
+        )
+        if workload == "existing-nodes":
+            assert args[0].shape[0] and len(state_nodes)  # N > 0 exercised
+        dense = jax.device_get(solve_all(*args, **statics))
+        tiled = jax.device_get(
+            solve_all(*args, tile_feasibility=True, **statics)
+        )
+        assert len(dense) == len(tiled)
+        for i, (a, b) in enumerate(zip(dense, tiled)):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"output {i}"
+            )

@@ -229,9 +229,12 @@ class TpuSolver:
         a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
-        G = len(snap.groups)
         P = len(snap.templates)
         T = len(snap.instance_types)
+        # bucketed axis sizes: the kernel runs on the padded snapshot, so
+        # every shape-derived decision below must use these
+        G = enc._next_pow2(len(snap.groups), floor=8)
+        N = enc._next_pow2(len(snap.existing_names), floor=1) if snap.existing_names else 0
         statics = dict(
             zone_kid=snap.zone_kid,
             ct_kid=snap.ct_kid,
@@ -242,7 +245,11 @@ class TpuSolver:
             # feasibility tables, the scan computes per-group rows instead
             tile_feasibility=P * G * T * 5 > (3 << 29),
         )
-        args = snap.solve_args(a_tzc, res_cap0, a_res)
+        # bucket the G/N axes to powers of two: repeat solves of nearby
+        # shapes (consolidation's binary-search probes, incremental
+        # provisioning rounds) reuse one compiled program instead of paying
+        # XLA compilation per solve
+        args = snap.padded(G, N).solve_args(a_tzc, res_cap0, a_res)
 
         if self.config.backend == "native":
             from .. import native

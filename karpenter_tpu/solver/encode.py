@@ -47,6 +47,24 @@ DMODE_AFFINITY = 2
 DOMAIN_KEYS = (labels_mod.TOPOLOGY_ZONE, labels_mod.CAPACITY_TYPE_LABEL_KEY)
 _DRANK_NONE = 2**28
 
+# EncodedSnapshot array fields with a G or N axis (padded by .padded()) and
+# those provably without one; .padded() refuses unclassified fields so a
+# new axis-carrying field cannot silently ship unpadded
+_PADDED_FIELDS = frozenset({
+    "g_count", "g_req", "g_def", "g_neg", "g_mask", "g_hcap",
+    "g_dmode", "g_dkey", "g_dskew", "g_dmin0", "g_dprior", "g_dreg",
+    "g_drank", "g_hstg", "g_hscap", "g_dtg",
+    "p_tol", "n_tol", "n_hcnt",
+    "n_avail", "n_base", "n_def", "n_mask", "n_dzone", "n_dct", "nh_cnt0",
+})
+_GN_FREE_FIELDS = frozenset({
+    "t_alloc", "t_cap", "t_def", "t_mask", "t_price",
+    "o_avail", "o_zone", "o_ct", "o_price",
+    "p_def", "p_neg", "p_mask", "p_daemon", "p_limit", "p_has_limit",
+    "p_titype_ok",
+    "dd0", "well_known",
+})
+
 
 def _unit_divisor(resource_name: str) -> int:
     if resource_name == res.CPU:
@@ -366,6 +384,73 @@ class EncodedSnapshot:
     zone_kid: int
     ct_kid: int
     well_known: np.ndarray  # [K] bool
+
+    def padded(self, g_target: int, n_target: int) -> "EncodedSnapshot":
+        """A copy with the group and existing-node axes padded to bucket
+        sizes, so repeat solves of nearby shapes (e.g. consolidation's
+        binary-search probes, each with a slightly different candidate set)
+        share one compiled program instead of recompiling per probe.
+
+        Padded groups have count 0 and place nothing; padded nodes have no
+        capacity and no tolerance, so they never receive fills. Decode
+        reads ``groups``/``existing_names`` (unpadded) and only walks
+        nonzero fills, so outputs stay correct.
+        """
+        import dataclasses
+
+        G = len(self.g_count)
+        N = self.n_avail.shape[0]
+        gp = max(g_target - G, 0)
+        np_pad = max(n_target - N, 0)
+        if not gp and not np_pad:
+            return self
+        # exhaustiveness guard: every array field must either be padded
+        # below or be known G/N-free — a new G/N-axis field silently
+        # shipping unpadded would clamp-index real groups inside jit
+        known = _PADDED_FIELDS | _GN_FREE_FIELDS
+        for f in dataclasses.fields(self):
+            if isinstance(getattr(self, f.name), np.ndarray) and f.name not in known:
+                raise AssertionError(
+                    f"EncodedSnapshot.{f.name} is not classified for padded();"
+                    " add it to _PADDED_FIELDS or _GN_FREE_FIELDS"
+                )
+
+        def pad(arr, axis, width, fill=0):
+            if not width:
+                return arr
+            widths = [(0, 0)] * arr.ndim
+            widths[axis] = (0, width)
+            return np.pad(arr, widths, constant_values=fill)
+
+        return dataclasses.replace(
+            self,
+            g_count=pad(self.g_count, 0, gp),
+            g_req=pad(self.g_req, 0, gp),
+            g_def=pad(self.g_def, 0, gp),
+            g_neg=pad(self.g_neg, 0, gp),
+            g_mask=pad(self.g_mask, 0, gp, fill=1),
+            g_hcap=pad(self.g_hcap, 0, gp, fill=HCAP_NONE),
+            g_dmode=pad(self.g_dmode, 0, gp),
+            g_dkey=pad(self.g_dkey, 0, gp),
+            g_dskew=pad(self.g_dskew, 0, gp),
+            g_dmin0=pad(self.g_dmin0, 0, gp),
+            g_dprior=pad(self.g_dprior, 0, gp),
+            g_dreg=pad(self.g_dreg, 0, gp),
+            g_drank=pad(self.g_drank, 0, gp, fill=_DRANK_NONE),
+            g_hstg=pad(self.g_hstg, 0, gp, fill=-1),
+            g_hscap=pad(self.g_hscap, 0, gp, fill=HCAP_NONE),
+            g_dtg=pad(self.g_dtg, 0, gp, fill=-1),
+            p_tol=pad(self.p_tol, 1, gp),
+            n_tol=pad(pad(self.n_tol, 1, gp), 0, np_pad),
+            n_hcnt=pad(pad(self.n_hcnt, 1, gp), 0, np_pad),
+            n_avail=pad(self.n_avail, 0, np_pad),
+            n_base=pad(self.n_base, 0, np_pad),
+            n_def=pad(self.n_def, 0, np_pad),
+            n_mask=pad(self.n_mask, 0, np_pad, fill=1),
+            n_dzone=pad(self.n_dzone, 0, np_pad, fill=-1),
+            n_dct=pad(self.n_dct, 0, np_pad, fill=-1),
+            nh_cnt0=pad(self.nh_cnt0, 0, np_pad),
+        )
 
     def solve_args(
         self,

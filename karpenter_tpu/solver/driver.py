@@ -248,8 +248,12 @@ class TpuSolver:
         # bucket the G/N axes to powers of two: repeat solves of nearby
         # shapes (consolidation's binary-search probes, incremental
         # provisioning rounds) reuse one compiled program instead of paying
-        # XLA compilation per solve
-        args = snap.padded(G, N).solve_args(a_tzc, res_cap0, a_res)
+        # XLA compilation per solve. The native backend has no compilation
+        # to amortize, so it runs the exact shapes.
+        if self.config.backend == "tpu":
+            args = snap.padded(G, N).solve_args(a_tzc, res_cap0, a_res)
+        else:
+            args = snap.solve_args(a_tzc, res_cap0, a_res)
 
         if self.config.backend == "native":
             from .. import native

@@ -46,6 +46,10 @@ DMODE_AFFINITY = 2
 # therefore ride the TPU as a dense domain axis (solver/vocab.py)
 DOMAIN_KEYS = (labels_mod.TOPOLOGY_ZONE, labels_mod.CAPACITY_TYPE_LABEL_KEY)
 _DRANK_NONE = 2**28
+# per-pod memoized routing verdict sentinel; a STRING so it survives
+# copy.deepcopy of a pod (an object() sentinel would deep-copy to a new
+# identity and masquerade as a group key)
+_NOT_TENSORIZABLE = "__not_tensorizable__"
 
 # EncodedSnapshot array fields with a G or N axis (padded by .padded()) and
 # those provably without one; .padded() refuses unclassified fields so a
@@ -836,30 +840,53 @@ def partition_and_group(
     # the uncommon shapes)
     rest_append = rest.append
     get_group = by_key.get
+    # routing verdicts memoize on the pod object, validated against the
+    # store's resource_version (client.update bumps it, invalidating the
+    # entry): the provisioner re-walks long-pending pods every batch and
+    # consolidation's binary search re-walks the same reschedulable pods
+    # once per probe. Oracle-side relaxation mutates pods WITHOUT a store
+    # update, but only ever pods already cached non-tensorizable — a stale
+    # verdict there keeps them oracle-routed (slower, never wrong).
+    gk_attr = "_gk_cache" if allow_topo else "_gk_cache_nt"
     for pod in pods:
-        spec = pod.spec
-        affinity = spec.node_affinity
-        if (
-            spec.topology_spread_constraints
-            or spec.pod_anti_affinity
-            or spec.pod_affinity
-            or spec.preferred_pod_affinity
-            or spec.preferred_pod_anti_affinity
-            or spec.host_ports
-            or spec.volumes
-        ):
-            if not is_tensorizable(pod, allow_topology=allow_topo):
+        cached = getattr(pod, gk_attr, None)
+        key = None
+        if cached is not None and cached[0] == pod.metadata.resource_version:
+            key = cached[1]
+            if key == _NOT_TENSORIZABLE:
                 rest_append(pod)
                 continue
-            key = group_key(pod)
-        else:
-            # constraint-free fast shape: only selector/affinity/tolerations
-            if affinity is not None:
+        if key is None:
+            spec = pod.spec
+            affinity = spec.node_affinity
+            if (
+                spec.topology_spread_constraints
+                or spec.pod_anti_affinity
+                or spec.pod_affinity
+                or spec.preferred_pod_affinity
+                or spec.preferred_pod_anti_affinity
+                or spec.host_ports
+                or spec.volumes
+            ):
                 if not is_tensorizable(pod, allow_topology=allow_topo):
+                    object.__setattr__(
+                        pod, gk_attr,
+                        (pod.metadata.resource_version, _NOT_TENSORIZABLE),
+                    )
+                    rest_append(pod)
+                    continue
+                key = group_key(pod)
+            elif affinity is not None:
+                if not is_tensorizable(pod, allow_topology=allow_topo):
+                    object.__setattr__(
+                        pod, gk_attr,
+                        (pod.metadata.resource_version, _NOT_TENSORIZABLE),
+                    )
                     rest_append(pod)
                     continue
                 key = group_key(pod)
             else:
+                # constraint-free fast shape: selector/tolerations only
                 sel = spec.node_selector
                 tol = spec.tolerations
                 key = (
@@ -870,10 +897,13 @@ def partition_and_group(
                         (t.key, t.operator, t.value, t.effect) for t in tol
                     ) if tol else (),
                 )
+            object.__setattr__(
+                pod, gk_attr, (pod.metadata.resource_version, key)
+            )
         g = get_group(key)
         if g is None:
             by_key[key] = PodGroup(
-                [pod], pod_requirements(pod), dict(spec.requests)
+                [pod], pod_requirements(pod), dict(pod.spec.requests)
             )
         else:
             g.pods.append(pod)

@@ -324,6 +324,9 @@ class Topology:
         self.domain_groups = build_domain_groups(node_pools, instance_types)
         self.topology_groups: Dict[tuple, TopologyGroup] = {}
         self.inverse_topology_groups: Dict[tuple, TopologyGroup] = {}
+        # pod uid -> owned forward groups; avoids scanning every group per
+        # placement attempt (add_requirements is the oracle's hot loop)
+        self._owner_index: Dict[str, List[TopologyGroup]] = {}
         self.excluded_pods: Set[str] = {p.uid for p in pods}
         self._update_inverse_affinities()
         for pod in pods:
@@ -334,13 +337,14 @@ class Topology:
     def update(self, pod: Pod) -> None:
         """(Re)register the pod as owner of its topologies; called again
         after preference relaxation (topology.go:157-189)."""
-        for tg in self.topology_groups.values():
+        for tg in self._owner_index.pop(pod.uid, ()):
             tg.remove_owner(pod.uid)
 
         if pod.spec.pod_anti_affinity:
             self._update_inverse_anti_affinity(pod, None)
 
         groups = self._new_for_topologies(pod) + self._new_for_affinities(pod)
+        owned: List[TopologyGroup] = []
         for tg in groups:
             key = tg.hash_key()
             existing = self.topology_groups.get(key)
@@ -350,6 +354,10 @@ class Topology:
             else:
                 tg = existing
             tg.add_owner(pod.uid)
+            if tg not in owned:  # duplicate constraints share one group
+                owned.append(tg)
+        if owned:
+            self._owner_index[pod.uid] = owned
 
     def _new_for_topologies(self, pod: Pod) -> List[TopologyGroup]:
         return [
@@ -542,10 +550,7 @@ class Topology:
         """Forward groups apply only to their OWNER pods; inverse
         anti-affinity groups apply to any pod they select that would count on
         this node (reference: topology.go:513-528)."""
-        out = []
-        for tg in self.topology_groups.values():
-            if tg.is_owned_by(pod.uid):
-                out.append(tg)
+        out = list(self._owner_index.get(pod.uid, ()))
         for tg in self.inverse_topology_groups.values():
             if tg.counts(pod, taints, requirements):
                 out.append(tg)

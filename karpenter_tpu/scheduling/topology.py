@@ -530,6 +530,10 @@ class Topology:
             requirements.add(domains)
         return requirements, None
 
+    def owned_topologies(self, uid: str):
+        """Forward TopologyGroups owned by a pod, via the owner index."""
+        return self._owner_index.get(uid, ())
+
     def register(self, topology_key: str, domain: str) -> None:
         for tg in list(self.topology_groups.values()) + list(
             self.inverse_topology_groups.values()

@@ -45,9 +45,14 @@ class EncodeCache:
     resets the cache."""
 
     def __init__(self):
+        import threading
+
         self._fingerprint = None
         self.vocab = enc.Vocab()
         self.cache: dict = {}
+        # encode mutates the shared vocab/static arrays; concurrent solves
+        # (the gRPC sidecar) serialize the host-side encode on this lock
+        self.lock = threading.RLock()
 
     @staticmethod
     def fingerprint(templates, its_by_pool, daemon_overhead, pool_limits):
@@ -206,26 +211,28 @@ class TpuSolver:
         its_by_pool = {
             nct.node_pool_name: nct.instance_type_options for nct in templates
         }
-        vocab, cache = self._shared_cache.lease(
-            templates, its_by_pool, self.oracle.daemon_overhead, self.pool_limits
-        )
-        snap = enc.encode(
-            groups,
-            templates,
-            its_by_pool,
-            existing_nodes=self.oracle.existing_nodes,
-            daemon_overhead=self.oracle.daemon_overhead,
-            pool_limits=self.pool_limits,
-            vocab=vocab,
-            cache=cache,
-        )
-        reserved_enabled = self.oracle.reserved_capacity_enabled
-        avail_key = ("a_tzc", reserved_enabled) + snap.vocab.padded_shape()
-        avail = cache.get(avail_key)
-        if avail is None:
-            avail = cache[avail_key] = self._offering_availability(
-                snap, reserved_enabled
+        with self._shared_cache.lock:
+            vocab, cache = self._shared_cache.lease(
+                templates, its_by_pool, self.oracle.daemon_overhead,
+                self.pool_limits,
             )
+            snap = enc.encode(
+                groups,
+                templates,
+                its_by_pool,
+                existing_nodes=self.oracle.existing_nodes,
+                daemon_overhead=self.oracle.daemon_overhead,
+                pool_limits=self.pool_limits,
+                vocab=vocab,
+                cache=cache,
+            )
+            reserved_enabled = self.oracle.reserved_capacity_enabled
+            avail_key = ("a_tzc", reserved_enabled) + snap.vocab.padded_shape()
+            avail = cache.get(avail_key)
+            if avail is None:
+                avail = cache[avail_key] = self._offering_availability(
+                    snap, reserved_enabled
+                )
         a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)

@@ -1027,9 +1027,7 @@ def _resolve_topology(
         ):
             continue
         uids = group_uids[gi]
-        owned = [
-            tg for tg in topology.topology_groups.values() if tg.is_owned_by(rep.uid)
-        ]
+        owned = list(topology.owned_topologies(rep.uid))
         constraints = []  # (cap, counts) per hostname constraint
         spec = TopoSpec()
         group_specs[gi] = spec

@@ -26,7 +26,10 @@ from ..kube import Client, TestClock
 from ..scheduling.scheduler import Results
 from ..scheduling.topology import Topology
 from . import wire
-from .driver import DecodedClaim, SolverConfig, TpuSolver
+from .driver import DecodedClaim, EncodeCache, SolverConfig, TpuSolver
+
+# one process-wide cache: the sidecar serves many solves of one catalog
+_SIDECAR_ENCODE_CACHE = EncodeCache()
 
 SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
 SOLVE_METHOD = f"/{SERVICE_NAME}/Solve"
@@ -49,6 +52,9 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
         state_nodes=[],
         daemonset_pods=daemonset_pods,
         config=config,
+        # catalog encode amortizes across requests; the cache's lock
+        # serializes the host-side encode under the gRPC thread pool
+        encode_cache=_SIDECAR_ENCODE_CACHE,
         # behavior knobs travel in the snapshot so controller and sidecar
         # can never disagree on gate-dependent packing
         reserved_capacity_enabled=bool(

@@ -15,6 +15,7 @@ import math
 from typing import Dict, List, Optional
 
 from ..api import labels as labels_mod
+from ..api import validation
 from ..api import resources as res
 from ..api.objects import (
     COND_CONSISTENT_STATE_FOUND,
@@ -223,5 +224,15 @@ class NodePoolStatusController:
                     count += 1
             total["nodes"] = count * res.MILLI
             pool.status.resources = total
-            pool.conds().set(COND_READY, "True", now=now)
+            # schema-tier validation gates readiness (the reference's
+            # nodepool validation controller + CRD CEL rules;
+            # api/validation.py)
+            verrs = validation.validate_node_pool(pool)
+            if verrs:
+                pool.conds().set(
+                    COND_READY, "False", reason="ValidationFailed",
+                    message="; ".join(verrs[:3]), now=now,
+                )
+            else:
+                pool.conds().set(COND_READY, "True", now=now)
             self.client.update_status(pool)

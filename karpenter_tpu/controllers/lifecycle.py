@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..api import labels as labels_mod
+from ..api import validation
 from ..api import resources as res
 from ..api import taints as taints_mod
 from ..api.objects import (
@@ -65,6 +66,18 @@ class LifecycleController:
     def _launch(self, claim: NodeClaim) -> None:
         conds = claim.conds()
         if conds.is_true(COND_LAUNCHED):
+            return
+        # schema-tier admission (the CRD CEL rules, nodeclaim.go:38-41):
+        # an invalid claim can never produce a node; delete it like an
+        # unrecoverable launch failure
+        verrs = validation.validate_node_claim(claim)
+        if verrs:
+            self.recorder.publish(
+                Event(claim.uid, "Warning", "ValidationFailed",
+                      "; ".join(verrs[:3]))
+            )
+            self.client.delete(claim)
+            self._finalize(claim)
             return
         try:
             self.cloud_provider.create(claim)

@@ -66,11 +66,16 @@ class EncodeCache:
             )
             for nct in templates
         )
+        # content-addressed (NOT id()): the gRPC sidecar decodes a fresh
+        # InstanceType object per request, and the cache must still hit on
+        # an unchanged catalog
         types = tuple(
             (
                 pool,
                 tuple(
-                    (id(it), it.name,
+                    (it.name,
+                     tuple(sorted(it.capacity.items())),
+                     repr(it.requirements),
                      tuple((o.price, o.available, o.reservation_capacity)
                            for o in it.offerings))
                     for it in its

@@ -826,3 +826,88 @@ class TestLeaderElection:
         assert client.list(NodeClaim) == []
         a.step(force_provision=True)
         assert len(client.list(NodeClaim)) == 1
+
+
+class TestSchemaValidation:
+    """CRD/CEL-tier validation (api/validation.py; reference
+    nodepool_validation.go, nodeclaim_validation.go, CEL rules in
+    nodepool.go:79,176-184)."""
+
+    def test_valid_pool_is_ready(self, env):
+        clock, client, provider, operator, binder = env
+        from karpenter_tpu.api.objects import COND_READY
+
+        pool = make_nodepool()
+        client.create(pool)
+        operator.nodepool_status.reconcile_all()
+        assert pool.conds().is_true(COND_READY)
+
+    def test_invalid_requirement_blocks_readiness(self, env):
+        from karpenter_tpu.api.objects import COND_READY, NodeSelectorRequirement
+
+        clock, client, provider, operator, binder = env
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(labels.TOPOLOGY_ZONE, "In", ())]
+        )
+        client.create(pool)
+        operator.nodepool_status.reconcile_all()
+        conds = pool.conds()
+        assert not conds.is_true(COND_READY)
+        assert conds.get(COND_READY).reason == "ValidationFailed"
+
+    def test_rule_catalog(self):
+        from karpenter_tpu.api import validation
+        from karpenter_tpu.api.objects import (
+            Budget, NodeSelectorRequirement, Taint,
+        )
+
+        R = NodeSelectorRequirement
+        # In must have values (CEL nodepool.go:176)
+        assert validation.validate_requirement(R("team", "In", ()))
+        # Gt/Lt single positive integer (CEL nodepool.go:177)
+        assert validation.validate_requirement(R("cpu-gen", "Gt", ("a",)))
+        assert validation.validate_requirement(R("cpu-gen", "Gt", ("1", "2")))
+        assert not validation.validate_requirement(R("cpu-gen", "Gt", ("3",)))
+        # minValues bound (CEL nodepool.go:178)
+        assert validation.validate_requirement(
+            R(labels.TOPOLOGY_ZONE, "In", ("a",), min_values=2)
+        )
+        # restricted label (labels.go:109-118)
+        assert validation.validate_requirement(
+            R("kubernetes.io/hostname", "In", ("n1",))
+        )
+        # well-known labels always pass the restriction
+        assert not validation.validate_requirement(
+            R(labels.TOPOLOGY_ZONE, "In", ("test-zone-a",))
+        )
+        # unsupported operator
+        assert validation.validate_requirement(R("team", "NotAnOp", ("x",)))
+        # malformed key / value syntax
+        assert validation.validate_requirement(R("-bad-", "In", ("x",)))
+        assert validation.validate_requirement(R("team", "In", ("bad value",)))
+
+        pool = make_nodepool(taints=[
+            Taint(key="a", value="v", effect="NoSchedule"),
+            Taint(key="a", value="w", effect="NoSchedule"),
+        ])
+        errs = validation.validate_node_pool(pool)
+        assert any("duplicate taint" in e for e in errs)
+
+        pool = make_nodepool(name="w")
+        pool.spec.weight = 500
+        assert any("weight" in e for e in validation.validate_node_pool(pool))
+
+        # budget: schedule requires duration (CEL nodepool.go:79) + cron syntax
+        pool = make_nodepool(name="b")
+        pool.spec.disruption.budgets = [Budget(nodes="10%", schedule="0 9 * * *")]
+        assert any("duration" in e for e in validation.validate_node_pool(pool))
+        pool.spec.disruption.budgets = [
+            Budget(nodes="10%", schedule="not cron", duration=60.0)
+        ]
+        assert any("cron" in e for e in validation.validate_node_pool(pool))
+        pool.spec.disruption.budgets = [Budget(nodes="nope")]
+        assert any("nodes" in e for e in validation.validate_node_pool(pool))
+        pool.spec.disruption.budgets = [
+            Budget(nodes="20%", schedule="0 9 * * 1-5", duration=3600.0)
+        ]
+        assert validation.validate_node_pool(pool) == []

@@ -84,15 +84,21 @@ class TestStoreAndClusterRaces:
         stop = threading.Event()
 
         def reader():
-            barrier.wait()
-            while not stop.is_set():
-                # deep-copied snapshots must never tear: every node must
-                # carry consistent identity and non-negative availability
-                for sn in cluster.nodes():
-                    assert sn.name
-                    for v in sn.available().values():
-                        assert v >= -1e9
-                cluster.synced()
+            try:
+                barrier.wait()
+                while not stop.is_set():
+                    # deep-copied snapshots must never tear: every node
+                    # carries consistent identity and bindings
+                    for sn in cluster.nodes():
+                        assert sn.name, "torn snapshot: unnamed node"
+                        sn.available()  # must not raise mid-copy
+                        for p in sn.pods:
+                            assert p.spec.node_name == sn.name, (
+                                "torn snapshot: pod bound elsewhere"
+                            )
+                    cluster.synced()
+            except Exception as exc:  # pragma: no cover - race reporting
+                errors.append(exc)
 
         threads = [
             threading.Thread(target=churn, args=(t,)) for t in range(N_THREADS)

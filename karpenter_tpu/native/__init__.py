@@ -82,17 +82,19 @@ def solve_core_native(
     g_count, g_req, g_def, g_neg, g_mask, g_hcap,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     g_hstg, g_hscap, g_dtg,
+    g_hself, g_hcontrib, g_dcontrib,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc, res_cap0, a_res,
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
-    nh_cnt0, dd0,
+    nh_cnt0, dd0, dtg_key,
     well_known,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
     has_domains: bool = True,  # trace-time gate for the JAX twin; unused here
+    has_contrib: bool = False,  # trace-time gate for the JAX twin; unused here
     tile_feasibility: bool = False,  # JAX execution strategy; unused here
 ) -> Tuple[np.ndarray, ...]:
     """Same contract as ops/solve.py::solve_core (and solve_all), on host.
@@ -118,8 +120,12 @@ def solve_core_native(
     g_hstg = _as(g_hstg, np.int32)
     g_hscap = _as(g_hscap, np.int32)
     g_dtg = _as(g_dtg, np.int32)
+    g_hself = _as(g_hself, np.uint8)
+    g_hcontrib = _as(g_hcontrib, np.uint8)
+    g_dcontrib = _as(g_dcontrib, np.uint8)
     nh_cnt0 = _as(nh_cnt0, np.int32)
     dd0 = _as(dd0, np.int32)
+    dtg_key = _as(dtg_key, np.int32)
     res_cap0 = _as(res_cap0, np.int32)
     a_res = _as(a_res, np.uint8)
     g_def, g_neg, g_mask = (_as(x, np.uint8) for x in (g_def, g_neg, g_mask))
@@ -170,6 +176,7 @@ def solve_core_native(
         _ptr(g_dmode), _ptr(g_dkey), _ptr(g_dskew), _ptr(g_dmin0),
         _ptr(g_dprior), _ptr(g_dreg), _ptr(g_drank),
         _ptr(g_hstg), _ptr(g_hscap), _ptr(g_dtg),
+        _ptr(g_hself), _ptr(g_hcontrib), _ptr(g_dcontrib),
         _ptr(p_def), _ptr(p_neg), _ptr(p_mask), _ptr(p_daemon), _ptr(p_limit),
         _ptr(p_has_limit), _ptr(p_tol), _ptr(p_titype_ok),
         _ptr(t_def), _ptr(t_mask), _ptr(t_alloc), _ptr(t_cap),
@@ -178,7 +185,7 @@ def solve_core_native(
         _ptr(n_def), _ptr(n_mask), _ptr(n_avail), _ptr(n_base), _ptr(n_tol),
         _ptr(n_hcnt),
         _ptr(n_dzone), _ptr(n_dct),
-        _ptr(nh_cnt0), _ptr(dd0),
+        _ptr(nh_cnt0), _ptr(dd0), _ptr(dtg_key),
         _ptr(well_known),
         _ptr(c_pool), _ptr(c_tmask), _ptr(n_open), _ptr(overflow),
         _ptr(exist_fills), _ptr(claim_fills), _ptr(unplaced),

@@ -186,6 +186,10 @@ int kt_solve(
     const int32_t* g_drank,   // [G, V1]
     // shared-constraint slots + caps
     const int32_t* g_hstg, const int32_t* g_hscap, const int32_t* g_dtg,
+    // shared-constraint roles: g_hself[G] (cap vs gate), contribution rows
+    // g_hcontrib[G,JH] / g_dcontrib[G,JD] (the oracle's record() rule)
+    const uint8_t* g_hself, const uint8_t* g_hcontrib,
+    const uint8_t* g_dcontrib,
     // templates
     const uint8_t* p_def, const uint8_t* p_neg, const uint8_t* p_mask,
     const float* p_daemon, const float* p_limit, const uint8_t* p_has_limit,
@@ -206,6 +210,7 @@ int kt_solve(
     const int32_t* n_dzone, const int32_t* n_dct,  // [N] domain value ids
     const int32_t* nh_cnt0,  // [N, JH] shared hostname-constraint priors
     const int32_t* dd0,      // [JD, V1] shared domain carry init
+    const int32_t* dtg_key,  // [JD] shared domain-constraint axis (0=zone)
     const uint8_t* well_known,
     // outputs
     int32_t* out_c_pool,      // [NMAX]
@@ -362,10 +367,18 @@ int kt_solve(
     const bool min0 = g_dmin0[gi];
     const uint8_t* reg = g_dreg + static_cast<size_t>(gi) * V1;
     const int32_t* drank = g_drank + static_cast<size_t>(gi) * V1;
-    // shared constraints: counts from the carries
+    // shared constraints: counts from the carries. Self owners (hself) are
+    // capped at scap_h minus the entity's count and counted; gate owners
+    // are blocked where the count exceeds the threshold, never counted.
     const int32_t jh = g_hstg[gi];
     const bool has_h = jh >= 0;
+    const bool hself = has_h && g_hself[gi];
     const int32_t scap_h = g_hscap[gi];
+    auto h_allow = [&](int32_t cnt) -> int32_t {
+      if (!has_h) return kBigFit;
+      if (hself) return std::max(scap_h - cnt, 0);
+      return (cnt > scap_h) ? 0 : kBigFit;
+    };
     const int32_t jd = g_dtg[gi];
     const bool has_d = jd >= 0;
     std::vector<int32_t> D0v(V1);
@@ -385,8 +398,7 @@ int kt_solve(
           std::max(hc - n_hcnt[static_cast<size_t>(n) * G + gi], 0));
       if (has_h)
         exist_cap[n] = std::min(
-            exist_cap[n],
-            std::max(scap_h - nhc[static_cast<size_t>(n) * JH + jh], 0));
+            exist_cap[n], h_allow(nhc[static_cast<size_t>(n) * JH + jh]));
     }
 
     // node domain slot on the constrained axis
@@ -428,7 +440,28 @@ int kt_solve(
       for (int d = 0; d < V1; ++d)
         realcap[d] =
             std::min<int32_t>(czcap[d] + (fresh_ok[d] ? kBigDom : 0), kBigDom);
-      if (mode == 1 /* DMODE_SPREAD */) {
+      if (mode == 3 || mode == 4) {
+        // GATE modes (DMODE_GATE_SPREAD / DMODE_GATE_AFF): the group is
+        // constrained by the carry-evolved counts but never moves them.
+        // gate-spread admits domains within skew of the STATIC min
+        // (topologygroup.go:233-244 with selects=false); gate-affinity
+        // admits currently nonempty domains (:277-290). Capacity within a
+        // domain is unbounded, so the per-domain cap is just feasibility.
+        int32_t mstat = kBigDom;
+        for (int d = 0; d < V1; ++d)
+          if (reg[d]) mstat = std::min(mstat, D0[d]);
+        if (min0) mstat = 0;
+        std::vector<int32_t> npods(V1), scap(V1);
+        for (int d = 0; d < V1; ++d) {
+          npods[d] = reg[d] ? D0[d] : kBigDom;
+          bool allowed =
+              reg[d] && (mode == 3 ? (D0[d] - mstat <= skew) : (D0[d] > 0));
+          scap[d] = allowed ? std::min(realcap[d], count) : 0;
+        }
+        std::vector<int32_t> qfill(V1);
+        waterfill(npods, scap, count, qfill);
+        for (int d = 0; d < V1; ++d) qd[d] = qfill[d];
+      } else if (mode == 1 /* DMODE_SPREAD */) {
         // L* = maxSkew + min over registered domains of (D0 + cap): the
         // closed form of sequential min-count-within-maxSkew selection
         // (topologygroup.go:205-251); minDomains pins the min to 0
@@ -487,7 +520,7 @@ int kt_solve(
             exist_used[static_cast<size_t>(n) * R + r] += exist_fill[n] * req[r];
           out_exist_fills[static_cast<size_t>(gi) * N + n] = exist_fill[n];
           qrem[nd_slot[n]] -= exist_fill[n];
-          if (has_h) nhc[static_cast<size_t>(n) * JH + jh] += exist_fill[n];
+          if (hself) nhc[static_cast<size_t>(n) * JH + jh] += exist_fill[n];
         }
       }
     }
@@ -578,8 +611,7 @@ int kt_solve(
       claim_cap[s] = std::min(claim_cap[s], hc);  // open claims carry no prior
       if (has_h)
         claim_cap[s] = std::min(
-            claim_cap[s],
-            std::max(scap_h - ch_cnt[static_cast<size_t>(s) * JH + jh], 0));
+            claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
     }
     // per-slot water-fill with the slot's remaining quota as budget
     for (int sl = 0; sl < NSLOT; ++sl) {
@@ -603,7 +635,7 @@ int kt_solve(
       if (claim_fill[s] <= 0) continue;
       got[s] = 1;
       c_npods[s] += claim_fill[s];
-      if (has_h) ch_cnt[static_cast<size_t>(s) * JH + jh] += claim_fill[s];
+      if (hself) ch_cnt[static_cast<size_t>(s) * JH + jh] += claim_fill[s];
       for (int r = 0; r < R; ++r)
         c_used[static_cast<size_t>(s) * R + r] += claim_fill[s] * req[r];
       out_claim_fills[static_cast<size_t>(gi) * NMAX + s] = claim_fill[s];
@@ -742,7 +774,9 @@ int kt_solve(
           debit[r] = std::max(debit[r], t_cap[t * R + r]);
       }
       n_per = std::min(n_per, hc);
-      if (has_h) n_per = std::min(n_per, scap_h);
+      // fresh claims have count 0: self owners cap at scap_h; gate owners
+      // are unblocked (0 never exceeds the threshold)
+      if (hself) n_per = std::min(n_per, scap_h);
       if (n_per <= 0) {
         ddead[d_sel] = 1;
         continue;
@@ -836,7 +870,7 @@ int kt_solve(
             c_dct[slot] = d_sel;
         }
         out_claim_fills[static_cast<size_t>(gi) * NMAX + slot] = n_take;
-        if (has_h) ch_cnt[static_cast<size_t>(slot) * JH + jh] = n_take;
+        if (hself) ch_cnt[static_cast<size_t>(slot) * JH + jh] = n_take;
         c_resv[slot] = any_resv;
         placed += n_take;
       }
@@ -852,11 +886,61 @@ int kt_solve(
       qrem[d_sel] -= placed;
       if (placed == 0) ddead[d_sel] = 1;
     }
-    // shared domain carry: this group's per-domain placements feed the
-    // next sharing group's counts
-    if (has_d)
+    // shared domain carry: a SELF owner's per-domain placements feed the
+    // next sharing group's counts (gate modes never count themselves)
+    if (has_d && mode <= 2)
       for (int d = 0; d < V1; ++d)
         ddc[static_cast<size_t>(jd) * V1 + d] += qd[d] - qrem[d];
+    // contributor counting (the oracle's record() rule,
+    // scheduling/topology.py:491-498): existing-node placements count by
+    // the node's domain; claim placements count only when the claim's key
+    // axis is pinned to a single value (hostname is always single per
+    // claim, so ch_cnt takes every claim fill).
+    {
+      bool anyh = false, anyd = false;
+      for (int j = 0; j < JH; ++j)
+        anyh = anyh || g_hcontrib[static_cast<size_t>(gi) * JH + j];
+      for (int j = 0; j < JD; ++j)
+        anyd = anyd || g_dcontrib[static_cast<size_t>(gi) * JD + j];
+      if (anyh) {
+        for (int j = 0; j < JH; ++j) {
+          if (!g_hcontrib[static_cast<size_t>(gi) * JH + j]) continue;
+          for (int n = 0; n < N; ++n)
+            nhc[static_cast<size_t>(n) * JH + j] +=
+                out_exist_fills[static_cast<size_t>(gi) * N + n];
+          for (int s = 0; s < NMAX; ++s)
+            ch_cnt[static_cast<size_t>(s) * JH + j] +=
+                out_claim_fills[static_cast<size_t>(gi) * NMAX + s];
+        }
+      }
+      if (anyd) {
+        std::vector<int32_t> cnt_z(V1, 0), cnt_c(V1, 0);
+        for (int n = 0; n < N; ++n) {
+          int32_t f = out_exist_fills[static_cast<size_t>(gi) * N + n];
+          if (!f) continue;
+          if (n_dzone[n] >= 0 && n_dzone[n] < V1) cnt_z[n_dzone[n]] += f;
+          if (n_dct[n] >= 0 && n_dct[n] < V1) cnt_c[n_dct[n]] += f;
+        }
+        for (int s = 0; s < NMAX; ++s) {
+          int32_t f = out_claim_fills[static_cast<size_t>(gi) * NMAX + s];
+          if (!f) continue;
+          const uint8_t* sm = c_mask.data() + static_cast<size_t>(s) * KV;
+          int zn = 0, zlast = -1, cn = 0, clast = -1;
+          for (int v = 0; v < V1; ++v) {
+            if (sm[zone_kid * V1 + v]) { ++zn; zlast = v; }
+            if (sm[ct_kid * V1 + v]) { ++cn; clast = v; }
+          }
+          if (zn == 1) cnt_z[zlast] += f;
+          if (cn == 1) cnt_c[clast] += f;
+        }
+        for (int j = 0; j < JD; ++j) {
+          if (!g_dcontrib[static_cast<size_t>(gi) * JD + j]) continue;
+          const int32_t* src = (dtg_key[j] == 0) ? cnt_z.data() : cnt_c.data();
+          for (int d = 0; d < V1; ++d)
+            ddc[static_cast<size_t>(j) * V1 + d] += src[d];
+        }
+      }
+    }
     int32_t left = 0;
     for (int sl = 0; sl < NSLOT; ++sl) left += qrem[sl];
     // pods never granted quota (domain water-fill ran out of capacity)

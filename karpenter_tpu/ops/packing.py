@@ -53,7 +53,13 @@ from .feasibility import (
     requirements_compatible,
     requirements_intersect,
 )
-from ..solver.encode import DMODE_AFFINITY, DMODE_NONE, DMODE_SPREAD
+from ..solver.encode import (
+    DMODE_AFFINITY,
+    DMODE_GATE_AFF,
+    DMODE_GATE_SPREAD,
+    DMODE_NONE,
+    DMODE_SPREAD,
+)
 
 _BIGI = 2**28  # "unbounded" domain capacity; keeps int32 bisection safe
 
@@ -134,7 +140,8 @@ class PackState(NamedTuple):
 @partial(
     jax.jit,
     static_argnames=(
-        "nmax", "zone_kid", "ct_kid", "has_domains", "tile_feasibility"
+        "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
+        "tile_feasibility",
     ),
 )
 def pack(
@@ -144,6 +151,9 @@ def pack(
     g_dmode, g_dkey, g_dskew, g_dmin0,  # [G] domain-constraint descriptors
     g_dprior, g_dreg, g_drank,  # [G, V1] prior counts / registered / rank
     g_hstg, g_hscap, g_dtg,  # [G] shared-constraint slots (-1 = none) + caps
+    g_hself,  # [G] bool shared-hostname role (True = self-counted cap)
+    g_hcontrib,  # [G, JH] bool shared-hostname slots this group counts toward
+    g_dcontrib,  # [G, JD] bool shared-domain slots this group counts toward
     # precomputed feasibility tables
     compat_pg, type_ok_pgt, n_fit_pgt,  # [P,G], [P,G,T], [P,G,T]
     cap_ng,  # [N, G] existing-node capacity at t0 (compat ∧ taints)
@@ -165,11 +175,13 @@ def pack(
     n_dzone, n_dct,  # [N] int32 zone / capacity-type value id (-1 = none)
     nh_cnt0,  # [N, JH] int32 shared hostname-constraint node priors
     dd0,  # [JD, V1] int32 shared domain-count carry init
+    dtg_key,  # [JD] int32 shared domain-constraint axis (0 = zone, 1 = ct)
     well_known,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
     has_domains: bool = True,
+    has_contrib: bool = False,
     tile_feasibility: bool = False,
 ):
     """Run the grouped-FFD scan. Returns per-group placement matrices and the
@@ -284,13 +296,30 @@ def pack(
             cap_row = cap_ng[:, gi]  # [N]
         hcap = g_hcap[gi]
         # shared hostname constraint: the cap applies against counts that
-        # accumulate across groups in the carry
+        # accumulate across groups in the carry. Self owners are capped at
+        # (scap_h - count) and counted; gate owners are blocked where the
+        # count already exceeds the threshold and never counted.
         JH = nh_cnt0.shape[1]
         jh = g_hstg[gi]
         has_h = jh >= 0
+        hself = g_hself[gi]
         jhc = jnp.clip(jh, 0, JH - 1)
-        jh_oh = jax.nn.one_hot(jhc, JH, dtype=jnp.int32) * has_h  # [JH]
+        jh_oh = (
+            jax.nn.one_hot(jhc, JH, dtype=jnp.int32) * (has_h & hself)
+        )  # [JH]
         scap_h = g_hscap[gi]
+
+        def _h_allow(cnt):
+            """Per-entity allowance under the shared hostname constraint."""
+            return jnp.where(
+                has_h,
+                jnp.where(
+                    hself,
+                    jnp.maximum(scap_h - cnt, 0),
+                    jnp.where(cnt > scap_h, 0, _BIGI),
+                ),
+                _BIGI,
+            )
         # shared domain constraint: counts from the domain carry add to the
         # group's static cluster priors
         JD = dd0.shape[0]
@@ -387,12 +416,7 @@ def pack(
         )
         exist_cap = jnp.minimum(exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0))
         if N:
-            exist_cap = jnp.minimum(
-                exist_cap,
-                jnp.where(
-                    has_h, jnp.maximum(scap_h - state.nhc[:, jhc], 0), _BIGI
-                ),
-            )
+            exist_cap = jnp.minimum(exist_cap, _h_allow(state.nhc[:, jhc]))
 
         if has_domains:
             # node domain slot on the constrained axis
@@ -456,10 +480,32 @@ def pack(
                 jnp.zeros((V1,), jnp.int32),
             )
 
+            # GATE modes: the group is constrained by the carry-evolved
+            # counts but its placements never move them (the owner pod is
+            # not selected by its own constraint). Admissible domains are
+            # those within skew of the STATIC min (gate-spread,
+            # topologygroup.go:233-244 with selects=false) or currently
+            # nonempty (gate-affinity, :277-290); capacity within a domain
+            # is unbounded, so the per-domain cap is just feasibility.
+            mstat = jnp.where(
+                min0, 0, jnp.min(jnp.where(reg, D0, _BIGI))
+            )
+            allowed_gate = reg & jnp.where(
+                mode == DMODE_GATE_AFF, D0 > 0, D0 - mstat <= skew
+            )
+            scap_gate = jnp.where(
+                allowed_gate, jnp.minimum(realcap, count), 0
+            )
+            q_gate = waterfill(jnp.where(reg, D0, _BIGI), scap_gate, count)
+
             q_dom = jnp.where(
                 mode == DMODE_SPREAD,
                 q_spread,
-                jnp.where(mode == DMODE_AFFINITY, q_aff, 0),
+                jnp.where(
+                    mode == DMODE_AFFINITY,
+                    q_aff,
+                    jnp.where(mode >= DMODE_GATE_SPREAD, q_gate, 0),
+                ),
             )
             qd = (
                 jnp.zeros((NSLOT,), jnp.int32)
@@ -530,12 +576,7 @@ def pack(
         def _clamp(cap):
             cap = jnp.minimum(cap, hcap)  # open claims carry no prior
             cap = jnp.minimum(cap, count)  # keeps int32 waterfill sums safe
-            return jnp.minimum(
-                cap,
-                jnp.where(
-                    has_h, jnp.maximum(scap_h - state.ch_cnt[:, jhc], 0), _BIGI
-                ),
-            )
+            return jnp.minimum(cap, _h_allow(state.ch_cnt[:, jhc]))
 
         def _tier2_any(_):
             c_slot = jnp.full((nmax,), ANY, jnp.int32)
@@ -683,7 +724,9 @@ def pack(
             n_per = jnp.minimum(
                 jnp.max(jnp.where(avail[p_star], n_fit_row[p_star], 0)), hcap
             )
-            n_per = jnp.minimum(n_per, jnp.where(has_h, scap_h, _BIGI))
+            # fresh claims have count 0: self owners cap at scap_h; gate
+            # owners are unblocked (0 never exceeds the threshold)
+            n_per = jnp.minimum(n_per, jnp.where(has_h & hself, scap_h, _BIGI))
 
             # pessimistic limit debit: max capacity over the claim's options
             debit = jnp.max(
@@ -835,13 +878,61 @@ def pack(
         new_state, qrem_fin, claim_fill, _ = jax.lax.while_loop(
             cond2, body, (new_state, qrem, claim_fill, ddead0)
         )
-        # shared domain carry: this group's per-domain placements feed the
-        # next sharing group's counts
+        # shared domain carry: a SELF owner's per-domain placements feed the
+        # next sharing group's counts (gate modes never count themselves)
         new_state = new_state._replace(
             ddc=new_state.ddc.at[jdc].add(
-                jnp.where(has_d, qd[:V1] - qrem_fin[:V1], 0)
+                jnp.where(
+                    has_d & (mode < DMODE_GATE_SPREAD),
+                    qd[:V1] - qrem_fin[:V1],
+                    0,
+                )
             )
         )
+        if has_contrib:
+            # contributor counting (the oracle's record() rule,
+            # scheduling/topology.py:491-498): existing-node placements
+            # count by the node's domain; claim placements count only when
+            # the claim's key axis is pinned to a single value (fresh
+            # multi-domain claims are NOT recorded — hostname is always
+            # single per claim, so ch_cnt takes every claim fill).
+            hrow = g_hcontrib[gi].astype(jnp.int32)  # [JH]
+            drow = g_dcontrib[gi].astype(jnp.int32)  # [JD]
+            if N:
+                nz_oh = jax.nn.one_hot(
+                    jnp.where(n_dzone >= 0, n_dzone, V1), V1 + 1,
+                    dtype=jnp.int32,
+                )[:, :V1]  # [N, V1]
+                nc_oh = jax.nn.one_hot(
+                    jnp.where(n_dct >= 0, n_dct, V1), V1 + 1, dtype=jnp.int32
+                )[:, :V1]
+                ze = jnp.sum(exist_fill[:, None] * nz_oh, axis=0)  # [V1]
+                ce = jnp.sum(exist_fill[:, None] * nc_oh, axis=0)
+            else:
+                ze = jnp.zeros((V1,), jnp.int32)
+                ce = jnp.zeros((V1,), jnp.int32)
+            zrow = jnp.take(new_state.c_mask, zone_kid, axis=1)  # [NMAX, V1]
+            crow = jnp.take(new_state.c_mask, ct_kid, axis=1)
+            z_single = jnp.sum(zrow, axis=1) == 1
+            c_single = jnp.sum(crow, axis=1) == 1
+            zc = jnp.sum(
+                jnp.where(z_single, claim_fill, 0)[:, None]
+                * zrow.astype(jnp.int32),
+                axis=0,
+            )  # [V1] (single-valued rows are one-hot, so mask == one_hot)
+            cc_cnt = jnp.sum(
+                jnp.where(c_single, claim_fill, 0)[:, None]
+                * crow.astype(jnp.int32),
+                axis=0,
+            )
+            per_slot = jnp.where(
+                (dtg_key == 0)[:, None], (ze + zc)[None, :], (ce + cc_cnt)[None, :]
+            )  # [JD, V1]
+            new_state = new_state._replace(
+                nhc=new_state.nhc + exist_fill[:, None] * hrow[None, :],
+                ch_cnt=new_state.ch_cnt + claim_fill[:, None] * hrow[None, :],
+                ddc=new_state.ddc + drow[:, None] * per_slot,
+            )
         unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
         return new_state, (exist_fill, claim_fill, unplaced)
 

@@ -22,17 +22,19 @@ def solve_core(
     g_count, g_req, g_def, g_neg, g_mask, g_hcap,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     g_hstg, g_hscap, g_dtg,
+    g_hself, g_hcontrib, g_dcontrib,
     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
     t_def, t_mask, t_alloc, t_cap,
     o_avail, o_zone, o_ct,
     a_tzc, res_cap0, a_res,
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
-    nh_cnt0, dd0,
+    nh_cnt0, dd0, dtg_key,
     well_known,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
     has_domains: bool = True,
+    has_contrib: bool = False,
     tile_feasibility: bool = False,
 ):
     if tile_feasibility:
@@ -69,6 +71,7 @@ def solve_core(
         g_hcap,
         g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
         g_hstg, g_hscap, g_dtg,
+        g_hself, g_hcontrib, g_dcontrib,
         compat_pg, type_ok, n_fit,
         cap_ng,
         t_alloc, t_cap,
@@ -80,12 +83,13 @@ def solve_core(
         n_def, n_mask, n_avail, n_base, n_tol,
         n_hcnt,
         n_dzone, n_dct,
-        nh_cnt0, dd0,
+        nh_cnt0, dd0, dtg_key,
         well_known,
         nmax=nmax,
         zone_kid=zone_kid,
         ct_kid=ct_kid,
         has_domains=has_domains,
+        has_contrib=has_contrib,
         tile_feasibility=tile_feasibility,
     )
     return (
@@ -104,7 +108,10 @@ def solve_core(
 
 solve_all = jax.jit(
     solve_core,
-    static_argnames=("nmax", "zone_kid", "ct_kid", "has_domains", "tile_feasibility"),
+    static_argnames=(
+        "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
+        "tile_feasibility",
+    ),
 )
 
 # MSB-first bit weights, matching numpy's unpackbits(bitorder="big")
@@ -112,7 +119,8 @@ _BIT_WEIGHTS = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
 
 
 def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
-                      has_domains: bool = True, tile_feasibility: bool = False,
+                      has_domains: bool = True, has_contrib: bool = False,
+                      tile_feasibility: bool = False,
                       fills_dtype=jnp.int32):
     """solve_core with a wire-compact output layout.
 
@@ -126,7 +134,8 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
     (c_pool, c_tmask, n_open, overflow,
      exist_fills, claim_fills, unplaced, c_dzone, c_dct, c_resv) = solve_core(
         *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid,
-        has_domains=has_domains, tile_feasibility=tile_feasibility)
+        has_domains=has_domains, has_contrib=has_contrib,
+        tile_feasibility=tile_feasibility)
     n, t = c_tmask.shape
     t_pad = -(-t // 8) * 8
     padded = jnp.pad(c_tmask, ((0, 0), (0, t_pad - t))).reshape(n, t_pad // 8, 8)
@@ -148,7 +157,7 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
 solve_all_packed = jax.jit(
     solve_core_packed,
     static_argnames=(
-        "nmax", "zone_kid", "ct_kid", "has_domains", "tile_feasibility",
-        "fills_dtype",
+        "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
+        "tile_feasibility", "fills_dtype",
     ),
 )

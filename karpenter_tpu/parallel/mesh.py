@@ -72,6 +72,9 @@ def snapshot_shardings(mesh) -> Tuple:
         g,  # g_hstg [G]
         g,  # g_hscap [G]
         g,  # g_dtg [G]
+        g,  # g_hself [G]
+        g,  # g_hcontrib [G, JH]
+        g,  # g_dcontrib [G, JD]
         rep,  # p_def
         rep,  # p_neg
         rep,  # p_mask
@@ -100,12 +103,14 @@ def snapshot_shardings(mesh) -> Tuple:
         rep,  # n_dct [N]
         rep,  # nh_cnt0 [N, JH]
         rep,  # dd0 [JD, V1]
+        rep,  # dtg_key [JD]
         rep,  # well_known [K]
     )
 
 
 def sharded_solve_fn(
-    mesh, nmax: int, zone_kid: int, ct_kid: int, has_domains: bool = True
+    mesh, nmax: int, zone_kid: int, ct_kid: int, has_domains: bool = True,
+    has_contrib: bool = False,
 ):
     """The full solve step jitted over the mesh. Group/type-sharded inputs,
     replicated outputs; XLA/GSPMD inserts the ICI collectives."""
@@ -120,6 +125,7 @@ def sharded_solve_fn(
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             has_domains=has_domains,
+            has_contrib=has_contrib,
         ),
         in_shardings=snapshot_shardings(mesh),
         out_shardings=jax.sharding.NamedSharding(

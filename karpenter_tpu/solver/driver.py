@@ -253,6 +253,9 @@ class TpuSolver:
             # static gate: topology-free batches trace out the per-domain
             # offering tensors and quota machinery entirely
             has_domains=bool((snap.g_dmode > 0).any()),
+            # static gate: contributor counting (cross-group shared
+            # constraints) traced out unless some group feeds a carry
+            has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
             # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
             # feasibility tables, the scan computes per-group rows instead
             tile_feasibility=P * G * T * 5 > (3 << 29),
@@ -353,8 +356,11 @@ class TpuSolver:
                     np.inf,
                 )
             best = np.maximum(best, np.min(per_n, axis=-1).max(axis=1))
-        # the hostname-topology caps (private and shared) bound every fill
-        best = np.minimum(np.minimum(best, snap.g_hcap), snap.g_hscap)
+        # the hostname-topology caps (private and shared) bound every fill;
+        # gate-role g_hscap values are thresholds, not caps, so they only
+        # bound self-counted groups
+        shared_cap = np.where(snap.g_hself, snap.g_hscap, enc.HCAP_NONE)
+        best = np.minimum(np.minimum(best, snap.g_hcap), shared_cap)
         capped = np.minimum(best, snap.g_count.astype(np.float64))
         return int(capped.max()) if capped.size else 0
 
@@ -365,8 +371,9 @@ class TpuSolver:
         can only shrink the real fit, so this may undershoot; the overflow
         retry doubles NMAX in that case."""
         n_fit = np.where(np.isfinite(fit), fit, 0)
+        shared_cap = np.where(snap.g_hself, snap.g_hscap, enc.HCAP_NONE)
         best = np.maximum(
-            np.minimum(np.minimum(n_fit.max(axis=1), snap.g_hcap), snap.g_hscap),
+            np.minimum(np.minimum(n_fit.max(axis=1), snap.g_hcap), shared_cap),
             1,
         )
         per_group = np.ceil(snap.g_count / best)
@@ -375,7 +382,7 @@ class TpuSolver:
         # the max, not the sum (summing overestimated a 20-deployment
         # hostname-spread mix 30x, quadrupling kernel time). Resource
         # pressure that breaks sharing is caught by the overflow retry.
-        capped = (snap.g_hcap < enc.HCAP_NONE) | (snap.g_hscap < enc.HCAP_NONE)
+        capped = (snap.g_hcap < enc.HCAP_NONE) | (shared_cap < enc.HCAP_NONE)
         base = int(per_group[~capped].sum())
         if capped.any():
             base += int(per_group[capped].max())

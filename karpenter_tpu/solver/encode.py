@@ -41,6 +41,13 @@ HCAP_NONE = 2**30  # sentinel: no per-entity topology cap
 DMODE_NONE = 0
 DMODE_SPREAD = 1
 DMODE_AFFINITY = 2
+# gate modes: the group is constrained by the counts but does not move them
+# (the owner pod is NOT selected by its own constraint; the reference checks
+# skew/options against counts other pods' placements evolve,
+# topologygroup.go:205-251 / :277-290 with selects(pod)=false). Admissible
+# domains are re-derived each step from the shared carry.
+DMODE_GATE_SPREAD = 3
+DMODE_GATE_AFF = 4
 
 # topology keys whose domains are interned in the offering vocabulary and
 # therefore ride the TPU as a dense domain axis (solver/vocab.py)
@@ -58,6 +65,7 @@ _PADDED_FIELDS = frozenset({
     "g_count", "g_req", "g_def", "g_neg", "g_mask", "g_hcap",
     "g_dmode", "g_dkey", "g_dskew", "g_dmin0", "g_dprior", "g_dreg",
     "g_drank", "g_hstg", "g_hscap", "g_dtg",
+    "g_hself", "g_hcontrib", "g_dcontrib",
     "p_tol", "n_tol", "n_hcnt",
     "n_avail", "n_base", "n_def", "n_mask", "n_dzone", "n_dct", "nh_cnt0",
 })
@@ -66,7 +74,7 @@ _GN_FREE_FIELDS = frozenset({
     "o_avail", "o_zone", "o_ct", "o_price",
     "p_def", "p_neg", "p_mask", "p_daemon", "p_limit", "p_has_limit",
     "p_titype_ok",
-    "dd0", "well_known",
+    "dd0", "dtg_key", "well_known",
 })
 
 
@@ -179,6 +187,16 @@ class TopoSpec:
     # sharing group's spec; encode() assigns carry slots by object identity
     shared_h: Optional[SharedHostTG] = None
     shared_d: Optional[SharedDomainTG] = None
+    # shared-hostname role: True = self-selecting owner (per-entity cap of
+    # h_capval, counts itself), False = gated-only owner (entities whose
+    # carry count exceeds h_capval are blocked; placements never counted)
+    h_self: bool = True
+    h_capval: Optional[int] = None  # overrides shared_h.cap when set
+    # shared constraints this group's placements COUNT toward without being
+    # gated by them (the group's pods match the selector but don't own the
+    # constraint — the oracle counts them at record(), topology.py:491-498)
+    contrib_h: List[SharedHostTG] = field(default_factory=list)
+    contrib_d: List[SharedDomainTG] = field(default_factory=list)
 
 
 @dataclass
@@ -256,6 +274,22 @@ def group_key(pod: Pod) -> tuple:
         tuple(_term_key(t) for t in spec.pod_anti_affinity),
     )
     return base + topo
+
+
+_EMPTY_FS = frozenset()
+
+
+def _sel_signature(pod: Pod, sel_keys: frozenset) -> tuple:
+    """(namespace, selector-relevant labels) appended to the group key of
+    pods whose own key carries no labels: selector matching for the
+    shared-constraint contributor role must be uniform per group."""
+    lbl = pod.metadata.labels
+    return (
+        pod.metadata.namespace,
+        frozenset((k, v) for k, v in lbl.items() if k in sel_keys)
+        if lbl
+        else _EMPTY_FS,
+    )
 
 
 def is_tensorizable(pod: Pod, allow_topology: bool = False) -> bool:
@@ -350,10 +384,14 @@ class EncodedSnapshot:
     n_dct: np.ndarray  # [N] int32 node capacity-type value id (-1 = none)
     # shared-constraint carries (cross-group counting)
     g_hstg: np.ndarray  # [G] int32 shared hostname-constraint slot (-1 none)
-    g_hscap: np.ndarray  # [G] int32 per-entity cap of the shared constraint
+    g_hscap: np.ndarray  # [G] int32 per-entity cap (self) / gate threshold
     g_dtg: np.ndarray  # [G] int32 shared domain-constraint slot (-1 none)
+    g_hself: np.ndarray  # [G] bool shared-hostname role (True = counts itself)
+    g_hcontrib: np.ndarray  # [G, JH] bool slots this group counts toward
+    g_dcontrib: np.ndarray  # [G, JD] bool slots this group counts toward
     nh_cnt0: np.ndarray  # [N, JH] int32 shared-constraint node priors
     dd0: np.ndarray  # [JD, V1] int32 shared domain-count carry init (zeros)
+    dtg_key: np.ndarray  # [JD] int32 shared domain-constraint axis (0=zone)
 
     # instance types
     t_alloc: np.ndarray  # [T, R] f32
@@ -444,6 +482,9 @@ class EncodedSnapshot:
             g_hstg=pad(self.g_hstg, 0, gp, fill=-1),
             g_hscap=pad(self.g_hscap, 0, gp, fill=HCAP_NONE),
             g_dtg=pad(self.g_dtg, 0, gp, fill=-1),
+            g_hself=pad(self.g_hself, 0, gp, fill=1),
+            g_hcontrib=pad(self.g_hcontrib, 0, gp),
+            g_dcontrib=pad(self.g_dcontrib, 0, gp),
             p_tol=pad(self.p_tol, 1, gp),
             n_tol=pad(pad(self.n_tol, 1, gp), 0, np_pad),
             n_hcnt=pad(pad(self.n_hcnt, 1, gp), 0, np_pad),
@@ -475,6 +516,7 @@ class EncodedSnapshot:
             self.g_dmode, self.g_dkey, self.g_dskew, self.g_dmin0,
             self.g_dprior, self.g_dreg, self.g_drank,
             self.g_hstg, self.g_hscap, self.g_dtg,
+            self.g_hself, self.g_hcontrib, self.g_dcontrib,
             self.p_def, self.p_neg, self.p_mask, self.p_daemon,
             self.p_limit, self.p_has_limit, self.p_tol, self.p_titype_ok,
             self.t_def, self.t_mask, self.t_alloc, self.t_cap,
@@ -483,7 +525,7 @@ class EncodedSnapshot:
             self.n_def, self.n_mask, self.n_avail, self.n_base, self.n_tol,
             self.n_hcnt,
             self.n_dzone, self.n_dct,
-            self.nh_cnt0, self.dd0,
+            self.nh_cnt0, self.dd0, self.dtg_key,
             self.well_known,
         )
 
@@ -594,24 +636,56 @@ def encode(
     g_hstg = np.full((G,), -1, np.int32)
     g_hscap = np.full((G,), HCAP_NONE, np.int32)
     g_dtg = np.full((G,), -1, np.int32)
+    g_hself = np.ones((G,), bool)
     shared_h_descs: List[SharedHostTG] = []
+    shared_d_descs: List[SharedDomainTG] = []
     _h_slots: Dict[int, int] = {}
     _d_slots: Dict[int, int] = {}
+
+    def _h_slot(desc: SharedHostTG) -> int:
+        j = _h_slots.setdefault(id(desc), len(_h_slots))
+        if j == len(shared_h_descs):
+            shared_h_descs.append(desc)
+        return j
+
+    def _d_slot(desc: SharedDomainTG) -> int:
+        j = _d_slots.setdefault(id(desc), len(_d_slots))
+        if j == len(shared_d_descs):
+            shared_d_descs.append(desc)
+        return j
+
     for i, g in enumerate(groups):
         t = g.topo
         if t is None:
             continue
         if t.shared_h is not None:
-            j = _h_slots.setdefault(id(t.shared_h), len(_h_slots))
-            if j == len(shared_h_descs):
-                shared_h_descs.append(t.shared_h)
-            g_hstg[i] = j
-            g_hscap[i] = t.shared_h.cap
+            g_hstg[i] = _h_slot(t.shared_h)
+            g_hscap[i] = t.h_capval if t.h_capval is not None else t.shared_h.cap
+            g_hself[i] = t.h_self
         if t.shared_d is not None:
-            g_dtg[i] = _d_slots.setdefault(id(t.shared_d), len(_d_slots))
+            g_dtg[i] = _d_slot(t.shared_d)
+        for desc in t.contrib_h:
+            _h_slot(desc)
+        for desc in t.contrib_d:
+            _d_slot(desc)
     JH = max(len(shared_h_descs), 1)
     JD = max(len(_d_slots), 1)
     dd0 = np.zeros((JD, V1), np.int32)
+    dtg_key = np.zeros((JD,), np.int32)
+    for j, desc in enumerate(shared_d_descs):
+        dtg_key[j] = 0 if desc.key == labels_mod.TOPOLOGY_ZONE else 1
+    # contribution rows: slots this group's placements count toward (the
+    # oracle's record() rule, scheduling/topology.py:491-498)
+    g_hcontrib = np.zeros((G, JH), bool)
+    g_dcontrib = np.zeros((G, JD), bool)
+    for i, g in enumerate(groups):
+        t = g.topo
+        if t is None:
+            continue
+        for desc in t.contrib_h:
+            g_hcontrib[i, _h_slots[id(desc)]] = True
+        for desc in t.contrib_d:
+            g_dcontrib[i, _d_slots[id(desc)]] = True
     for i, g in enumerate(groups):
         g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
         if g.topo is not None:
@@ -774,8 +848,12 @@ def encode(
         g_hstg=g_hstg,
         g_hscap=g_hscap,
         g_dtg=g_dtg,
+        g_hself=g_hself,
+        g_hcontrib=g_hcontrib,
+        g_dcontrib=g_dcontrib,
         nh_cnt0=nh_cnt0,
         dd0=dd0,
+        dtg_key=dtg_key,
         t_alloc=t_alloc,
         t_cap=t_cap,
         t_def=t_def,
@@ -834,6 +912,22 @@ def partition_and_group(
     by_key: Dict[tuple, PodGroup] = {}
     rest: List[Pod] = []
     allow_topo = topology is not None
+    # label keys referenced by any pending forward constraint's selector:
+    # constraint-FREE pods must additionally group on (namespace, these
+    # labels) so selector matching — and hence the contributor role in the
+    # shared-constraint carries — is uniform per group. Empty for
+    # constraint-free batches, preserving the hot-path key shape.
+    sel_keys = None
+    if allow_topo and topology.topology_groups:
+        keys = set()
+        for tg in topology.topology_groups.values():
+            sel = tg.selector
+            if sel is None:
+                continue
+            keys.update(sel.match_labels)
+            keys.update(e.key for e in sel.match_expressions)
+        if keys:
+            sel_keys = frozenset(keys)
     # fused per-pod check + key build: this loop walks every spec in a 50k
     # batch, so the common no-constraint shape takes one attribute sweep
     # (is_tensorizable + group_key stay the semantic reference and serve
@@ -851,8 +945,12 @@ def partition_and_group(
     for pod in pods:
         cached = getattr(pod, gk_attr, None)
         key = None
-        if cached is not None and cached[0] == pod.metadata.resource_version:
-            key = cached[1]
+        if (
+            cached is not None
+            and cached[0] == pod.metadata.resource_version
+            and cached[1] == sel_keys
+        ):
+            key = cached[2]
             if key == _NOT_TENSORIZABLE:
                 rest_append(pod)
                 continue
@@ -871,7 +969,8 @@ def partition_and_group(
                 if not is_tensorizable(pod, allow_topology=allow_topo):
                     object.__setattr__(
                         pod, gk_attr,
-                        (pod.metadata.resource_version, _NOT_TENSORIZABLE),
+                        (pod.metadata.resource_version, sel_keys,
+                         _NOT_TENSORIZABLE),
                     )
                     rest_append(pod)
                     continue
@@ -880,11 +979,14 @@ def partition_and_group(
                 if not is_tensorizable(pod, allow_topology=allow_topo):
                     object.__setattr__(
                         pod, gk_attr,
-                        (pod.metadata.resource_version, _NOT_TENSORIZABLE),
+                        (pod.metadata.resource_version, sel_keys,
+                         _NOT_TENSORIZABLE),
                     )
                     rest_append(pod)
                     continue
                 key = group_key(pod)
+                if sel_keys:
+                    key = key + _sel_signature(pod, sel_keys)
             else:
                 # constraint-free fast shape: selector/tolerations only
                 sel = spec.node_selector
@@ -897,8 +999,10 @@ def partition_and_group(
                         (t.key, t.operator, t.value, t.effect) for t in tol
                     ) if tol else (),
                 )
+                if sel_keys:
+                    key = key + _sel_signature(pod, sel_keys)
             object.__setattr__(
-                pod, gk_attr, (pod.metadata.resource_version, key)
+                pod, gk_attr, (pod.metadata.resource_version, sel_keys, key)
             )
         g = get_group(key)
         if g is None:
@@ -1164,12 +1268,33 @@ def _resolve_topology(
         g.topo = spec
 
     # -- shared constraints: one TopologyGroup spanning several groups -----
-    # (e.g. a Deployment's anti-affinity across request shapes). Tensorized
-    # via kernel carries when counting stays fully inside the tensorized
-    # groups: every owner pod grouped, the selector matches exactly the
-    # owner groups, and every owner group is selected (a mixed
-    # selected/unselected split would make the gate evolve mid-solve).
-    partners: Dict[int, set] = {}  # gi -> co-owners of any shared constraint
+    # (e.g. a Deployment's anti-affinity across request shapes, or the
+    # reference benchmark's cross-selecting spread classes). Tensorized via
+    # kernel carries when counting stays fully inside the tensorized
+    # groups: every owner pod grouped and no oracle-routed pod matches the
+    # selector. Three per-group roles fall out of the oracle's semantics:
+    #
+    # - SELF owner (tg.selects(rep)): gated by the counts AND counted —
+    #   DMODE_SPREAD/AFFINITY (or the hostname per-entity cap) plus the
+    #   carry self-update.
+    # - GATE owner (owns the constraint, not selected by it): gated by
+    #   counts other groups' placements evolve, never counted —
+    #   DMODE_GATE_* (or the hostname gate threshold, g_hself=False).
+    # - CONTRIBUTOR (selected, doesn't own): counted, never gated —
+    #   contrib_h/contrib_d rows; the kernel adds its placements to the
+    #   carry by the record() rule (single-domain entities only,
+    #   scheduling/topology.py:491-498).
+    partners: Dict[int, set] = {}  # gi -> co-parties of any shared constraint
+
+    def _filter_free(tg) -> bool:
+        """Kernel carry counting is node-filter-blind; only constraints
+        whose filter matches every node qualify for cross-group counting
+        (topologynodefilter.go:26-97 zero-value shape)."""
+        nf = tg.node_filter
+        if nf.taint_policy == "Honor":
+            return False
+        return all(len(r.values()) == 0 for r in nf.requirements)
+
     for tg in shared_pending.values():
         owner_gis = set()
         oracle_owner = False
@@ -1180,25 +1305,34 @@ def _resolve_topology(
             else:
                 owner_gis.add(gi)
         matched = matched_owners(tg.namespaces, tg.selector)
+        contrib_gis = {gi for gi in matched - owner_gis if gi >= 0}
+        oracle_matched = -1 in matched
         reps = {gi: groups[gi].pods[0] for gi in owner_gis}
+        self_gis = {gi for gi, rep in reps.items() if tg.selects(rep)}
+        gate_gis = owner_gis - self_gis
+        # the original all-self, exactly-self-matching shape
+        plain = not contrib_gis and not gate_gis
 
-        def _admit() -> Optional[Tuple[str, object]]:
-            if oracle_owner or not owner_gis:
+        def _admit() -> Optional[Tuple[str, object, Optional[int]]]:
+            if oracle_owner or not owner_gis or oracle_matched:
                 return None
-            if matched != owner_gis:
-                return None  # selects outside its owners (or misses some)
-            if not all(tg.selects(rep) for rep in reps.values()):
+            if not plain and not _filter_free(tg):
                 return None
             if tg.key == labels_mod.HOSTNAME:
                 if tg.type is TopologyType.POD_AFFINITY:
                     return None
                 cap = tg.max_skew if tg.type is TopologyType.SPREAD else 1
+                # gate threshold: blocked when the entity's count already
+                # EXCEEDS the allowance (spread: > maxSkew with min 0;
+                # anti: > 0), no count contribution
+                thresh = tg.max_skew if tg.type is TopologyType.SPREAD else 0
                 return (
                     "h",
                     SharedHostTG(
                         cap=cap,
                         counts={d: c for d, c in tg.domains.items() if c > 0},
                     ),
+                    thresh,
                 )
             if (
                 tg.key in DOMAIN_KEYS
@@ -1236,15 +1370,16 @@ def _resolve_topology(
                             prior=counts,
                             reg=frozenset(counts),
                         ),
+                        None,
                     )
                 nonempty = [d for d, c in counts.items() if c > 0]
-                if nonempty:
-                    # compatible pods already placed: the options rule is a
-                    # STATIC gate to all nonempty domains — placements never
-                    # shrink it, and multi-domain placements are not
-                    # recorded (topologygroup.go:277-290) — so no carry;
-                    # gate every owner group like the single-group path
-                    return ("gate", (tg.key, nonempty))
+                if nonempty and plain:
+                    # compatible pods already placed and no contributor can
+                    # grow the options: a STATIC gate to all nonempty
+                    # domains (topologygroup.go:277-290) — no carry. With
+                    # contributors the options evolve mid-solve, so the
+                    # dynamic follow rule in the kernel applies instead.
+                    return ("gate", (tg.key, nonempty), None)
                 return (
                     "d",
                     SharedDomainTG(
@@ -1253,12 +1388,13 @@ def _resolve_topology(
                         prior=counts,
                         reg=frozenset(counts),
                     ),
+                    None,
                 )
             return None
 
         admitted = _admit()
         if admitted is not None:
-            kind, desc = admitted
+            kind, desc, thresh = admitted
             for gi in owner_gis:
                 spec = group_specs.get(gi)
                 if spec is None or gi in demote:
@@ -1273,25 +1409,48 @@ def _resolve_topology(
                     admitted = None  # one domain-dynamic per group
                     break
             if admitted is not None:
-                for gi in owner_gis:
-                    spec = group_specs[gi]
-                    if kind == "h":
-                        spec.shared_h = desc
-                    elif kind == "gate":
+                if kind == "gate":
+                    for gi in owner_gis:
                         key, allowed = desc
                         groups[gi].requirements.add(
                             Requirement(key, Operator.IN, allowed)
                         )
-                        continue  # static gate: no carry, no partner coupling
-                    else:
-                        spec.shared_d = desc
-                        spec.dmode = desc.mode
-                        spec.dkey = desc.key
-                        spec.dskew = desc.skew
-                        spec.dmin0 = desc.min0
-                        spec.dprior = desc.prior
-                        spec.dreg = desc.reg
-                    partners.setdefault(gi, set()).update(owner_gis - {gi})
+                    # static gate: no carry, no partner coupling
+                else:
+                    for gi in owner_gis:
+                        spec = group_specs[gi]
+                        is_self = gi in self_gis
+                        if kind == "h":
+                            spec.shared_h = desc
+                            spec.h_self = is_self
+                            spec.h_capval = desc.cap if is_self else thresh
+                        else:
+                            spec.shared_d = desc
+                            spec.dmode = (
+                                desc.mode
+                                if is_self
+                                else (
+                                    DMODE_GATE_SPREAD
+                                    if desc.mode == DMODE_SPREAD
+                                    else DMODE_GATE_AFF
+                                )
+                            )
+                            spec.dkey = desc.key
+                            spec.dskew = desc.skew
+                            spec.dmin0 = desc.min0
+                            spec.dprior = desc.prior
+                            spec.dreg = desc.reg
+                    for gi in contrib_gis:
+                        g = groups[gi]
+                        if g.topo is None:
+                            g.topo = TopoSpec()
+                        if kind == "h":
+                            g.topo.contrib_h.append(desc)
+                        else:
+                            g.topo.contrib_d.append(desc)
+                    parties = owner_gis | contrib_gis
+                    for gi in parties:
+                        partners.setdefault(gi, set()).update(parties - {gi})
         if admitted is None:
             demote.update(owner_gis)
 

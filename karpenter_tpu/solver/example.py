@@ -95,5 +95,6 @@ def example_snapshot_arrays(
         zone_kid=snap.zone_kid,
         ct_kid=snap.ct_kid,
         has_domains=bool((snap.g_dmode > 0).any()),
+        has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
     )
     return snap.solve_args(a_tzc, res_cap0, a_res), statics

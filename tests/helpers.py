@@ -31,6 +31,12 @@ from karpenter_tpu.api.objects import (
 _seq = itertools.count(1)
 
 
+def _as_host_ports(ports: Sequence) -> List:
+    from karpenter_tpu.api.objects import HostPort
+
+    return [p if isinstance(p, HostPort) else HostPort(port=int(p)) for p in ports]
+
+
 def make_pod(
     name: Optional[str] = None,
     cpu: str = "1",
@@ -46,6 +52,7 @@ def make_pod(
     extra_requests: Optional[Dict[str, str]] = None,
     node_name: str = "",
     phase: str = "Pending",
+    host_ports: Sequence[int] = (),
 ) -> Pod:
     i = next(_seq)
     requests = {"cpu": res.parse_quantity(cpu), "memory": res.parse_quantity(memory)}
@@ -68,6 +75,7 @@ def make_pod(
             pod_affinity=list(pod_affinity),
             pod_anti_affinity=list(pod_anti_affinity),
             node_name=node_name,
+            host_ports=_as_host_ports(host_ports),
         ),
     )
     pod.status.phase = phase
@@ -167,5 +175,6 @@ def snapshot_args(
         zone_kid=snap.zone_kid,
         ct_kid=snap.ct_kid,
         has_domains=bool((snap.g_dmode > 0).any()),
+        has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
     )
     return snap.solve_args(a_tzc, res_cap0, a_res), statics

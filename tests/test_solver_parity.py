@@ -220,12 +220,14 @@ class TestHostnameTopology:
         for claim in tpu_r.new_node_claims:
             assert len(claim.pods) <= 1
 
-    def test_cross_group_selector_demotes_to_oracle(self):
+    def test_cross_group_selector_rides_contributor_carry(self):
         from karpenter_tpu.solver import encode as enc
         from helpers import spread_constraint
 
-        # the spread selector also matches the plain pods' labels -> the
-        # spread group must serialize through the oracle
+        # the spread selector also matches the plain pods' labels: the
+        # plain group becomes a CONTRIBUTOR to the shared hostname carry
+        # (its placements count toward the spreaders' skew) and the whole
+        # batch stays on the fast path (round-2 behavior demoted all of it)
         app = {"app": "shared"}
         plain = make_pods(4, cpu="2", labels=app)
         spreaders = make_pods(
@@ -237,11 +239,26 @@ class TestHostnameTopology:
         its_by_pool = {"default": corpus.generate(20)}
         topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
         groups, rest = enc.partition_and_group(pods, topology=topo)
-        assert {p.uid for p in rest} >= {p.uid for p in spreaders}
-        # end-to-end still schedules everything
+        assert not rest, "contributor batch must tensorize fully"
+        contrib = [
+            g for g in groups
+            if g.topo is not None and g.topo.contrib_h
+        ]
+        assert contrib, "plain group must carry a contribution row"
+        # end-to-end schedules everything; every node holds at most
+        # maxSkew selected pods ABOVE the running min — with plain pods
+        # counting, a node with a plain pod is as full as one with a
+        # spreader (the oracle's record() counts both)
         solver = TpuSolver(node_pools, its_by_pool, topo)
         results = solver.solve(pods)
         assert results.all_pods_scheduled()
+        # skew audit: count selected pods (all 7 match app=shared) per
+        # entity; hostname spread with maxSkew=1 and global min 0 means no
+        # entity may hold more than 1 SPREADER, and spreaders must land on
+        # entities where prior selected counts permit them
+        for claim in results.new_node_claims:
+            n_spread = sum(1 for p in claim.pods if p in spreaders)
+            assert n_spread <= 1
 
     def test_non_self_selecting_spread_is_node_gate(self):
         from helpers import spread_constraint
@@ -350,17 +367,17 @@ class TestHostnameTopology:
         for en in results.existing_nodes:
             assert not en.pods  # oracle honors the bound pod's anti-affinity
 
-    def test_transitive_demotion(self):
-        from karpenter_tpu.api.objects import LabelSelector, PodAffinityTerm
+    def test_cross_group_anti_takes_contributor_carry(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, LabelSelectorRequirement, PodAffinityTerm,
+        )
         from karpenter_tpu.solver import encode as enc
 
-        # A's anti selects both its own labels and B's -> A demoted for
-        # cross-group selection, then B demoted transitively
+        # A's anti selects both its own labels and B's: B becomes a
+        # CONTRIBUTOR (its placements block A's entities), both tensorized
         sel = LabelSelector(
             match_expressions=[
-                __import__(
-                    "karpenter_tpu.api.objects", fromlist=["LabelSelectorRequirement"]
-                ).LabelSelectorRequirement(key="app", operator="In", values=("a", "b"))
+                LabelSelectorRequirement(key="app", operator="In", values=("a", "b"))
             ]
         )
         term = PodAffinityTerm(topology_key=labels.HOSTNAME, label_selector=sel)
@@ -371,7 +388,44 @@ class TestHostnameTopology:
         its_by_pool = {"default": corpus.generate(20)}
         topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
         groups, rest = enc.partition_and_group(pods, topology=topo)
-        assert not groups and len(rest) == 4
+        assert not rest
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        # B packs first (FFD: cpu desc), so A must avoid every B entity
+        # and spread one-per-entity among themselves
+        for claim in results.new_node_claims:
+            n_a = sum(1 for p in claim.pods if p in a_pods)
+            n_b = sum(1 for p in claim.pods if p in b_pods)
+            assert n_a <= 1
+            assert not (n_a and n_b), "anti-affinity pod co-located with blocker"
+
+    def test_transitive_demotion(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, LabelSelectorRequirement, PodAffinityTerm,
+        )
+        from karpenter_tpu.solver import encode as enc
+
+        # A's anti selects an ORACLE-ROUTED pod (host ports force it off the
+        # fast path): counting would be blind to the oracle's placements, so
+        # A demotes — and A's selector then drags B (matched) transitively
+        sel = LabelSelector(
+            match_expressions=[
+                LabelSelectorRequirement(
+                    key="app", operator="In", values=("a", "b", "ported")
+                )
+            ]
+        )
+        term = PodAffinityTerm(topology_key=labels.HOSTNAME, label_selector=sel)
+        a_pods = make_pods(2, cpu="1", labels={"app": "a"}, pod_anti_affinity=[term])
+        b_pods = make_pods(2, cpu="2", labels={"app": "b"})
+        ported = make_pods(1, cpu="1", labels={"app": "ported"}, host_ports=(8080,))
+        pods = a_pods + b_pods + ported
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 5
 
     def test_schedule_anyway_spread_falls_back(self):
         from karpenter_tpu.solver import encode as enc
@@ -767,11 +821,13 @@ class TestSharedConstraints:
                 zones.add(next(iter(zr.values)))
         assert len(zones) == 1  # the second group followed the first's domain
 
-    def test_shared_selector_mismatch_still_demotes(self):
+    def test_shared_selector_plain_group_contributes(self):
         from helpers import spread_constraint
         from karpenter_tpu.solver import encode as enc
 
-        # the shared constraint also selects a plain group -> oracle
+        # the shared constraint also selects a plain group: that group rides
+        # the fast path as a CONTRIBUTOR whose placements feed the carry
+        # (round-2 behavior demoted the whole batch to the oracle)
         app = {"app": "smix"}
         spread = [spread_constraint(labels.HOSTNAME, labels=app)]
         pods = (
@@ -781,7 +837,36 @@ class TestSharedConstraints:
         )
         node_pools, its_by_pool, topo = self._mk(pods)
         groups, rest = enc.partition_and_group(pods, topology=topo)
-        assert not groups and len(rest) == 8
+        assert not rest and len(groups) == 3
+        contrib = [g for g in groups if g.topo is not None and g.topo.contrib_h]
+        assert len(contrib) == 1  # the plain group
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        # maxSkew=1 over a shared hostname carry: every spreader entity
+        # allowance is 1 minus the plain pods already counted there, and
+        # spreaders of BOTH shapes share the count
+        spreaders = pods[:6]
+        for claim in results.new_node_claims:
+            n_spread = sum(1 for p in claim.pods if p in spreaders)
+            assert n_spread <= 1
+
+    def test_shared_selector_oracle_pod_still_demotes(self):
+        from helpers import spread_constraint
+        from karpenter_tpu.solver import encode as enc
+
+        # an oracle-routed pod (host ports) matching the shared selector
+        # keeps the whole selection oracle-side: the carry cannot see its
+        # placements
+        app = {"app": "smix2"}
+        spread = [spread_constraint(labels.HOSTNAME, labels=app)]
+        pods = (
+            make_pods(3, cpu="1", memory="1Gi", labels=app, spread=list(spread))
+            + make_pods(2, cpu="3", memory="3Gi", labels=app, host_ports=(9090,))
+        )
+        node_pools, its_by_pool, topo = self._mk(pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 5
         solver = TpuSolver(node_pools, its_by_pool, topo)
         results = solver.solve(pods)
         assert results.all_pods_scheduled()

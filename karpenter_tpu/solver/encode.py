@@ -889,6 +889,17 @@ def build_groups(pods: Sequence[Pod]) -> List[PodGroup]:
     return groups
 
 
+def ffd_sort_key(g: "PodGroup"):
+    """FFD pack-order key over groups: cpu desc, then memory desc
+    (queue.go:76-112). The kernel scan processes groups in this order, and
+    the shared-constraint admission guard in _resolve_topology reasons about
+    pack order with this same key — keep them identical."""
+    return (
+        -g.requests.get(res.CPU, 0),
+        -g.requests.get(res.MEMORY, 0),
+    )
+
+
 def partition_and_group(
     pods: Sequence[Pod],
     topology=None,
@@ -1022,12 +1033,7 @@ def partition_and_group(
         groups, demoted = _resolve_topology(groups, rest, topology)
         rest.extend(demoted)
     # FFD order over groups: cpu desc, then memory desc (queue.go:76-112)
-    groups.sort(
-        key=lambda g: (
-            -g.requests.get(res.CPU, 0),
-            -g.requests.get(res.MEMORY, 0),
-        )
-    )
+    groups.sort(key=ffd_sort_key)
     return groups, rest
 
 
@@ -1321,6 +1327,32 @@ def _resolve_topology(
             if tg.key == labels_mod.HOSTNAME:
                 if tg.type is TopologyType.POD_AFFINITY:
                     return None
+                if tg.type is TopologyType.POD_ANTI_AFFINITY and not plain:
+                    # Required anti-affinity is enforced symmetrically: the
+                    # oracle's inverse gating (topology.go:509-525) blocks
+                    # any SELECTED pod from entities where an owner already
+                    # landed. The kernel gates only owners, so a selected-
+                    # but-ungated placement AFTER an owner could co-locate.
+                    # Admit only when FFD order makes that impossible:
+                    # contributors pack strictly before every owner, and
+                    # self owners strictly before gate owners (gate-owner
+                    # placements are uncounted, so a later self owner would
+                    # not see them in the carry). Ties are rejected — the
+                    # post-sort order of equal keys is build-order-dependent.
+                    def _ffd_key(gi: int):
+                        return ffd_sort_key(groups[gi])
+
+                    if contrib_gis and max(
+                        _ffd_key(gi) for gi in contrib_gis
+                    ) >= min(_ffd_key(gi) for gi in owner_gis):
+                        return None
+                    if (
+                        gate_gis
+                        and self_gis
+                        and max(_ffd_key(gi) for gi in self_gis)
+                        >= min(_ffd_key(gi) for gi in gate_gis)
+                    ):
+                        return None
                 cap = tg.max_skew if tg.type is TopologyType.SPREAD else 1
                 # gate threshold: blocked when the entity's count already
                 # EXCEEDS the allowance (spread: > maxSkew with min 0;

@@ -400,6 +400,115 @@ class TestHostnameTopology:
             assert n_a <= 1
             assert not (n_a and n_b), "anti-affinity pod co-located with blocker"
 
+    def test_cross_group_anti_adverse_order_demotes(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, LabelSelectorRequirement, PodAffinityTerm,
+        )
+        from karpenter_tpu.solver import encode as enc
+
+        # Adverse FFD order: the anti-affinity OWNER group A has larger cpu
+        # and packs first; contributor B packs after and is not gated by the
+        # kernel, so admitting would let B land on A's entities — a placement
+        # the oracle's inverse gating (topology.go:509-525) forbids. The
+        # batch must route to the oracle instead.
+        sel = LabelSelector(
+            match_expressions=[
+                LabelSelectorRequirement(key="app", operator="In", values=("a", "b"))
+            ]
+        )
+        term = PodAffinityTerm(topology_key=labels.HOSTNAME, label_selector=sel)
+        a_pods = make_pods(2, cpu="4", labels={"app": "a"}, pod_anti_affinity=[term])
+        b_pods = make_pods(2, cpu="1", labels={"app": "b"})
+        pods = a_pods + b_pods
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 4  # oracle-routed, not tensorized
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            n_a = sum(1 for p in claim.pods if p in a_pods)
+            n_b = sum(1 for p in claim.pods if p in b_pods)
+            assert n_a <= 1
+            assert not (n_a and n_b), "anti-affinity pod co-located with blocker"
+
+    def test_cross_group_anti_gate_owner_order(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, LabelSelectorRequirement, PodAffinityTerm,
+        )
+        from karpenter_tpu.solver import encode as enc
+
+        # GATE owner: A owns the anti term but is NOT selected by it (the
+        # term selects only app=b). Gate-owner placements are uncounted in
+        # the kernel carry, so a SELECTED group packing after a gate owner
+        # would not see the owner's entities — the oracle's inverse gating
+        # forbids landing there. Adverse order (gate owner cpu larger →
+        # packs first) must demote; safe order (selected group packs first)
+        # stays tensorized.
+        sel = LabelSelector(
+            match_expressions=[
+                LabelSelectorRequirement(key="app", operator="In", values=("b",))
+            ]
+        )
+        term = PodAffinityTerm(topology_key=labels.HOSTNAME, label_selector=sel)
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+
+        # adverse: gate owner A (cpu=4) packs before selected B (cpu=1)
+        a_pods = make_pods(2, cpu="4", labels={"app": "a"}, pod_anti_affinity=[term])
+        b_pods = make_pods(2, cpu="1", labels={"app": "b"})
+        pods = a_pods + b_pods
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 4
+        solver = TpuSolver(node_pools, its_by_pool, topo)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            n_a = sum(1 for p in claim.pods if p in a_pods)
+            n_b = sum(1 for p in claim.pods if p in b_pods)
+            assert not (n_a and n_b), "selected pod co-located with gate owner"
+
+        # safe: selected B (cpu=4) packs before gate owner A (cpu=1)
+        a2 = make_pods(2, cpu="1", labels={"app": "a"}, pod_anti_affinity=[term])
+        b2 = make_pods(2, cpu="4", labels={"app": "b"})
+        pods2 = a2 + b2
+        topo2 = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods2)
+        groups2, rest2 = enc.partition_and_group(pods2, topology=topo2)
+        assert len(groups2) == 2 and not rest2
+        solver2 = TpuSolver(node_pools, its_by_pool, topo2)
+        results2 = solver2.solve(pods2)
+        assert results2.all_pods_scheduled()
+        for claim in results2.new_node_claims:
+            n_a = sum(1 for p in claim.pods if p in a2)
+            n_b = sum(1 for p in claim.pods if p in b2)
+            assert not (n_a and n_b), "selected pod co-located with gate owner"
+
+    def test_cross_group_anti_tie_demotes(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, LabelSelectorRequirement, PodAffinityTerm,
+        )
+        from karpenter_tpu.solver import encode as enc
+
+        # Equal FFD keys: post-sort order of tied groups is build-order-
+        # dependent, so order safety cannot be guaranteed — must demote.
+        sel = LabelSelector(
+            match_expressions=[
+                LabelSelectorRequirement(key="app", operator="In", values=("a", "b"))
+            ]
+        )
+        term = PodAffinityTerm(topology_key=labels.HOSTNAME, label_selector=sel)
+        a_pods = make_pods(2, cpu="2", labels={"app": "a"}, pod_anti_affinity=[term])
+        b_pods = make_pods(2, cpu="2", labels={"app": "b"})
+        pods = a_pods + b_pods
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 4
+
     def test_transitive_demotion(self):
         from karpenter_tpu.api.objects import (
             LabelSelector, LabelSelectorRequirement, PodAffinityTerm,

@@ -94,6 +94,12 @@ def init_backend() -> Tuple[str, bool]:
         )
         jax.config.update("jax_platforms", "cpu")
         fell_back = True
+    # persistent compilation cache: each grid config compiles its own shape
+    # bucket; cache across runs so repeat benches skip straight to execution
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     devs = jax.devices()
     plat = devs[0].platform
     print(f"bench: platform={plat} devices={len(devs)}", file=sys.stderr)

@@ -96,6 +96,7 @@ def solve_core_native(
     has_domains: bool = True,  # trace-time gate for the JAX twin; unused here
     has_contrib: bool = False,  # trace-time gate for the JAX twin; unused here
     tile_feasibility: bool = False,  # JAX execution strategy; unused here
+    wf_iters: int = 32,  # JAX bisection budget; the C++ core is exact
 ) -> Tuple[np.ndarray, ...]:
     """Same contract as ops/solve.py::solve_core (and solve_all), on host.
 

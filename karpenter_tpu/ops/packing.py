@@ -79,7 +79,7 @@ def greedy_prefix_fill(cap, n):
     return jnp.clip(n - before, 0, cap)
 
 
-def waterfill(npods, cap, n):
+def waterfill(npods, cap, n, iters: int = 32):
     """Distribute n pods to slots, always to the least-loaded slot with
     remaining capacity (ties by slot index). Returns fills [NSLOTS] int32.
 
@@ -89,13 +89,20 @@ def waterfill(npods, cap, n):
     solved as: find the smallest water level L with
     f(L) = sum(clip(L - npods, 0, cap)) >= n by bisection, then hand the
     deficit layer out by slot index.
+
+    ``iters`` (static) is the bisection trip count: 32 covers any int32
+    level; the driver passes ceil(log2(level bound)) + 1 when it can prove
+    a tighter per-snapshot bound (each trip is a serial [NSLOTS] reduction,
+    so on a scan-step critical path trimmed trips are real latency). The
+    search range starts at the max level over slots with cap > 0 — dead
+    slots often carry _BIGI sentinels in npods and must not inflate it.
     """
     n = jnp.minimum(n, jnp.sum(cap))
 
     def f(level):
         return jnp.sum(jnp.clip(level - npods, 0, cap))
 
-    hi0 = jnp.max(npods + cap) + 1
+    hi0 = jnp.max(jnp.where(cap > 0, npods + cap, 0)) + 1
 
     def body(_, lo_hi):
         lo, hi = lo_hi
@@ -103,7 +110,9 @@ def waterfill(npods, cap, n):
         ge = f(mid) >= n
         return jnp.where(ge, lo, mid), jnp.where(ge, mid, hi)
 
-    lo, hi = jax.lax.fori_loop(0, 32, body, (jnp.int32(0), hi0.astype(jnp.int32)))
+    lo, hi = jax.lax.fori_loop(
+        0, iters, body, (jnp.int32(0), hi0.astype(jnp.int32))
+    )
     level = hi  # smallest L with f(L) >= n
     base = jnp.clip((level - 1) - npods, 0, cap)
     deficit = n - jnp.sum(base)
@@ -141,7 +150,7 @@ class PackState(NamedTuple):
     jax.jit,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility",
+        "tile_feasibility", "wf_iters",
     ),
 )
 def pack(
@@ -183,9 +192,14 @@ def pack(
     has_domains: bool = True,
     has_contrib: bool = False,
     tile_feasibility: bool = False,
+    wf_iters: int = 32,
 ):
     """Run the grouped-FFD scan. Returns per-group placement matrices and the
     final claim state for decoding.
+
+    ``wf_iters`` (static) bounds every waterfill bisection in the scan; the
+    driver derives it from host-provable level bounds (pods-per-entity
+    capacity, domain priors, group sizes) — see waterfill's docstring.
 
     ``has_domains`` (static) gates the domain-quota machinery: when the host
     proves no group carries a domain-keyed constraint (all g_dmode == 0),
@@ -282,8 +296,7 @@ def pack(
                 cap_row = jnp.zeros((0,), jnp.int32)
             return compat_row, type_ok_row, n_fit_row, cap_row
 
-    def step(state: PackState, xs):
-        (gi,) = xs
+    def _step_body(state: PackState, gi):
         count = g_count[gi]
         req = g_req[gi]
         gdef, gneg, gmask = g_def[gi], g_neg[gi], g_mask[gi]
@@ -448,7 +461,9 @@ def pack(
             scap = jnp.minimum(
                 jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0), count
             )
-            q_spread = waterfill(jnp.where(reg, D0, _BIGI), scap, count)  # [V1]
+            q_spread = waterfill(
+                jnp.where(reg, D0, _BIGI), scap, count, iters=wf_iters
+            )  # [V1]
 
             # AFFINITY bootstrap: all pods pin to ONE viable domain — the
             # first fitting existing node's domain (the oracle walks nodes
@@ -496,7 +511,9 @@ def pack(
             scap_gate = jnp.where(
                 allowed_gate, jnp.minimum(realcap, count), 0
             )
-            q_gate = waterfill(jnp.where(reg, D0, _BIGI), scap_gate, count)
+            q_gate = waterfill(
+                jnp.where(reg, D0, _BIGI), scap_gate, count, iters=wf_iters
+            )
 
             q_dom = jnp.where(
                 mode == DMODE_SPREAD,
@@ -581,7 +598,9 @@ def pack(
         def _tier2_any(_):
             c_slot = jnp.full((nmax,), ANY, jnp.int32)
             claim_cap = _clamp(cap_any)
-            claim_fill = waterfill(state.c_npods, claim_cap, qrem[ANY])
+            claim_fill = waterfill(
+                state.c_npods, claim_cap, qrem[ANY], iters=wf_iters
+            )
             return c_slot, claim_fill, qrem.at[ANY].add(-jnp.sum(claim_fill))
 
         if has_domains:
@@ -613,6 +632,7 @@ def pack(
                         jnp.where(m, state.c_npods, _BIGI),
                         jnp.where(m, claim_cap, 0),
                         slot_budget,
+                        iters=wf_iters,
                     )
 
                 fills_sd = jax.vmap(wf_slot)(
@@ -935,6 +955,23 @@ def pack(
             )
         unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
         return new_state, (exist_fill, claim_fill, unplaced)
+
+    def step(state: PackState, xs):
+        (gi,) = xs
+
+        def _skip(st):
+            return st, (
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((nmax,), jnp.int32),
+                jnp.int32(0),
+            )
+
+        # padded / empty groups place nothing and mutate nothing; branching
+        # them out makes the power-of-two G bucketing cost ~one predicate
+        # per skipped step instead of a full scan-step body
+        return jax.lax.cond(
+            g_count[gi] > 0, lambda st: _step_body(st, gi), _skip, state
+        )
 
     state, (exist_fills, claim_fills, unplaced) = jax.lax.scan(
         step, state, (jnp.arange(G),)

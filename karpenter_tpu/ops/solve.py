@@ -36,6 +36,7 @@ def solve_core(
     has_domains: bool = True,
     has_contrib: bool = False,
     tile_feasibility: bool = False,
+    wf_iters: int = 32,
 ):
     if tile_feasibility:
         # HBM-scaling mode (SURVEY §7.4.6): the packing scan computes each
@@ -91,6 +92,7 @@ def solve_core(
         has_domains=has_domains,
         has_contrib=has_contrib,
         tile_feasibility=tile_feasibility,
+        wf_iters=wf_iters,
     )
     return (
         state.c_pool,
@@ -110,7 +112,7 @@ solve_all = jax.jit(
     solve_core,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility",
+        "tile_feasibility", "wf_iters",
     ),
 )
 
@@ -120,7 +122,7 @@ _BIT_WEIGHTS = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
 
 def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
                       has_domains: bool = True, has_contrib: bool = False,
-                      tile_feasibility: bool = False,
+                      tile_feasibility: bool = False, wf_iters: int = 32,
                       fills_dtype=jnp.int32):
     """solve_core with a wire-compact output layout.
 
@@ -135,7 +137,7 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
      exist_fills, claim_fills, unplaced, c_dzone, c_dct, c_resv) = solve_core(
         *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid,
         has_domains=has_domains, has_contrib=has_contrib,
-        tile_feasibility=tile_feasibility)
+        tile_feasibility=tile_feasibility, wf_iters=wf_iters)
     n, t = c_tmask.shape
     t_pad = -(-t // 8) * 8
     padded = jnp.pad(c_tmask, ((0, 0), (0, t_pad - t))).reshape(n, t_pad // 8, 8)
@@ -158,6 +160,6 @@ solve_all_packed = jax.jit(
     solve_core_packed,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility", "fills_dtype",
+        "tile_feasibility", "wf_iters", "fills_dtype",
     ),
 )

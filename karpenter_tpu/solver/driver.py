@@ -114,6 +114,11 @@ class SolverConfig:
     # "tpu": jitted JAX kernel (ops/solve.py). "native": the C++ host core
     # (native/solve_core.cc) — same contract, no accelerator needed.
     backend: str = "tpu"
+    # multi-chip: a jax.sharding.Mesh (parallel.mesh.make_mesh) to shard the
+    # solve over — groups data-parallel, instance types tensor-parallel —
+    # or "auto" to build one over all local devices when more than one is
+    # present. None = single device. Only meaningful with backend="tpu".
+    mesh: Optional[object] = None
 
 
 @dataclass
@@ -259,6 +264,10 @@ class TpuSolver:
             # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
             # feasibility tables, the scan computes per-group rows instead
             tile_feasibility=P * G * T * 5 > (3 << 29),
+            # waterfill bisection budget: every trip is a serial reduction
+            # on the scan-step critical path, so prove the tightest level
+            # bound the snapshot allows (see _wf_iters)
+            wf_iters=self._wf_iters(snap),
         )
         # bucket the G/N axes to powers of two: repeat solves of nearby
         # shapes (consolidation's binary-search probes, incremental
@@ -275,6 +284,33 @@ class TpuSolver:
 
             def call(nmax):
                 return native.solve_core_native(*args, nmax=nmax, **statics)
+
+        elif self.config.backend == "tpu" and self._resolve_mesh() is not None:
+            # multi-chip: shard the whole solve over the configured mesh
+            # (SURVEY §5 — pjit/shard_map across TPU cores behind the
+            # Solver seam); inputs pad to divide the mesh axes, outputs
+            # come back replicated and decode identically
+            import jax
+
+            from ..parallel.mesh import pad_args_for_mesh, sharded_solve_fn
+
+            mesh = self._resolve_mesh()
+            margs = pad_args_for_mesh(args, mesh)
+
+            def call(nmax):
+                fn = sharded_solve_fn(mesh, nmax=nmax, **statics)
+                with mesh:
+                    out = fn(*margs)
+                (c_pool, c_tmask, n_open, overflow,
+                 exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                 c_resv) = [np.asarray(x) for x in jax.device_get(out)]
+                return (
+                    c_pool.astype(np.int32), c_tmask, n_open, overflow,
+                    exist_fills.astype(np.int32),
+                    claim_fills.astype(np.int32), unplaced,
+                    c_dzone.astype(np.int32), c_dct.astype(np.int32),
+                    c_resv.astype(bool),
+                )
 
         elif self.config.backend == "tpu":
             # imported lazily so backend="native" serves accelerator-less
@@ -333,6 +369,28 @@ class TpuSolver:
             unplaced, c_dzone, c_dct, c_resv,
         )
 
+    def _resolve_mesh(self):
+        """The mesh to shard the solve over, or None for single-device.
+        "auto" builds a ('data', 'model') mesh over all local devices once
+        more than one is present (single-device auto stays on the plain
+        jit path — no GSPMD overhead for nothing)."""
+        m = self.config.mesh
+        if m is None:
+            return None
+        if m == "auto":
+            cached = getattr(self, "_auto_mesh", None)
+            if cached is not None:
+                return cached
+            import jax
+
+            if len(jax.devices()) < 2:
+                return None
+            from ..parallel.mesh import make_mesh
+
+            self._auto_mesh = make_mesh()
+            return self._auto_mesh
+        return m
+
     def _fit_matrix(self, snap: enc.EncodedSnapshot) -> np.ndarray:
         """[G, T] unconstrained pods-per-node fit (inf where a group has no
         positive request). Shared by the NMAX estimate and the fill bound."""
@@ -364,6 +422,42 @@ class TpuSolver:
         capped = np.minimum(best, snap.g_count.astype(np.float64))
         return int(capped.max()) if capped.size else 0
 
+    def _wf_iters(self, snap: enc.EncodedSnapshot) -> int:
+        """Static bisection budget for the kernel's waterfills.
+
+        Every water level the scan can ever probe is bounded by
+        (slot prior) + (slot capacity): claim slots carry at most the
+        pods-per-entity capacity (the "pods" resource column when tracked,
+        else the batch total), domain slots at most the cluster prior plus
+        one group's count. ceil(log2(bound)) + 1 trips pin the bisection;
+        32 is the int32-safe fallback."""
+        total = int(snap.g_count.sum())
+        npods_bound = total
+        if "pods" in snap.resource_names:
+            col = snap.resource_names.index("pods")
+            caps = []
+            if snap.t_cap.size:
+                caps.append(float(np.max(snap.t_cap[:, col])))
+            if snap.n_avail.size:
+                caps.append(float(np.max(snap.n_avail[:, col])))
+            if caps:
+                npods_bound = min(total, int(max(caps)))
+        prior_bound = int(snap.g_dprior.max()) if snap.g_dprior.size else 0
+        # shared-domain carries accumulate other groups' placements into D0
+        # across steps, so the domain level can reach priors + batch total
+        if (snap.g_dtg >= 0).any() or snap.g_dcontrib.any():
+            prior_bound += total
+        count_bound = int(snap.g_count.max()) if snap.g_count.size else 0
+        level_bound = max(npods_bound, prior_bound) + count_bound + 2
+        need = max(1, int(level_bound).bit_length() + 1)
+        # bucket to {8, 16, 32}: wf_iters is a static jit arg, and a raw
+        # bit_length would fork the compile cache on mere pod-count changes
+        # across solves whose bucketed G/N shapes are otherwise identical
+        for bucket in (8, 16, 32):
+            if need <= bucket:
+                return bucket
+        return 32
+
     def _estimate_nmax(self, snap: enc.EncodedSnapshot, fit: np.ndarray) -> int:
         """Host-side claim-count bound: pods per node by the best
         unconstrained fit, clamped by the hostname-topology per-entity cap
@@ -382,14 +476,37 @@ class TpuSolver:
         # the max, not the sum (summing overestimated a 20-deployment
         # hostname-spread mix 30x, quadrupling kernel time). Resource
         # pressure that breaks sharing is caught by the overflow retry.
-        capped = (snap.g_hcap < enc.HCAP_NONE) | (shared_cap < enc.HCAP_NONE)
+        # EXCEPT groups feeding one shared constraint slot: the cap counts
+        # their placements jointly (a cross-shape anti-affinity Deployment
+        # needs one claim per pod across ALL its shape groups), so demand
+        # within a slot sums; distinct slots still share claims.
+        priv_capped = (snap.g_hcap < enc.HCAP_NONE) & ~(
+            snap.g_hself & (snap.g_hstg >= 0)
+        )
+        shared_self = (shared_cap < enc.HCAP_NONE) & (snap.g_hstg >= 0)
+        capped = priv_capped | shared_self
         base = int(per_group[~capped].sum())
-        if capped.any():
-            base += int(per_group[capped].max())
+        demands = []
+        if priv_capped.any():
+            demands.append(per_group[priv_capped].max())
+        for slot in np.unique(snap.g_hstg[shared_self]):
+            demands.append(per_group[shared_self & (snap.g_hstg == slot)].sum())
+        if demands:
+            base += int(max(demands))
         # domain-constrained groups open claims per domain (zonal spread
-        # water-fills across zones), so each may leave one partial claim per
-        # registered domain instead of one overall
-        extra = int(snap.g_dreg[snap.g_dmode > 0].sum()) if len(snap.groups) else 0
+        # water-fills across zones), so each may leave one partial claim
+        # per registered domain it can actually reach (bounded by its pod
+        # count — a 1-pod group never strands more than one partial claim)
+        dyn = snap.g_dmode > 0
+        extra = (
+            int(
+                np.minimum(
+                    snap.g_dreg[dyn].sum(axis=1), snap.g_count[dyn]
+                ).sum()
+            )
+            if len(snap.groups)
+            else 0
+        )
         return enc._next_pow2(
             base + len(snap.groups) + extra + 8,
             floor=8,

@@ -21,6 +21,13 @@ import jax  # noqa: E402
 if os.environ.get("JAX_PLATFORMS", "axon") == "axon":
     jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: the big packing-scan programs take tens of
+# seconds to compile; cache them across test processes
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 

@@ -108,9 +108,17 @@ def snapshot_shardings(mesh) -> Tuple:
     )
 
 
+# jitted sharded programs keyed by (mesh, statics): a jax.jit wrapper owns
+# its own trace cache, so handing the same wrapper back for repeat solves is
+# what makes the driver's mesh path amortize compilation the way the
+# single-device jit does
+_SHARDED_FNS = {}
+
+
 def sharded_solve_fn(
     mesh, nmax: int, zone_kid: int, ct_kid: int, has_domains: bool = True,
-    has_contrib: bool = False,
+    has_contrib: bool = False, tile_feasibility: bool = False,
+    wf_iters: int = 32,
 ):
     """The full solve step jitted over the mesh. Group/type-sharded inputs,
     replicated outputs; XLA/GSPMD inserts the ICI collectives."""
@@ -118,17 +126,101 @@ def sharded_solve_fn(
 
     from ..ops.solve import solve_core
 
-    return jax.jit(
-        partial(
-            solve_core,
-            nmax=nmax,
-            zone_kid=zone_kid,
-            ct_kid=ct_kid,
-            has_domains=has_domains,
-            has_contrib=has_contrib,
-        ),
-        in_shardings=snapshot_shardings(mesh),
-        out_shardings=jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec()
-        ),
+    key = (
+        mesh, nmax, zone_kid, ct_kid, has_domains, has_contrib,
+        tile_feasibility, wf_iters,
+    )
+    fn = _SHARDED_FNS.get(key)
+    if fn is None:
+        fn = _SHARDED_FNS[key] = jax.jit(
+            partial(
+                solve_core,
+                nmax=nmax,
+                zone_kid=zone_kid,
+                ct_kid=ct_kid,
+                has_domains=has_domains,
+                has_contrib=has_contrib,
+                tile_feasibility=tile_feasibility,
+                wf_iters=wf_iters,
+            ),
+            in_shardings=snapshot_shardings(mesh),
+            out_shardings=jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            ),
+        )
+    return fn
+
+
+def pad_args_for_mesh(args, mesh):
+    """Pad solve_core's argument tuple (EncodedSnapshot.solve_args order) so
+    the sharded axes divide the mesh: the G axis (groups and the [*, G]
+    tables) to a multiple of 'data', the T axis (types, offerings,
+    availability) to a multiple of 'model'. Padded groups have count 0 (the
+    kernel's skip-step branch retires them); padded types stay infeasible
+    (p_titype_ok False, no offerings), so results are unchanged."""
+    data = mesh.devices.shape[0]
+    model = mesh.devices.shape[1]
+    (
+        g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+        g_hstg, g_hscap, g_dtg,
+        g_hself, g_hcontrib, g_dcontrib,
+        p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
+        p_titype_ok,
+        t_def, t_mask, t_alloc, t_cap,
+        o_avail, o_zone, o_ct, a_tzc, res_cap0, a_res,
+        n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
+        nh_cnt0, dd0, dtg_key,
+        well_known,
+    ) = args
+
+    def pad_axis(arr, axis, mult, fill=0):
+        size = arr.shape[axis]
+        target = ((size + mult - 1) // mult) * mult
+        if target == size:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, target - size)
+        return np.pad(arr, widths, constant_values=fill)
+
+    g_count = pad_axis(g_count, 0, data)  # padded groups have count 0
+    g_req = pad_axis(g_req, 0, data)
+    g_def = pad_axis(g_def, 0, data)
+    g_neg = pad_axis(g_neg, 0, data)
+    g_mask = pad_axis(g_mask, 0, data, fill=1)
+    g_hcap = pad_axis(g_hcap, 0, data)  # count-0 pads never place anyway
+    for_g = lambda a: pad_axis(a, 0, data)
+    g_dmode, g_dkey, g_dskew, g_dmin0 = map(
+        for_g, (g_dmode, g_dkey, g_dskew, g_dmin0)
+    )
+    g_dprior, g_dreg, g_drank = map(for_g, (g_dprior, g_dreg, g_drank))
+    # slot ids pad with -1 (0 is a real slot); caps pad with the no-cap value
+    g_hstg = pad_axis(g_hstg, 0, data, fill=-1)
+    g_dtg = pad_axis(g_dtg, 0, data, fill=-1)
+    g_hscap = pad_axis(g_hscap, 0, data, fill=2**30)
+    g_hself = pad_axis(g_hself, 0, data, fill=1)
+    g_hcontrib = pad_axis(g_hcontrib, 0, data)
+    g_dcontrib = pad_axis(g_dcontrib, 0, data)
+    p_tol = pad_axis(p_tol, 1, data)
+    n_tol = pad_axis(n_tol, 1, data)
+    n_hcnt = pad_axis(n_hcnt, 1, data)
+
+    for_t = lambda a: pad_axis(a, 0, model)
+    t_def, t_mask, t_alloc, t_cap = map(for_t, (t_def, t_mask, t_alloc, t_cap))
+    o_avail, o_zone, o_ct, a_tzc = map(for_t, (o_avail, o_zone, o_ct, a_tzc))
+    a_res = pad_axis(a_res, 1, model)  # padded types have no reservations
+    p_titype_ok = pad_axis(p_titype_ok, 1, model)  # padded types stay infeasible
+
+    return (
+        g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+        g_hstg, g_hscap, g_dtg,
+        g_hself, g_hcontrib, g_dcontrib,
+        p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
+        p_titype_ok,
+        t_def, t_mask, t_alloc, t_cap,
+        o_avail, o_zone, o_ct, a_tzc, res_cap0, a_res,
+        n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
+        nh_cnt0, dd0, dtg_key,
+        well_known,
     )

@@ -4,7 +4,7 @@ import jax
 import numpy as np
 import pytest
 
-from karpenter_tpu.parallel.mesh import make_mesh, sharded_solve_fn
+from karpenter_tpu.parallel.mesh import make_mesh, pad_args_for_mesh, sharded_solve_fn
 from karpenter_tpu.ops.solve import solve_all
 
 
@@ -48,11 +48,9 @@ class TestMesh:
             np.testing.assert_array_equal(a, b[: a.shape[0]], err_msg=name)
 
     def test_sharded_matches_single_device(self, mesh):
-        import __graft_entry__ as graft
-
         args, statics = _example()
         single = solve_all(*args, **statics)
-        padded = graft._pad_for_mesh(args, mesh)
+        padded = pad_args_for_mesh(args, mesh)
         fn = sharded_solve_fn(mesh, **statics)
         with mesh:
             sharded = fn(*padded)
@@ -61,8 +59,6 @@ class TestMesh:
     def test_sharded_matches_single_device_many_groups(self, mesh):
         """G far beyond the data axis (hundreds of groups over data=2):
         every output must still match the single-device program exactly."""
-        import __graft_entry__ as graft
-
         from karpenter_tpu.api import resources as res
         from karpenter_tpu.api.objects import ObjectMeta, Pod, PodSpec
         from karpenter_tpu.cloudprovider import corpus
@@ -107,11 +103,61 @@ class TestMesh:
         G = args[0].shape[0]
         assert G >= 300
         single = solve_all(*args, **statics)
-        padded = graft._pad_for_mesh(args, mesh)
+        padded = pad_args_for_mesh(args, mesh)
         fn = sharded_solve_fn(mesh, **statics)
         with mesh:
             sharded = fn(*padded)
         self._assert_full_equality(single, sharded, G)
+
+    def test_driver_mesh_matches_single_device(self, mesh):
+        """THROUGH THE DRIVER: TpuSolver with SolverConfig(mesh=...) must
+        produce identical Results (claims, pods, types, requirements,
+        errors) to the single-device TpuSolver, at G >> data axis."""
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+        from karpenter_tpu.solver.driver import SolverConfig
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import constrained_mix
+
+        # constrained mix: zonal + hostname spread ride the domain-quota
+        # and per-entity-cap kernel paths under GSPMD
+        pods = constrained_mix(600)
+        pools = [example_nodepool()]
+        its_by_pool = {p.name: corpus.generate(24) for p in pools}
+
+        def solve(cfg):
+            topology = Topology(
+                Client(TestClock()), [], pools, its_by_pool, pods
+            )
+            return TpuSolver(
+                pools, its_by_pool, topology, config=cfg
+            ).solve(pods)
+
+        single = solve(SolverConfig())
+        sharded = solve(SolverConfig(mesh=mesh))
+        assert not single.pod_errors and not sharded.pod_errors
+        assert single.node_count() == sharded.node_count()
+        a = sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(t.name for t in c.instance_type_options)),
+                repr(sorted(c.requirements.keys())),
+            )
+            for c in single.new_node_claims
+        )
+        b = sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(t.name for t in c.instance_type_options)),
+                repr(sorted(c.requirements.keys())),
+            )
+            for c in sharded.new_node_claims
+        )
+        assert a == b
 
     def test_dryrun_entrypoint(self, mesh):
         import __graft_entry__ as graft

@@ -113,6 +113,22 @@ class VolumeUsage:
             ):
                 vols.discard(vid)
 
+    def snapshot(self) -> Dict[str, List[Tuple[str, str]]]:
+        """Wire-portable form: pod uid -> [(driver, volume id)]. The
+        per-driver volume sets are derivable, so only the pod map ships."""
+        return {uid: list(pairs) for uid, pairs in self._pod_volumes.items()}
+
+    @classmethod
+    def from_snapshot(cls, snap) -> "VolumeUsage":
+        vu = cls()
+        for uid, pairs in (snap or {}).items():
+            counted = [(d, v) for d, v in pairs]
+            vu._pod_volumes[uid] = counted
+            for d, v in counted:
+                if d:
+                    vu._volumes.setdefault(d, set()).add(v)
+        return vu
+
     def validate(self, resolved: Sequence, limits: Dict[str, int]) -> Optional[str]:
         """Error string if adding ``resolved`` would exceed any driver's
         attach limit (volumeusage.go exceedsLimits)."""

@@ -16,6 +16,7 @@ wire.py — no generated stubs, one method:
 from __future__ import annotations
 
 from concurrent import futures
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 import grpc
@@ -41,16 +42,29 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
     node_pools: List[NodePool] = snap["node_pools"]
     instance_types = snap["instance_types"]
     daemonset_pods = snap["daemonset_pods"]
-    # the sidecar solves against an empty cluster view: existing-node
-    # placement stays with the controller, which holds the live state cache
+    state_nodes = snap["state_nodes"]
+    # rebuild the controller's cluster view: state nodes pack FIRST
+    # (scheduler.go:357-425), their bound pods feed the topology priors and
+    # inverse anti-affinity gates, and PVC/PV/StorageClass objects let the
+    # VolumeResolver answer identically — so the scratch client holds them
     scratch = Client(TestClock())
-    topology = Topology(scratch, [], node_pools, instance_types, pods)
+    for sn in state_nodes:
+        if sn.node is not None:
+            scratch.create(sn.node)
+        for p in sn.pods:
+            scratch.create(p)
+    for vo in snap["volume_objects"]:
+        scratch.create(vo)
+    topology = Topology(scratch, state_nodes, node_pools, instance_types, pods)
+    from ..scheduling.volumeusage import VolumeResolver
+
     solver = TpuSolver(
         node_pools,
         instance_types,
         topology,
-        state_nodes=[],
+        state_nodes=state_nodes,
         daemonset_pods=daemonset_pods,
+        volume_resolver=VolumeResolver(scratch),
         config=config,
         # catalog encode amortizes across requests; the cache's lock
         # serializes the host-side encode under the gRPC thread pool
@@ -62,7 +76,9 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
         ),
     )
     results = solver.solve(pods)
-    return wire.encode_solve_response(results)
+    return wire.encode_solve_response(
+        results, state_nodes_packed=len(state_nodes)
+    )
 
 
 class SolverService(grpc.GenericRpcHandler):
@@ -96,10 +112,25 @@ def serve(
     return server
 
 
+@dataclass
+class RemoteExistingNode:
+    """Existing-node placement reassembled from the sidecar's response.
+    Duck-types the surface Results consumers read (provisioning.py:262:
+    .name for nomination, .pods for events)."""
+
+    name: str
+    pods: List[Pod]
+
+
 class RemoteSolver:
     """Client-side seam: same solve(pods) contract as TpuSolver, but the
     packing runs in the sidecar. Claims come back as instance-type names and
-    pod uids and are reassembled against the local objects."""
+    pod uids and are reassembled against the local objects. Pass the
+    cluster's StateNodes (``state_nodes``) so the sidecar packs existing
+    capacity first exactly like the in-process solve — without them a
+    non-empty cluster over-provisions every batch. Pass the PVC/PV/
+    StorageClass objects pending pods reference (``volume_objects``) so
+    CSI attach-limit checks match too."""
 
     def __init__(
         self,
@@ -110,6 +141,8 @@ class RemoteSolver:
         channel: Optional["grpc.Channel"] = None,
         timeout: float = 30.0,
         reserved_capacity_enabled: bool = False,
+        state_nodes: Sequence = (),
+        volume_objects: Sequence = (),
     ):
         self._channel = channel or grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(SOLVE_METHOD)
@@ -118,6 +151,8 @@ class RemoteSolver:
         self.node_pools = list(node_pools)
         self.instance_types = instance_types
         self.daemonset_pods = list(daemonset_pods)
+        self.state_nodes = list(state_nodes)
+        self.volume_objects = list(volume_objects)
         self._pools_by_name = {np_.name: np_ for np_ in self.node_pools}
         self._types_by_pool = {
             pool: {it.name: it for it in its}
@@ -135,10 +170,23 @@ class RemoteSolver:
             solver_options={
                 "reserved_capacity_enabled": self.reserved_capacity_enabled
             },
+            state_nodes=self.state_nodes,
+            volume_objects=self.volume_objects,
         )
         response = wire.decode_solve_response(
             self._solve(request, timeout=self.timeout)
         )
+        if self.state_nodes and response.get("state_nodes_packed") != len(
+            self.state_nodes
+        ):
+            # a sidecar speaking an older protocol drops unknown request
+            # keys: solving against an empty cluster view would silently
+            # over-provision — fail as loudly as catalog skew does below
+            raise RuntimeError(
+                f"sent {len(self.state_nodes)} state nodes but the solver "
+                f"acknowledged {response.get('state_nodes_packed', 0)} — "
+                "controller/sidecar wire protocol versions are out of sync"
+            )
         pods_by_uid = {p.uid: p for p in pods}
         claims: List[DecodedClaim] = []
         for c in response["claims"]:
@@ -167,9 +215,16 @@ class RemoteSolver:
                     requirements=c["requirements"],
                 )
             )
+        existing = [
+            RemoteExistingNode(
+                name=e["name"],
+                pods=[pods_by_uid[u] for u in e["pod_uids"]],
+            )
+            for e in response.get("existing", [])
+        ]
         return Results(
             new_node_claims=claims,
-            existing_nodes=[],
+            existing_nodes=existing,
             pod_errors=dict(response["pod_errors"]),
         )
 
@@ -177,4 +232,7 @@ class RemoteSolver:
         self._channel.close()
 
 
-__all__ = ["SOLVE_METHOD", "SolverService", "serve", "RemoteSolver"]
+__all__ = [
+    "SOLVE_METHOD", "SolverService", "serve", "RemoteSolver",
+    "RemoteExistingNode",
+]

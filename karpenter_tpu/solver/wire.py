@@ -57,6 +57,14 @@ _CLASSES = {
         obj.NodePoolStatus,
         obj.NodePool,
         obj.DaemonSet,
+        obj.Node,
+        obj.NodeStatus,
+        obj.NodeClaim,
+        obj.NodeClaimStatus,
+        obj.Condition,
+        obj.PersistentVolumeClaim,
+        obj.PersistentVolume,
+        obj.StorageClass,
         cp.Offering,
         cp.InstanceTypeOverhead,
         cp.InstanceType,
@@ -125,6 +133,46 @@ def from_wire(value: Any) -> Any:
     return value
 
 
+# -- state-node snapshots ---------------------------------------------------
+
+
+def encode_state_node(sn) -> Dict[str, Any]:
+    """StateNode → wire: the merged Node/NodeClaim objects, the node's bound
+    pods (with which of them are daemons), and the usage surfaces the
+    scheduler's ExistingNode model reads. The sidecar reconstructs a
+    StateNode that answers labels()/taints()/available()/hostport_usage
+    identically, so existing-capacity packing matches the controller's
+    in-process solve (scheduler.go:357-425 packs existing nodes FIRST)."""
+    return {
+        "node": to_wire(sn.node),
+        "node_claim": to_wire(sn.node_claim),
+        "pods": [to_wire(p) for p in sn.pods],
+        "daemon_uids": sorted(sn.daemonset_requests),
+        "volume_limits": dict(sn.volume_limits),
+        "volume_usage": sn.volume_usage.snapshot(),
+        "mark_for_deletion": bool(sn.mark_for_deletion),
+        "nominated_until": float(sn.nominated_until),
+    }
+
+
+def decode_state_node(raw: Dict[str, Any]):
+    from ..controllers.state import StateNode
+
+    sn = StateNode(
+        node=from_wire(raw["node"]), node_claim=from_wire(raw["node_claim"])
+    )
+    from ..scheduling.volumeusage import VolumeUsage
+
+    sn.volume_limits = dict(raw.get("volume_limits") or {})
+    sn.volume_usage = VolumeUsage.from_snapshot(raw.get("volume_usage"))
+    sn.mark_for_deletion = raw.get("mark_for_deletion", False)
+    sn.nominated_until = raw.get("nominated_until", 0.0)
+    daemons = set(raw.get("daemon_uids", ()))
+    for p in (from_wire(x) for x in raw.get("pods", [])):
+        sn.update_pod(p, is_daemon=p.uid in daemons)
+    return sn
+
+
 # -- snapshot / result envelopes -------------------------------------------
 
 
@@ -134,9 +182,14 @@ def encode_solve_request(
     instance_types: Dict[str, List[cp.InstanceType]],
     daemonset_pods=(),
     solver_options: Optional[Dict[str, Any]] = None,
+    state_nodes=(),
+    volume_objects=(),
 ) -> bytes:
     """solver_options carries behavior knobs (feature gates) that must match
-    between controller and sidecar — e.g. reserved_capacity_enabled."""
+    between controller and sidecar — e.g. reserved_capacity_enabled.
+    ``volume_objects`` are the PVC/PV/StorageClass objects pending pods
+    reference, so the sidecar's VolumeResolver answers identically to the
+    controller's (volumeusage.go resolveDriver/VolumeName)."""
     return msgpack.packb(
         {
             "pods": [to_wire(p) for p in pods],
@@ -147,6 +200,8 @@ def encode_solve_request(
             },
             "daemonset_pods": [to_wire(p) for p in daemonset_pods],
             "solver_options": dict(solver_options or {}),
+            "state_nodes": [encode_state_node(sn) for sn in state_nodes],
+            "volume_objects": [to_wire(o) for o in volume_objects],
         },
         use_bin_type=True,
     )
@@ -163,12 +218,22 @@ def decode_solve_request(data: bytes) -> Dict[str, Any]:
         },
         "daemonset_pods": [from_wire(p) for p in raw.get("daemonset_pods", [])],
         "solver_options": raw.get("solver_options", {}),
+        "state_nodes": [
+            decode_state_node(sn) for sn in raw.get("state_nodes", [])
+        ],
+        "volume_objects": [
+            from_wire(o) for o in raw.get("volume_objects", [])
+        ],
     }
 
 
-def encode_solve_response(results) -> bytes:
+def encode_solve_response(results, state_nodes_packed: int = 0) -> bytes:
     """Results → wire. Claims reference instance types by name and pods by
-    uid; the caller reassembles against its own objects."""
+    uid; the caller reassembles against its own objects. Existing-node
+    placements travel as (node name, newly placed pod uids);
+    ``state_nodes_packed`` acknowledges how many shipped state nodes the
+    solve actually packed against, so a client that sent state nodes can
+    fail fast against a sidecar that silently dropped them."""
     claims = []
     for claim in results.new_node_claims:
         claims.append(
@@ -179,9 +244,15 @@ def encode_solve_response(results) -> bytes:
                 "requirements": to_wire(claim.requirements),
             }
         )
+    existing = [
+        {"name": en.name, "pod_uids": [p.uid for p in en.pods]}
+        for en in results.existing_nodes
+    ]
     return msgpack.packb(
         {
             "claims": claims,
+            "existing": existing,
+            "state_nodes_packed": int(state_nodes_packed),
             "pod_errors": {uid: str(err) for uid, err in results.pod_errors.items()},
         },
         use_bin_type=True,

@@ -53,6 +53,7 @@ def make_pod(
     node_name: str = "",
     phase: str = "Pending",
     host_ports: Sequence[int] = (),
+    volumes: Sequence = (),
 ) -> Pod:
     i = next(_seq)
     requests = {"cpu": res.parse_quantity(cpu), "memory": res.parse_quantity(memory)}
@@ -76,6 +77,7 @@ def make_pod(
             pod_anti_affinity=list(pod_anti_affinity),
             node_name=node_name,
             host_ports=_as_host_ports(host_ports),
+            volumes=list(volumes),
         ),
     )
     pod.status.phase = phase

@@ -128,6 +128,210 @@ class TestSolverService:
         assert not results.new_node_claims
         remote.close()
 
+    def test_remote_matches_local_with_existing_nodes(self, sidecar):
+        """RemoteSolver ≡ in-process TpuSolver on a NON-EMPTY cluster: the
+        sidecar must pack onto shipped state nodes first (scheduler.go:
+        357-425) instead of opening fresh claims for everything."""
+        from karpenter_tpu.api import labels as labels_mod
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.controllers.state import StateNode
+        from karpenter_tpu.solver import TpuSolver
+
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(12)}
+
+        def build_state_nodes():
+            sns = []
+            for i in range(3):
+                node = Node(
+                    metadata=ObjectMeta(
+                        name=f"existing-{i}",
+                        labels={
+                            labels_mod.TOPOLOGY_ZONE: "test-zone-a",
+                            labels_mod.HOSTNAME: f"existing-{i}",
+                            labels_mod.NODEPOOL_LABEL_KEY: "default",
+                        },
+                    ),
+                )
+                node.status.capacity = {
+                    "cpu": res.parse_quantity("16"),
+                    "memory": res.parse_quantity("64Gi"),
+                    "pods": res.parse_quantity("110"),
+                }
+                node.status.allocatable = dict(node.status.capacity)
+                node.status.ready = True
+                sn = StateNode(node=node)
+                # partially filled: a bound pod consumes half the cpu
+                bound = make_pod(
+                    cpu="8", memory="8Gi", node_name=f"existing-{i}",
+                    phase="Running",
+                )
+                sn.update_pod(bound, is_daemon=False)
+                sns.append(sn)
+            return sns
+
+        pods = make_pods(40, cpu="1", memory="1Gi")
+
+        remote_sns = build_state_nodes()
+        remote = RemoteSolver(sidecar, pools, types, state_nodes=remote_sns)
+        got = remote.solve(pods)
+
+        local_sns = build_state_nodes()
+        client = Client(TestClock())
+        for sn in local_sns:
+            client.create(sn.node)
+            for p in sn.pods:
+                client.create(p)
+        topology = Topology(client, local_sns, pools, types, pods)
+        want = TpuSolver(
+            pools, types, topology, state_nodes=local_sns
+        ).solve(pods)
+
+        assert not got.pod_errors and not want.pod_errors
+        # existing nodes absorb pods before any claim opens, identically
+        got_exist = sorted(
+            (e.name, sorted(p.uid for p in e.pods))
+            for e in got.existing_nodes
+        )
+        want_exist = sorted(
+            (e.name, sorted(p.uid for p in e.pods))
+            for e in want.existing_nodes
+        )
+        assert got_exist == want_exist
+        assert any(pods_ for _, pods_ in got_exist), (
+            "existing nodes took no pods — the remote seam dropped them"
+        )
+        assert len(got.new_node_claims) == len(want.new_node_claims)
+        got_counts = sorted(len(c.pods) for c in got.new_node_claims)
+        want_counts = sorted(len(c.pods) for c in want.new_node_claims)
+        assert got_counts == want_counts
+        remote.close()
+
+    def test_remote_honors_csi_attach_limits(self, sidecar):
+        """A node at its CSI attach limit must refuse volume-bearing pods
+        remotely exactly as in-process: volume_usage travels with the state
+        node and PVC/PV objects travel so the sidecar resolver answers
+        identically (volumeusage.go exceedsLimits)."""
+        from karpenter_tpu.api import labels as labels_mod
+        from karpenter_tpu.api.objects import (
+            Node, ObjectMeta, PersistentVolume, PersistentVolumeClaim,
+            PersistentVolumeClaimRef,
+        )
+        from karpenter_tpu.controllers.state import StateNode
+        from karpenter_tpu.scheduling.volumeusage import VolumeResolver
+        from karpenter_tpu.solver import TpuSolver
+
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(12)}
+        driver = "csi.example.com"
+
+        def build():
+            node = Node(
+                metadata=ObjectMeta(
+                    name="vol-node",
+                    labels={
+                        labels_mod.HOSTNAME: "vol-node",
+                        labels_mod.NODEPOOL_LABEL_KEY: "default",
+                    },
+                ),
+            )
+            node.status.capacity = {
+                "cpu": res.parse_quantity("32"),
+                "memory": res.parse_quantity("64Gi"),
+                "pods": res.parse_quantity("110"),
+            }
+            node.status.allocatable = dict(node.status.capacity)
+            node.status.ready = True
+            sn = StateNode(node=node)
+            sn.volume_limits = {driver: 1}  # one attachment, already used
+            bound = make_pod(
+                cpu="1", node_name="vol-node", phase="Running",
+                volumes=[PersistentVolumeClaimRef(claim_name="used")],
+            )
+            sn.update_pod(
+                bound, is_daemon=False,
+                resolved_volumes=[(driver, "pv-used", ())],
+            )
+            return sn
+
+        pvc = PersistentVolumeClaim(
+            metadata=ObjectMeta(name="fresh", namespace="default"),
+            volume_name="pv-fresh",
+        )
+        pv = PersistentVolume(
+            metadata=ObjectMeta(name="pv-fresh"), driver=driver
+        )
+        pod = make_pod(
+            cpu="1",
+            volumes=[PersistentVolumeClaimRef(claim_name="fresh")],
+        )
+
+        remote_sn = build()
+        remote = RemoteSolver(
+            sidecar, pools, types,
+            state_nodes=[remote_sn], volume_objects=[pvc, pv],
+        )
+        got = remote.solve([pod])
+
+        local_sn = build()
+        client = Client(TestClock())
+        client.create(local_sn.node)
+        for p in local_sn.pods:
+            client.create(p)
+        client.create(pvc)
+        client.create(pv)
+        topology = Topology(client, [local_sn], pools, types, [pod])
+        want = TpuSolver(
+            pools, types, topology, state_nodes=[local_sn],
+            volume_resolver=VolumeResolver(client),
+        ).solve([pod])
+
+        # the node is attach-limited: both paths must open a fresh claim
+        # instead of placing onto it
+        for res_ in (got, want):
+            assert not res_.pod_errors
+            assert len(res_.new_node_claims) == 1
+            assert not any(e.pods for e in res_.existing_nodes)
+        remote.close()
+
+    def test_state_node_round_trip(self):
+        from karpenter_tpu.api import labels as labels_mod
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.controllers.state import StateNode
+
+        node = Node(
+            metadata=ObjectMeta(
+                name="sn-1",
+                labels={labels_mod.HOSTNAME: "sn-1"},
+            ),
+        )
+        node.status.capacity = {"cpu": res.parse_quantity("8")}
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        sn = StateNode(node=node)
+        daemon = make_pod(cpu="1", node_name="sn-1", phase="Running")
+        workload = make_pod(
+            cpu="2", node_name="sn-1", phase="Running", host_ports=(8080,)
+        )
+        sn.update_pod(daemon, is_daemon=True)
+        sn.update_pod(workload, is_daemon=False)
+        sn.volume_limits = {"csi.example.com": 16}
+        sn.mark_for_deletion = True
+        back = wire.decode_state_node(wire.encode_state_node(sn))
+        assert back.name == "sn-1"
+        assert back.labels() == sn.labels()
+        assert back.available() == sn.available()
+        assert sorted(p.uid for p in back.pods) == sorted(
+            p.uid for p in sn.pods
+        )
+        assert set(back.daemonset_requests) == {daemon.uid}
+        assert back.volume_limits == {"csi.example.com": 16}
+        assert back.mark_for_deletion is True
+        # host-port usage traveled: a new pod on the same port must conflict
+        assert back.hostport_usage.conflicts(
+            make_pod(host_ports=(8080,))
+        )
+
     def test_constrained_pods(self, sidecar):
         pools = [make_nodepool(name="default")]
         types = {"default": corpus.generate(12)}

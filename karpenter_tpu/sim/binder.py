@@ -45,16 +45,32 @@ class Binder:
             for n in nodes
         }
         volume_usage = self._build_volume_usage(nodes, all_pods)
+        nodes_by_name = {n.name: n for n in nodes}
+        placements = [
+            (p, nodes_by_name[p.spec.node_name])
+            for p in all_pods
+            if p.spec.node_name in nodes_by_name and pod_utils.is_active(p)
+        ]
+        # only placements with anti-affinity terms can repel new pods; keep
+        # the inverse-anti scan off the O(pods x nodes) hot path
+        anti_placements = [
+            (p, n) for p, n in placements if p.spec.pod_anti_affinity
+        ]
         for pod in all_pods:
             if not pod_utils.is_provisionable(pod):
                 continue
-            node = self._find_node(pod, nodes, used, volume_usage)
+            node = self._find_node(
+                pod, nodes, used, volume_usage, placements, anti_placements
+            )
             if node is not None:
                 pod.spec.node_name = node.name
                 used[node.name] = res.merge(used[node.name], pod.spec.requests)
                 if pod.spec.volumes:
                     resolved, _ = self.volume_topology.resolver.resolve(pod)
                     volume_usage.setdefault(node.name, VolumeUsage()).add(pod, resolved)
+                placements.append((pod, node))
+                if pod.spec.pod_anti_affinity:
+                    anti_placements.append((pod, node))
                 self.client.update(pod)
                 bound.append(pod)
         return bound
@@ -68,13 +84,22 @@ class Binder:
         return usage
 
     def _find_node(
-        self, pod: Pod, nodes: List[Node], used, volume_usage
+        self,
+        pod: Pod,
+        nodes: List[Node],
+        used,
+        volume_usage,
+        placements=(),
+        anti_placements=(),
     ) -> Optional[Node]:
         # the kube-scheduler's volume plugins see zonal PV constraints and
         # CSI attach limits; mirror both so sim bindings match provisioning
         if pod.spec.volumes:
             pod = copy.deepcopy(pod)
             self.volume_topology.inject(pod)
+        topo_ctx = self._topology_ctx(pod, nodes, placements)
+        if topo_ctx is None:
+            return None  # unsatisfiable required affinity: stays pending
         for node in nodes:
             if node.unschedulable or not node.status.ready:
                 continue
@@ -88,8 +113,119 @@ class Binder:
                 continue
             if pod.spec.volumes and not self._volumes_fit(pod, node, volume_usage):
                 continue
+            if not self._topology_ok(pod, node, topo_ctx, anti_placements):
+                continue
             return node
         return None
+
+    @staticmethod
+    def _term_ns(term, owner_ns):
+        return set(term.namespaces) if term.namespaces else {owner_ns}
+
+    def _topology_ctx(self, pod: Pod, nodes, placements):
+        """Node-independent part of the topology filters, computed once per
+        pod: spread counts per constraint and admissible domains per
+        required-affinity term. Returns None when a required affinity can
+        never be satisfied (non-self-selecting with no matching pod — the
+        solver refuses the same shape, topology.go:277-324)."""
+        ns = pod.metadata.namespace
+        spread = []
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            key = tsc.topology_key
+            counts = {}
+            for n2 in nodes:
+                d2 = n2.metadata.labels.get(key)
+                if d2 is not None:
+                    counts.setdefault(d2, 0)
+            for p2, n2 in placements:
+                d2 = n2.metadata.labels.get(key)
+                if (
+                    d2 is not None
+                    and p2.metadata.namespace == ns
+                    and tsc.label_selector is not None
+                    and tsc.label_selector.matches(p2.metadata.labels)
+                ):
+                    counts[d2] += 1
+            spread.append((key, tsc.max_skew, counts))
+        aff_domains = []  # (key, allowed domain set or None for any)
+        for term in pod.spec.pod_affinity:
+            key = term.topology_key
+            matching = {
+                n2.metadata.labels.get(key)
+                for p2, n2 in placements
+                if p2.metadata.namespace in self._term_ns(term, ns)
+                and term.label_selector is not None
+                and term.label_selector.matches(p2.metadata.labels)
+                and n2.metadata.labels.get(key) is not None
+            }
+            if matching:
+                aff_domains.append((key, matching))
+            else:
+                # bootstrap only for a SELF-selecting pod (kube-scheduler
+                # and topology.go:277-324's nextDomainAffinity agree): a
+                # required affinity on pods that don't exist and never
+                # will (the pod doesn't select itself) cannot bind
+                if not (
+                    ns in self._term_ns(term, ns)
+                    and term.label_selector is not None
+                    and term.label_selector.matches(pod.metadata.labels)
+                ):
+                    return None
+                aff_domains.append((key, None))
+        anti_blocked = []  # (key, domains holding a matching pod)
+        for term in pod.spec.pod_anti_affinity:
+            key = term.topology_key
+            blocked = {
+                n2.metadata.labels.get(key)
+                for p2, n2 in placements
+                if p2.metadata.namespace in self._term_ns(term, ns)
+                and term.label_selector is not None
+                and term.label_selector.matches(p2.metadata.labels)
+                and n2.metadata.labels.get(key) is not None
+            }
+            anti_blocked.append((key, blocked))
+        return ns, spread, aff_domains, anti_blocked
+
+    def _topology_ok(self, pod: Pod, node: Node, ctx, anti_placements) -> bool:
+        """The kube-scheduler's PodTopologySpread + InterPodAffinity
+        filters for one candidate node: DoNotSchedule spread keeps skew
+        <= maxSkew, required pod affinity needs a matching pod in the
+        node's domain (self-selecting bootstrap aside), required
+        anti-affinity is enforced in BOTH directions (a bound pod's anti
+        terms also repel the new pod)."""
+        ns, spread, aff_domains, anti_blocked = ctx
+        labels = node.metadata.labels
+        for key, max_skew, counts in spread:
+            dom = labels.get(key)
+            if dom is None:
+                return False
+            if counts.get(dom, 0) + 1 - min(counts.values()) > max_skew:
+                return False
+        for key, allowed in aff_domains:
+            dom = labels.get(key)
+            if dom is None:
+                return False
+            if allowed is not None and dom not in allowed:
+                return False
+        for key, blocked in anti_blocked:
+            dom = labels.get(key)
+            if dom is not None and dom in blocked:
+                return False
+        for p2, n2 in anti_placements:
+            for term in p2.spec.pod_anti_affinity:
+                key = term.topology_key
+                d2 = n2.metadata.labels.get(key)
+                if (
+                    d2 is not None
+                    and d2 == labels.get(key)
+                    and ns in self._term_ns(term, p2.metadata.namespace)
+                    and term.label_selector is not None
+                    and term.label_selector.matches(pod.metadata.labels)
+                ):
+                    return False
+        return True
 
     def _volumes_fit(self, pod: Pod, node: Node, volume_usage) -> bool:
         csinode = self.client.try_get(CSINode, node.name)

@@ -53,18 +53,25 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
             scratch.create(sn.node)
         for p in sn.pods:
             scratch.create(p)
-    for vo in snap["volume_objects"]:
+    for vo in snap["volume_objects"] or ():
         scratch.create(vo)
     topology = Topology(scratch, state_nodes, node_pools, instance_types, pods)
     from ..scheduling.volumeusage import VolumeResolver
 
+    # clients predating the volume protocol (volume_objects is None, not
+    # []) never ship PVC/PV objects; resolving against the empty scratch
+    # store would fail every PVC-bearing pod, so keep the old no-resolver
+    # behavior for them
+    resolver = (
+        VolumeResolver(scratch) if snap["volume_objects"] is not None else None
+    )
     solver = TpuSolver(
         node_pools,
         instance_types,
         topology,
         state_nodes=state_nodes,
         daemonset_pods=daemonset_pods,
-        volume_resolver=VolumeResolver(scratch),
+        volume_resolver=resolver,
         config=config,
         # catalog encode amortizes across requests; the cache's lock
         # serializes the host-side encode under the gRPC thread pool

@@ -221,9 +221,14 @@ def decode_solve_request(data: bytes) -> Dict[str, Any]:
         "state_nodes": [
             decode_state_node(sn) for sn in raw.get("state_nodes", [])
         ],
-        "volume_objects": [
-            from_wire(o) for o in raw.get("volume_objects", [])
-        ],
+        # None (vs []) marks a client that predates the volume protocol;
+        # the sidecar then skips PVC resolution rather than failing every
+        # PVC-bearing pod with "not found" against its empty scratch store
+        "volume_objects": (
+            [from_wire(o) for o in raw["volume_objects"]]
+            if "volume_objects" in raw
+            else None
+        ),
     }
 
 

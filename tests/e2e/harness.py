@@ -1,0 +1,249 @@
+"""Tier-3 scenario harness: the whole operator roster at replica scale.
+
+Models the reference's perf suite driver (test/suites/perf/
+scheduling_test.go:35-114) and its polling Monitor (test/pkg/environment/
+common/monitor.go:53-249): scenarios create Deployments, run the full
+reconcile roster — provision → register → initialize → disrupt → drain →
+terminate — against the in-process store + kwok provider, and record timed
+phases to an artifact.
+
+Three pieces the reference gets from a live cluster are simulated here:
+
+- ``DeploymentSim`` — the ReplicaSet controller: keeps ``replicas`` pods of
+  a template alive, recreating any that eviction deleted (drain deletes
+  pods outright, controllers/termination.py).
+- ``Monitor`` — polling cluster observer: node/claim/pod counts since
+  reset, utilization, healthy (bound) pod counts per label selector.
+- ``PhaseTimer`` — the TimeIntervalCollector analog: wall + virtual-clock
+  durations per named phase, dumped as JSON next to this file.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import labels as labels_mod
+from karpenter_tpu.api import resources as res
+from karpenter_tpu.api.objects import Node, NodeClaim, Pod
+from karpenter_tpu.utils import pod as pod_utils
+
+_seq = itertools.count(1)
+
+
+class DeploymentSim:
+    """Replica-keeping pod source (the ReplicaSet role). ``make_pod`` is a
+    zero-arg factory returning a fresh Pending pod; the sim labels it for
+    selector-based monitoring and replaces pods the drain deleted."""
+
+    def __init__(self, client, name: str, replicas: int, make_pod: Callable[[], Pod]):
+        self.client = client
+        self.name = name
+        self.replicas = replicas
+        self._make_pod = make_pod
+        self._owned: List[str] = []  # live uids, in creation order
+
+    def reconcile(self) -> int:
+        """Create pods up to ``replicas``; returns how many were created."""
+        live = {p.uid for p in self.client.list(Pod)}
+        self._owned = [uid for uid in self._owned if uid in live]
+        created = 0
+        while len(self._owned) < self.replicas:
+            pod = self._make_pod()
+            pod.metadata.labels.setdefault("e2e/deployment", self.name)
+            pod.metadata.name = f"{self.name}-{next(_seq)}"
+            self.client.create(pod)
+            self._owned.append(pod.uid)
+            created += 1
+        return created
+
+    def scale(self, replicas: int) -> None:
+        """Scale down deletes surplus pods (newest first), like a
+        ReplicaSet; scale up happens on the next reconcile."""
+        while len(self._owned) > replicas:
+            uid = self._owned.pop()
+            for p in self.client.list(Pod):
+                if p.uid == uid:
+                    self.client.delete(p)
+                    break
+        self.replicas = replicas
+
+    def bound_count(self) -> int:
+        live = {p.uid: p for p in self.client.list(Pod)}
+        return sum(
+            1
+            for uid in self._owned
+            if uid in live
+            and live[uid].spec.node_name
+            and pod_utils.is_active(live[uid])
+        )
+
+    def all_bound(self) -> bool:
+        return (
+            len(self._owned) == self.replicas
+            and self.bound_count() == self.replicas
+        )
+
+
+class Monitor:
+    """Polling cluster observer (monitor.go:53-249): counts are snapshots
+    of the store; ``reset()`` pins the baseline the way the reference pins
+    nodesAtReset before each test."""
+
+    def __init__(self, client):
+        self.client = client
+        self._nodes_at_reset: Dict[str, Node] = {}
+        self.reset()
+
+    def reset(self) -> None:
+        self._nodes_at_reset = {n.name: n for n in self.client.list(Node)}
+
+    def node_count(self) -> int:
+        return len(self.client.list(Node))
+
+    def created_node_count(self) -> int:
+        return sum(
+            1
+            for n in self.client.list(Node)
+            if n.name not in self._nodes_at_reset
+        )
+
+    def deleted_node_count(self) -> int:
+        live = {n.name for n in self.client.list(Node)}
+        return sum(1 for name in self._nodes_at_reset if name not in live)
+
+    def claim_count(self) -> int:
+        return len(self.client.list(NodeClaim))
+
+    def drifted_claim_count(self) -> int:
+        from karpenter_tpu.api.objects import COND_DRIFTED
+
+        return sum(
+            1
+            for c in self.client.list(NodeClaim)
+            if c.conds().is_true(COND_DRIFTED)
+        )
+
+    def pending_pod_count(self) -> int:
+        return sum(
+            1
+            for p in self.client.list(Pod)
+            if pod_utils.is_provisionable(p)
+        )
+
+    def avg_utilization(self, resource: str = res.CPU) -> float:
+        """Requested/allocatable over live nodes (monitor.go AvgUtilization)."""
+        nodes = self.client.list(Node)
+        if not nodes:
+            return 0.0
+        pods = self.client.list(Pod)
+        total_req = 0.0
+        total_alloc = 0.0
+        for n in nodes:
+            total_alloc += float(n.status.allocatable.get(resource, 0))
+            total_req += float(
+                sum(
+                    p.spec.requests.get(resource, 0)
+                    for p in pods
+                    if p.spec.node_name == n.name and pod_utils.is_active(p)
+                )
+            )
+        return total_req / total_alloc if total_alloc else 0.0
+
+
+class PhaseTimer:
+    """TimeIntervalCollector analog: named phases with wall + virtual-clock
+    durations, dumped to JSON for the artifact trail."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self._open: Dict[str, tuple] = {}
+        self.phases: Dict[str, Dict[str, float]] = {}
+
+    def start(self, name: str) -> None:
+        self._open[name] = (time.perf_counter(), self.clock.now())
+
+    def end(self, name: str, **extra) -> None:
+        wall0, virt0 = self._open.pop(name)
+        entry = {
+            "wall_s": round(time.perf_counter() - wall0, 3),
+            "virtual_s": round(self.clock.now() - virt0, 1),
+        }
+        entry.update(extra)
+        self.phases[name] = entry
+
+
+class Scenario:
+    """One operator + store + kwok environment with the simulation loop."""
+
+    def __init__(self, n_types: int = 24, operator_options=None):
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.sim import Binder
+
+        self.clock = TestClock()
+        self.client = Client(self.clock)
+        self.provider = KwokCloudProvider(self.client, corpus.generate(n_types))
+        self.operator = Operator(
+            self.client, self.provider, options=operator_options
+        )
+        self.binder = Binder(self.client)
+        self.monitor = Monitor(self.client)
+        self.timer = PhaseTimer(self.clock)
+        self.deployments: List[DeploymentSim] = []
+
+    def deployment(self, name: str, replicas: int, make_pod) -> DeploymentSim:
+        dep = DeploymentSim(self.client, name, replicas, make_pod)
+        self.deployments.append(dep)
+        return dep
+
+    def tick(self, force: bool = True) -> None:
+        for dep in self.deployments:
+            dep.reconcile()
+        self.operator.step(force_provision=force)
+        self.binder.bind_all()
+        self.clock.step(1.0)
+
+    def run_until(
+        self,
+        predicate: Callable[[], bool],
+        max_ticks: int,
+        what: str,
+    ) -> int:
+        """Tick the roster until the predicate holds; returns ticks used.
+        Raises on timeout — a scenario that can't converge is a failure,
+        not a skip (EventuallyExpectHealthyPodCount's role)."""
+        for i in range(max_ticks):
+            if predicate():
+                return i
+            self.tick()
+        raise AssertionError(
+            f"scenario did not reach '{what}' within {max_ticks} ticks: "
+            f"nodes={self.monitor.node_count()} "
+            f"claims={self.monitor.claim_count()} "
+            f"pending={self.monitor.pending_pod_count()}"
+        )
+
+
+_ARTIFACT = os.path.join(os.path.dirname(__file__), "last_run.json")
+
+
+def record(scenario_name: str, timer: PhaseTimer, **extra) -> None:
+    """Append this scenario's phases to the artifact file."""
+    data = {}
+    if os.path.exists(_ARTIFACT):
+        try:
+            with open(_ARTIFACT) as fh:
+                data = json.load(fh)
+        except Exception:
+            data = {}
+    entry: Dict[str, object] = dict(timer.phases)
+    entry.update(extra)
+    data[scenario_name] = entry
+    with open(_ARTIFACT, "w") as fh:
+        json.dump(data, fh, indent=1)

@@ -1,0 +1,212 @@
+"""Tier-3 E2E scenarios: the full roster at 100-500 replica scale.
+
+Each scenario drives the whole operator — provision → register →
+initialize → (disrupt → drain → terminate) — through the in-process store
+and kwok provider, with timed phases recorded to last_run.json
+(reference: test/suites/perf/scheduling_test.go:35-114).
+"""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # tests/ for helpers
+
+from karpenter_tpu.api import labels
+from karpenter_tpu.api.objects import COND_DRIFTED, Node, NodeClaim, Pod
+
+from e2e.harness import Scenario, record
+from helpers import make_nodepool, make_pod, spread_constraint
+
+
+class TestProvisioningScale:
+    def test_simple_provisioning_500(self):
+        """500 one-cpu replicas from an empty cluster: every pod must land
+        on a created node (scheduling_test.go:39-55 at 5x its scale)."""
+        s = Scenario()
+        s.client.create(make_nodepool())
+        dep = s.deployment(
+            "simple", 500, lambda: make_pod(cpu="1", memory="1Gi")
+        )
+        s.timer.start("provision")
+        ticks = s.run_until(dep.all_bound, 60, "all 500 pods bound")
+        s.timer.end(
+            "provision",
+            replicas=500,
+            ticks=ticks,
+            nodes=s.monitor.created_node_count(),
+        )
+        assert s.monitor.created_node_count() > 0
+        assert s.monitor.pending_pod_count() == 0
+        # every claim made it through the full lifecycle
+        from karpenter_tpu.api.objects import COND_INITIALIZED
+
+        for claim in s.client.list(NodeClaim):
+            assert claim.conds().is_true(COND_INITIALIZED)
+        record(
+            "simple_provisioning_500",
+            s.timer,
+            utilization=round(s.monitor.avg_utilization(), 3),
+        )
+
+    def test_complex_provisioning_400(self):
+        """Diverse deployments — generic, zonal spread, hostname spread,
+        zonal node affinity — provision together (MakeDiversePodOptions's
+        role, scheduling_test.go:92-114)."""
+        from karpenter_tpu.api.objects import (
+            NodeAffinity, NodeSelectorRequirement,
+        )
+
+        s = Scenario()
+        s.client.create(make_nodepool())
+        app_z = {"app": "zspread"}
+        app_h = {"app": "hspread"}
+        deps = [
+            s.deployment(
+                "generic", 100, lambda: make_pod(cpu="1", memory="2Gi")
+            ),
+            s.deployment(
+                "big", 100, lambda: make_pod(cpu="3", memory="4Gi")
+            ),
+            s.deployment(
+                "zonal-spread",
+                100,
+                lambda: make_pod(
+                    cpu="1",
+                    labels=dict(app_z),
+                    spread=[
+                        spread_constraint(labels.TOPOLOGY_ZONE, labels=app_z)
+                    ],
+                ),
+            ),
+            s.deployment(
+                "host-spread",
+                100,
+                lambda: make_pod(
+                    cpu="1",
+                    labels=dict(app_h),
+                    spread=[
+                        spread_constraint(
+                            labels.HOSTNAME, max_skew=2, labels=app_h
+                        )
+                    ],
+                ),
+            ),
+        ]
+        s.timer.start("provision")
+        ticks = s.run_until(
+            lambda: all(d.all_bound() for d in deps), 80,
+            "all 400 diverse pods bound",
+        )
+        s.timer.end(
+            "provision",
+            replicas=400,
+            ticks=ticks,
+            nodes=s.monitor.created_node_count(),
+        )
+        # zonal spread held: bound zspread pods within maxSkew across zones
+        zone_counts = {}
+        pods = s.client.list(Pod)
+        nodes = {n.name: n for n in s.client.list(Node)}
+        for p in pods:
+            if p.metadata.labels.get("app") == "zspread" and p.spec.node_name:
+                z = nodes[p.spec.node_name].metadata.labels.get(
+                    labels.TOPOLOGY_ZONE
+                )
+                zone_counts[z] = zone_counts.get(z, 0) + 1
+        assert zone_counts and max(zone_counts.values()) - min(
+            zone_counts.values()
+        ) <= 1
+        record("complex_provisioning_400", s.timer)
+
+
+class TestDriftReplacement:
+    def test_drift_replacement_cycle_100(self):
+        """Provision 100 replicas, drift the pool (template label change),
+        and run the roster until every old claim is replaced and the
+        workload is whole again (scheduling_test.go:56-91: drift until no
+        claims remain drifted)."""
+        s = Scenario()
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 30.0
+        s.client.create(pool)
+        dep = s.deployment(
+            "workload", 100, lambda: make_pod(cpu="1", memory="2Gi")
+        )
+        s.timer.start("provision")
+        ticks = s.run_until(dep.all_bound, 40, "100 pods bound")
+        s.timer.end("provision", ticks=ticks)
+
+        original = {c.uid for c in s.client.list(NodeClaim)}
+        assert original
+
+        # drift: change the pool template (nodepool hash changes)
+        pool.spec.template.labels["e2e-drift"] = "true"
+        s.client.update(pool)
+        s.timer.start("drift")
+        s.run_until(
+            lambda: s.monitor.drifted_claim_count() > 0,
+            20,
+            "at least one claim drifted",
+        )
+        # replacement converges: no drifted claims left, no old claims
+        # left, workload fully re-bound on replacement nodes
+        ticks = s.run_until(
+            lambda: (
+                s.monitor.drifted_claim_count() == 0
+                and not (
+                    {c.uid for c in s.client.list(NodeClaim)} & original
+                )
+                and dep.all_bound()
+            ),
+            600,
+            "all drifted claims replaced and pods re-bound",
+        )
+        s.timer.end(
+            "drift",
+            ticks=ticks,
+            replaced=len(original),
+            nodes=s.monitor.node_count(),
+        )
+        for claim in s.client.list(NodeClaim):
+            assert claim.metadata.labels.get("e2e-drift") == "true"
+        record("drift_replacement_100", s.timer)
+
+
+class TestConsolidationScale:
+    def test_scale_down_consolidates_200_to_50(self):
+        """Scale a 200-replica deployment down to 50: emptiness +
+        consolidation must shrink the fleet while the surviving pods stay
+        scheduled (the disruption loop's steady-state job)."""
+        s = Scenario()
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = 10.0
+        s.client.create(pool)
+        dep = s.deployment(
+            "workload", 200, lambda: make_pod(cpu="2", memory="2Gi")
+        )
+        s.timer.start("provision")
+        s.run_until(dep.all_bound, 40, "200 pods bound")
+        s.timer.end("provision", nodes=s.monitor.created_node_count())
+        peak = s.monitor.node_count()
+        assert peak >= 2
+
+        dep.scale(50)
+        s.timer.start("consolidate")
+        ticks = s.run_until(
+            lambda: (
+                s.monitor.node_count() < peak
+                and s.monitor.pending_pod_count() == 0
+                and dep.all_bound()
+            ),
+            600,
+            "fleet shrank after scale-down",
+        )
+        s.timer.end(
+            "consolidate",
+            ticks=ticks,
+            peak_nodes=peak,
+            final_nodes=s.monitor.node_count(),
+        )
+        assert dep.bound_count() == 50
+        record("consolidation_200_to_50", s.timer)

@@ -130,25 +130,37 @@ class Binder:
         solver refuses the same shape, topology.go:277-324)."""
         ns = pod.metadata.namespace
         spread = []
+        if pod.spec.topology_spread_constraints:
+            # nodeAffinityPolicy=Honor (the kube-scheduler default, and the
+            # solver's own domain universe): only domains of nodes the pod
+            # itself can land on participate in the skew calculation
+            reqs = pod_requirements(pod)
+            eligible = [
+                n2
+                for n2 in nodes
+                if Requirements.from_labels(n2.metadata.labels).compatible(reqs)
+                is None
+            ]
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
             key = tsc.topology_key
             counts = {}
-            for n2 in nodes:
+            for n2 in eligible:
                 d2 = n2.metadata.labels.get(key)
                 if d2 is not None:
                     counts.setdefault(d2, 0)
             for p2, n2 in placements:
                 d2 = n2.metadata.labels.get(key)
                 if (
-                    d2 is not None
+                    d2 in counts
                     and p2.metadata.namespace == ns
                     and tsc.label_selector is not None
                     and tsc.label_selector.matches(p2.metadata.labels)
                 ):
                     counts[d2] += 1
-            spread.append((key, tsc.max_skew, counts))
+            min_count = min(counts.values()) if counts else 0
+            spread.append((key, tsc.max_skew, counts, min_count))
         aff_domains = []  # (key, allowed domain set or None for any)
         for term in pod.spec.pod_affinity:
             key = term.topology_key
@@ -197,11 +209,11 @@ class Binder:
         terms also repel the new pod)."""
         ns, spread, aff_domains, anti_blocked = ctx
         labels = node.metadata.labels
-        for key, max_skew, counts in spread:
+        for key, max_skew, counts, min_count in spread:
             dom = labels.get(key)
-            if dom is None:
+            if dom is None or dom not in counts:
                 return False
-            if counts.get(dom, 0) + 1 - min(counts.values()) > max_skew:
+            if counts[dom] + 1 - min_count > max_skew:
                 return False
         for key, allowed in aff_domains:
             dom = labels.get(key)

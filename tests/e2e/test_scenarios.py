@@ -13,7 +13,7 @@ import pytest
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # tests/ for helpers
 
 from karpenter_tpu.api import labels
-from karpenter_tpu.api.objects import COND_DRIFTED, Node, NodeClaim, Pod
+from karpenter_tpu.api.objects import Node, NodeClaim, Pod
 
 from e2e.harness import Scenario, record
 from helpers import make_nodepool, make_pod, spread_constraint
@@ -53,9 +53,7 @@ class TestProvisioningScale:
         """Diverse deployments — generic, zonal spread, hostname spread,
         zonal node affinity — provision together (MakeDiversePodOptions's
         role, scheduling_test.go:92-114)."""
-        from karpenter_tpu.api.objects import (
-            NodeAffinity, NodeSelectorRequirement,
-        )
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
 
         s = Scenario()
         s.client.create(make_nodepool())
@@ -66,7 +64,19 @@ class TestProvisioningScale:
                 "generic", 100, lambda: make_pod(cpu="1", memory="2Gi")
             ),
             s.deployment(
-                "big", 100, lambda: make_pod(cpu="3", memory="4Gi")
+                "zonal-affinity",
+                100,
+                lambda: make_pod(
+                    cpu="3",
+                    memory="4Gi",
+                    requirements=[
+                        NodeSelectorRequirement(
+                            labels.TOPOLOGY_ZONE,
+                            "In",
+                            ("test-zone-a", "test-zone-b"),
+                        )
+                    ],
+                ),
             ),
             s.deployment(
                 "zonal-spread",
@@ -117,6 +127,16 @@ class TestProvisioningScale:
         assert zone_counts and max(zone_counts.values()) - min(
             zone_counts.values()
         ) <= 1
+        # zonal node affinity held: those pods only landed in allowed zones
+        for p in pods:
+            if (
+                p.metadata.labels.get("e2e/deployment") == "zonal-affinity"
+                and p.spec.node_name
+            ):
+                z = nodes[p.spec.node_name].metadata.labels.get(
+                    labels.TOPOLOGY_ZONE
+                )
+                assert z in ("test-zone-a", "test-zone-b"), z
         record("complex_provisioning_400", s.timer)
 
 

@@ -556,6 +556,52 @@ class TestHostnameTopology:
         assert not groups and len(rest) == 3
 
 
+class TestCostDelta:
+    """The kernel's grouped placement beats the oracle's per-pod FFD on
+    mixed accelerator batches by avoiding type poisoning (small GPU pods
+    landing on CPU-opened claims narrow their options to GPU-capable
+    types). Root cause audit: PARITY.md 'Packing-cost delta'."""
+
+    def test_mixed_accelerator_kernel_not_pricier(self):
+        from karpenter_tpu.cloudprovider import types as cpt
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import mixed_pods
+
+        pods = mixed_pods(2_000)
+        pools = [example_nodepool()]
+        its = corpus.generate(100)
+        its_by_pool = {p.name: list(its) for p in pools}
+        cache = EncodeCache()
+
+        def solve(force):
+            topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+            return TpuSolver(
+                pools, its_by_pool, topo,
+                config=SolverConfig(force_oracle=force),
+                encode_cache=cache,
+            ).solve(pods)
+
+        kernel = solve(False)
+        oracle = solve(True)
+        assert not kernel.pod_errors and not oracle.pod_errors
+        # equal fleet size; kernel never pricier than the reference FFD
+        assert kernel.node_count() == oracle.node_count()
+        k_cost, o_cost = kernel.total_price(), oracle.total_price()
+        assert k_cost <= o_cost * 1.02, (k_cost, o_cost)
+        # the mechanism: the kernel keeps some claims accelerator-free
+        def gpu_free_claims(results):
+            return sum(
+                1
+                for c in results.new_node_claims
+                if not any(
+                    p.spec.requests.get("nvidia.com/gpu", 0) for p in c.pods
+                )
+            )
+
+        assert gpu_free_claims(kernel) >= gpu_free_claims(oracle)
+
+
 class TestZonalTopology:
     """Zone/capacity-type-keyed spread and pod affinity ride the TPU fast
     path: self-selecting spread as a per-step domain-quota water-fill,

@@ -79,7 +79,7 @@ def _ptr(arr: np.ndarray):
 
 
 def solve_core_native(
-    g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+    g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     g_hstg, g_hscap, g_dtg,
     g_hself, g_hcontrib, g_dcontrib,
@@ -107,6 +107,7 @@ def solve_core_native(
 
     g_count = _as(g_count, np.int32)
     g_hcap = _as(g_hcap, np.int32)
+    g_haff = _as(g_haff, np.uint8)
     n_hcnt = _as(n_hcnt, np.int32)
     g_req = _as(g_req, np.float32)
     g_dmode = _as(g_dmode, np.int32)
@@ -173,7 +174,7 @@ def solve_core_native(
         ctypes.c_int(nmax), ctypes.c_int(zone_kid), ctypes.c_int(ct_kid),
         ctypes.c_int(JH), ctypes.c_int(JD), ctypes.c_int(NRES),
         _ptr(g_count), _ptr(g_req), _ptr(g_def), _ptr(g_neg), _ptr(g_mask),
-        _ptr(g_hcap),
+        _ptr(g_hcap), _ptr(g_haff),
         _ptr(g_dmode), _ptr(g_dkey), _ptr(g_dskew), _ptr(g_dmin0),
         _ptr(g_dprior), _ptr(g_dreg), _ptr(g_drank),
         _ptr(g_hstg), _ptr(g_hscap), _ptr(g_dtg),

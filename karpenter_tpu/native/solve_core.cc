@@ -178,6 +178,7 @@ int kt_solve(
     const int32_t* g_count, const float* g_req, const uint8_t* g_def,
     const uint8_t* g_neg, const uint8_t* g_mask,
     const int32_t* g_hcap,  // [G] per-entity hostname-topology cap
+    const uint8_t* g_haff,  // [G] hostname-affinity: whole group on 1 entity
     // domain-keyed constraint descriptors (ops/packing.py DMODE_*)
     const int32_t* g_dmode, const int32_t* g_dkey, const int32_t* g_dskew,
     const uint8_t* g_dmin0,
@@ -356,6 +357,10 @@ int kt_solve(
     // skew bound collapses to "<= maxSkew selected pods per node/claim"
     // because hostname domains have a global min of 0.
     const int32_t hc = g_hcap[gi];
+    // hostname-affinity single-entity pin (topologygroup.go:277-324
+    // hostname case); n_hcnt rows hold the matching-pod priors for these
+    // groups (the cap combo is demoted at encode time)
+    const bool haff = g_haff[gi];
 
     // domain-keyed constraint descriptors
     const int32_t mode = g_dmode[gi];
@@ -399,6 +404,32 @@ int kt_solve(
       if (has_h)
         exist_cap[n] = std::min(
             exist_cap[n], h_allow(nhc[static_cast<size_t>(n) * JH + jh]));
+    }
+    bool haff_exist_served = false;
+    if (haff && N) {
+      bool has_prior = false;
+      for (int n = 0; n < N; ++n)
+        if (n_hcnt[static_cast<size_t>(n) * G + gi] > 0) {
+          has_prior = true;
+          break;
+        }
+      if (has_prior) {
+        // candidates are exactly the prior-holding nodes (nonempty domains)
+        for (int n = 0; n < N; ++n)
+          if (n_hcnt[static_cast<size_t>(n) * G + gi] <= 0) exist_cap[n] = 0;
+        haff_exist_served = true;
+      } else {
+        // bootstrap: the first node with capacity hosts everyone
+        int first_free = -1;
+        for (int n = 0; n < N; ++n)
+          if (exist_cap[n] >= 1) {
+            first_free = n;
+            break;
+          }
+        for (int n = 0; n < N; ++n)
+          if (n != first_free) exist_cap[n] = 0;
+        haff_exist_served = first_free >= 0;
+      }
     }
 
     // node domain slot on the constrained axis
@@ -524,6 +555,9 @@ int kt_solve(
         }
       }
     }
+    // a served existing-entity pin absorbs what fits; the remainder of a
+    // hostname-affinity group errors rather than spilling to claims
+    if (haff && haff_exist_served) std::fill(qrem.begin(), qrem.end(), 0);
 
     // ---- 2. open claims, least-loaded first ----
     std::vector<uint8_t> got(NMAX, 0);
@@ -613,6 +647,21 @@ int kt_solve(
         claim_cap[s] = std::min(
             claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
     }
+    // hostname-affinity: restrict tier 2 to the least-loaded eligible open
+    // claim (the oracle's in-flight order) — one entity only
+    bool haff_claim_served = false;
+    if (haff) {
+      int tstar = -1;
+      int32_t bestload = kBigDom;
+      for (int s = 0; s < NMAX; ++s)
+        if (c_slot[s] == ANY && claim_cap[s] >= 1 && c_npods[s] < bestload) {
+          bestload = c_npods[s];
+          tstar = s;
+        }
+      for (int s = 0; s < NMAX; ++s)
+        if (s != tstar) claim_cap[s] = 0;
+      haff_claim_served = tstar >= 0;
+    }
     // per-slot water-fill with the slot's remaining quota as budget
     for (int sl = 0; sl < NSLOT; ++sl) {
       if (qrem[sl] <= 0) continue;
@@ -631,6 +680,7 @@ int kt_solve(
           qrem[sl] -= wf_fill[s];
         }
     }
+    if (haff && haff_claim_served) std::fill(qrem.begin(), qrem.end(), 0);
     for (int s = 0; s < NMAX; ++s) {
       if (claim_fill[s] <= 0) continue;
       got[s] = 1;
@@ -833,6 +883,8 @@ int kt_solve(
       int64_t k_want = std::min<int64_t>(
           (rem_d + n_per - 1) / n_per, std::max<int64_t>(k_limit, 0));
       if (any_resv) k_want = std::min(k_want, k_resv);
+      // hostname-affinity: ONE fresh claim hosts the bootstrap
+      if (haff) k_want = std::min<int64_t>(k_want, 1);
       int64_t k_slots = NMAX - n_open;
       if (k_want > k_slots) overflow = true;
       int64_t k = std::min(k_want, k_slots);
@@ -885,6 +937,8 @@ int kt_solve(
               debit[r] * static_cast<float>(k);
       qrem[d_sel] -= placed;
       if (placed == 0) ddead[d_sel] = 1;
+      // haff: a second trip would open a second entity — retire the slot
+      if (haff) ddead[d_sel] = 1;
     }
     // shared domain carry: a SELF owner's per-domain placements feed the
     // next sharing group's counts (gate modes never count themselves)
@@ -941,12 +995,15 @@ int kt_solve(
         }
       }
     }
-    int32_t left = 0;
-    for (int sl = 0; sl < NSLOT; ++sl) left += qrem[sl];
-    // pods never granted quota (domain water-fill ran out of capacity)
-    int32_t granted = 0;
-    for (int sl = 0; sl < NSLOT; ++sl) granted += qd[sl];
-    out_unplaced[gi] = (count - granted) + left;
+    // fill-based, matching the JAX kernel's count - sum(fills): quota
+    // bookkeeping under-reports here — the haff path zeroes qrem after a
+    // served pin precisely so the remainder errors instead of spilling
+    int64_t placed_total = 0;
+    for (int n = 0; n < N; ++n)
+      placed_total += out_exist_fills[static_cast<size_t>(gi) * N + n];
+    for (int s = 0; s < NMAX; ++s)
+      placed_total += out_claim_fills[static_cast<size_t>(gi) * NMAX + s];
+    out_unplaced[gi] = count - static_cast<int32_t>(placed_total);
   }
 
   std::memcpy(out_c_pool, c_pool.data(), sizeof(int32_t) * NMAX);

@@ -157,6 +157,7 @@ def pack(
     # groups (FFD order)
     g_count, g_req, g_def, g_neg, g_mask,
     g_hcap,  # [G] int32 per-entity cap (hostname spread/anti; 2**30 = none)
+    g_haff,  # [G] bool hostname-affinity: whole group on ONE entity
     g_dmode, g_dkey, g_dskew, g_dmin0,  # [G] domain-constraint descriptors
     g_dprior, g_dreg, g_drank,  # [G, V1] prior counts / registered / rank
     g_hstg, g_hscap, g_dtg,  # [G] shared-constraint slots (-1 = none) + caps
@@ -308,6 +309,7 @@ def pack(
             n_fit_row = n_fit_pgt[:, gi, :]  # [P, T]
             cap_row = cap_ng[:, gi]  # [N]
         hcap = g_hcap[gi]
+        haff = g_haff[gi]  # hostname-affinity: whole group on ONE entity
         # shared hostname constraint: the cap applies against counts that
         # accumulate across groups in the carry. Self owners are capped at
         # (scap_h - count) and counted; gate owners are blocked where the
@@ -430,6 +432,28 @@ def pack(
         exist_cap = jnp.minimum(exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0))
         if N:
             exist_cap = jnp.minimum(exist_cap, _h_allow(state.nhc[:, jhc]))
+            # hostname-affinity single-entity pin (topologygroup.go:277-324
+            # hostname case): with priors, candidates are exactly the
+            # prior-holding nodes (the oracle's nonempty-domain options);
+            # without, the first node with capacity in walk order hosts the
+            # bootstrap and everyone follows. n_hcnt rows hold the affinity
+            # priors for haff groups (encode.py — the cap combo demotes).
+            prior_nodes = n_hcnt[:, gi] > 0
+            haff_has_prior = jnp.any(prior_nodes)
+            free = exist_cap >= 1
+            haff_has_free = jnp.any(free)
+            pin_oh = jax.nn.one_hot(
+                jnp.argmax(free), N, dtype=exist_cap.dtype
+            )
+            haff_cap = jnp.where(
+                haff_has_prior,
+                jnp.where(prior_nodes, exist_cap, 0),
+                jnp.where(haff_has_free, pin_oh * exist_cap, 0),
+            )
+            exist_cap = jnp.where(haff, haff_cap, exist_cap)
+            haff_exist_served = haff & (haff_has_prior | haff_has_free)
+        else:
+            haff_exist_served = jnp.bool_(False)
 
         if has_domains:
             # node domain slot on the constrained axis
@@ -545,6 +569,9 @@ def pack(
             qd = jnp.zeros((NSLOT,), jnp.int32).at[ANY].set(count)
             exist_fill = greedy_prefix_fill(exist_cap, count)
             qrem = qd.at[ANY].add(-jnp.sum(exist_fill))
+        # a served existing-entity pin absorbs what fits; the remainder of
+        # a hostname-affinity group must error, never spill to claims
+        qrem = jnp.where(haff_exist_served, jnp.zeros_like(qrem), qrem)
         exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
         nhc = state.nhc + exist_fill[:, None] * jh_oh[None, :]
 
@@ -598,10 +625,24 @@ def pack(
         def _tier2_any(_):
             c_slot = jnp.full((nmax,), ANY, jnp.int32)
             claim_cap = _clamp(cap_any)
+            # hostname-affinity: restrict to the least-loaded eligible open
+            # claim (the oracle's in-flight order) — one entity only
+            elig = claim_cap >= 1
+            haff_any_claim = haff & jnp.any(elig)
+            tstar = jnp.argmin(jnp.where(elig, state.c_npods, _BIGI))
+            pin = (
+                jax.nn.one_hot(tstar, nmax, dtype=claim_cap.dtype) * claim_cap
+            )
+            claim_cap = jnp.where(
+                haff, jnp.where(haff_any_claim, pin, 0), claim_cap
+            )
             claim_fill = waterfill(
                 state.c_npods, claim_cap, qrem[ANY], iters=wf_iters
             )
-            return c_slot, claim_fill, qrem.at[ANY].add(-jnp.sum(claim_fill))
+            qrem2 = qrem.at[ANY].add(-jnp.sum(claim_fill))
+            # a served claim pin absorbs what fits; the remainder errors
+            qrem2 = jnp.where(haff_any_claim, jnp.zeros_like(qrem2), qrem2)
+            return c_slot, claim_fill, qrem2
 
         if has_domains:
             # per-claim per-domain capacity, and a single domain assignment
@@ -806,6 +847,9 @@ def pack(
                 r_compat = None
             slot = st.n_open
             k_slots = jnp.maximum(nmax - slot, 0)
+            # hostname-affinity: ONE fresh claim hosts the bootstrap; the
+            # remainder errors (the while-loop exit below retires the slot)
+            k_want = jnp.where(haff, jnp.minimum(k_want, 1), k_want)
             k = jnp.minimum(k_want, k_slots)
             ok = any_feasible & (k > 0) & (n_per > 0)
             k = jnp.where(ok, k, 0)
@@ -873,8 +917,10 @@ def pack(
             fills = fills + takes
             qrem = qrem.at[d_sel].add(-placed)
             # a no-progress iteration means this domain has no feasible
-            # template left; retire it so other domains still get served
-            ddead = ddead.at[d_sel].set(ddead[d_sel] | (placed == 0))
+            # template left; retire it so other domains still get served.
+            # haff groups retire after ONE trip: a second trip would open a
+            # second entity, violating the co-location pin.
+            ddead = ddead.at[d_sel].set(ddead[d_sel] | (placed == 0) | haff)
             return st, qrem, fills, ddead
 
         def cond2(carry):

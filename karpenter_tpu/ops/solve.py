@@ -19,7 +19,7 @@ from .packing import pack
 
 
 def solve_core(
-    g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+    g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     g_hstg, g_hscap, g_dtg,
     g_hself, g_hcontrib, g_dcontrib,
@@ -69,7 +69,7 @@ def solve_core(
 
     state, exist_fills, claim_fills, unplaced = pack(
         g_count, g_req, g_def, g_neg, g_mask,
-        g_hcap,
+        g_hcap, g_haff,
         g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
         g_hstg, g_hscap, g_dtg,
         g_hself, g_hcontrib, g_dcontrib,

@@ -62,6 +62,7 @@ def snapshot_shardings(mesh) -> Tuple:
         g,  # g_neg [G, K]
         g,  # g_mask [G, K, V1]
         g,  # g_hcap [G]
+        g,  # g_haff [G]
         g,  # g_dmode [G]
         g,  # g_dkey [G]
         g,  # g_dskew [G]
@@ -161,7 +162,7 @@ def pad_args_for_mesh(args, mesh):
     data = mesh.devices.shape[0]
     model = mesh.devices.shape[1]
     (
-        g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+        g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
         g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
         g_hstg, g_hscap, g_dtg,
         g_hself, g_hcontrib, g_dcontrib,
@@ -189,6 +190,7 @@ def pad_args_for_mesh(args, mesh):
     g_neg = pad_axis(g_neg, 0, data)
     g_mask = pad_axis(g_mask, 0, data, fill=1)
     g_hcap = pad_axis(g_hcap, 0, data)  # count-0 pads never place anyway
+    g_haff = pad_axis(g_haff, 0, data)
     for_g = lambda a: pad_axis(a, 0, data)
     g_dmode, g_dkey, g_dskew, g_dmin0 = map(
         for_g, (g_dmode, g_dkey, g_dskew, g_dmin0)
@@ -212,7 +214,7 @@ def pad_args_for_mesh(args, mesh):
     p_titype_ok = pad_axis(p_titype_ok, 1, model)  # padded types stay infeasible
 
     return (
-        g_count, g_req, g_def, g_neg, g_mask, g_hcap,
+        g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
         g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
         g_hstg, g_hscap, g_dtg,
         g_hself, g_hcontrib, g_dcontrib,

@@ -62,7 +62,7 @@ _NOT_TENSORIZABLE = "__not_tensorizable__"
 # those provably without one; .padded() refuses unclassified fields so a
 # new axis-carrying field cannot silently ship unpadded
 _PADDED_FIELDS = frozenset({
-    "g_count", "g_req", "g_def", "g_neg", "g_mask", "g_hcap",
+    "g_count", "g_req", "g_def", "g_neg", "g_mask", "g_hcap", "g_haff",
     "g_dmode", "g_dkey", "g_dskew", "g_dmin0", "g_dprior", "g_dreg",
     "g_drank", "g_hstg", "g_hscap", "g_dtg",
     "g_hself", "g_hcontrib", "g_dcontrib",
@@ -177,6 +177,12 @@ class TopoSpec:
 
     host_cap: Optional[int] = None  # per-entity cap; None = unconstrained
     host_counts: Dict[str, int] = field(default_factory=dict)  # node -> prior
+    # hostname-keyed POD_AFFINITY: the whole group co-locates on ONE entity
+    # (topologygroup.go:277-324 hostname case). With priors, candidates are
+    # exactly the prior-holding nodes; without, the bootstrap pins the
+    # first fitting entity and the rest follow (overflow = pod errors).
+    haff: bool = False
+    haff_prior: Dict[str, int] = field(default_factory=dict)  # node -> count
     dmode: int = DMODE_NONE
     dkey: Optional[str] = None  # TOPOLOGY_ZONE or CAPACITY_TYPE_LABEL_KEY
     dskew: int = 0
@@ -326,8 +332,14 @@ def is_tensorizable(pod: Pod, allow_topology: bool = False) -> bool:
         if len(spec.pod_anti_affinity) > 1:
             return False
         for term in spec.pod_affinity:
-            # hostname affinity (co-locate on one node) stays host-side
-            if term.topology_key not in DOMAIN_KEYS:
+            # zone/ct affinity rides the domain machinery; hostname
+            # affinity (co-locate on one node) rides the single-entity
+            # pin (_resolve_topology admits only the self-selecting,
+            # group-private shape)
+            if (
+                term.topology_key not in DOMAIN_KEYS
+                and term.topology_key != labels_mod.HOSTNAME
+            ):
                 return False
         if len(spec.pod_affinity) > 1:
             return False
@@ -371,7 +383,11 @@ class EncodedSnapshot:
     g_neg: np.ndarray  # [G, K] bool
     g_mask: np.ndarray  # [G, K, V1] bool
     g_hcap: np.ndarray  # [G] int32 per-entity cap (hostname topology; HCAP_NONE=free)
-    n_hcnt: np.ndarray  # [N, G] int32 prior selected-pod counts per existing node
+    n_hcnt: np.ndarray  # [N, G] int32 prior selected-pod counts per existing
+    # node — per-entity-cap priors for capped groups; for g_haff groups the
+    # SAME rows hold the hostname-affinity matching-pod priors (the two
+    # never combine: _resolve_topology demotes the combo)
+    g_haff: np.ndarray  # [G] bool hostname-affinity single-entity pin
     # domain-keyed (zone / capacity-type) constraint descriptors
     g_dmode: np.ndarray  # [G] int32 DMODE_*
     g_dkey: np.ndarray  # [G] int32 0=zone 1=capacity-type
@@ -472,6 +488,7 @@ class EncodedSnapshot:
             g_neg=pad(self.g_neg, 0, gp),
             g_mask=pad(self.g_mask, 0, gp, fill=1),
             g_hcap=pad(self.g_hcap, 0, gp, fill=HCAP_NONE),
+            g_haff=pad(self.g_haff, 0, gp),
             g_dmode=pad(self.g_dmode, 0, gp),
             g_dkey=pad(self.g_dkey, 0, gp),
             g_dskew=pad(self.g_dskew, 0, gp),
@@ -512,7 +529,7 @@ class EncodedSnapshot:
             a_res = np.zeros((0,) + a_tzc.shape, bool)
         return (
             self.g_count, self.g_req, self.g_def, self.g_neg, self.g_mask,
-            self.g_hcap,
+            self.g_hcap, self.g_haff,
             self.g_dmode, self.g_dkey, self.g_dskew, self.g_dmin0,
             self.g_dprior, self.g_dreg, self.g_drank,
             self.g_hstg, self.g_hscap, self.g_dtg,
@@ -625,6 +642,7 @@ def encode(
     g_neg = np.zeros((G, K), bool)
     g_mask = np.ones((G, K, V1), bool)
     g_hcap = np.full((G,), HCAP_NONE, np.int32)
+    g_haff = np.zeros((G,), bool)
     g_dmode = np.zeros((G,), np.int32)
     g_dkey = np.zeros((G,), np.int32)
     g_dskew = np.zeros((G,), np.int32)
@@ -691,6 +709,7 @@ def encode(
         if g.topo is not None:
             if g.topo.host_cap is not None:
                 g_hcap[i] = g.topo.host_cap
+            g_haff[i] = g.topo.haff
             if g.topo.dmode != DMODE_NONE:
                 t = g.topo
                 g_dmode[i] = t.dmode
@@ -812,15 +831,23 @@ def encode(
                 taints_mod.tolerates(en.cached_taints, g.pods[0].spec.tolerations)
                 is None
             )
-            if g.topo is not None and g.topo.host_counts:
+            if g.topo is not None and (
+                g.topo.host_counts or g.topo.haff_prior
+            ):
                 # hostname domains are the node's hostname label (node name
-                # as fallback), mirroring Topology._count_domains
+                # as fallback), mirroring Topology._count_domains. For haff
+                # groups the row holds the affinity matching-pod priors
+                # (the cap/affinity combo is demoted, so no overlap).
                 domain = (
                     en.state_node.hostname()
                     if hasattr(en, "state_node")
                     else en.name
                 )
-                n_hcnt[i, gi] = g.topo.host_counts.get(domain, 0)
+                n_hcnt[i, gi] = (
+                    g.topo.haff_prior.get(domain, 0)
+                    if g.topo.haff
+                    else g.topo.host_counts.get(domain, 0)
+                )
 
     return EncodedSnapshot(
         vocab=vocab,
@@ -835,6 +862,7 @@ def encode(
         g_neg=g_neg,
         g_mask=g_mask,
         g_hcap=g_hcap,
+        g_haff=g_haff,
         n_hcnt=n_hcnt,
         g_dmode=g_dmode,
         g_dkey=g_dkey,
@@ -1151,8 +1179,38 @@ def _resolve_topology(
             self_sel = tg.selects(rep)
             if tg.key == labels_mod.HOSTNAME:
                 if tg.type is TopologyType.POD_AFFINITY:
-                    demote.add(gi)  # hostname co-location stays host-side
-                    break
+                    # hostname co-location: the whole group pins to ONE
+                    # entity (topologygroup.go:277-324 hostname case).
+                    # Admit the self-selecting group-private shape; gate
+                    # affinity (owner not selected — its candidates never
+                    # grow) stays host-side, as does a second hostname
+                    # affinity on the same group.
+                    if not self_sel or spec.haff:
+                        demote.add(gi)
+                        break
+                    prior = {d: c for d, c in tg.domains.items() if c > 0}
+                    if prior:
+                        # prior counts come from cluster pods, but the
+                        # kernel's candidate rows are the solve's state
+                        # nodes — a prior on a node outside the snapshot
+                        # (cordoned/deleting) would silently degrade to
+                        # the bootstrap; the oracle pins candidates to the
+                        # prior node, so demote instead
+                        known = set()
+                        for sn in getattr(topology, "_state_nodes", ()):
+                            hn = (
+                                sn.hostname()
+                                if hasattr(sn, "hostname")
+                                else getattr(sn, "name", None)
+                            )
+                            if hn:
+                                known.add(hn)
+                        if not set(prior) <= known:
+                            demote.add(gi)
+                            break
+                    spec.haff = True
+                    spec.haff_prior = prior
+                    continue
                 if self_sel:
                     # self-selecting: the skew bound is a per-entity cap of
                     # maxSkew (anti: 1) minus pods already counted on the node
@@ -1266,6 +1324,12 @@ def _resolve_topology(
         # fold hostname constraints: fresh-entity cap = min cap_i; a node's
         # residual is min_i (cap_i - prior_i), stored back as an effective
         # prior so the kernel's single (cap - prior) recovers it
+        if spec.haff and (constraints or spec.dmode != DMODE_NONE):
+            # the single-entity pin composing with hostname caps or a
+            # domain-dynamic constraint shares kernel state (n_hcnt rows /
+            # quota machinery) — serialize the combo through the oracle
+            demote.add(gi)
+            continue
         if constraints:
             spec.host_cap = min(c for c, _ in constraints)
             for d in {d for _, counts in constraints for d in counts}:
@@ -1436,7 +1500,9 @@ def _resolve_topology(
                     admitted = None  # one shared hostname constraint/group
                     break
                 if kind == "d" and (
-                    spec.shared_d is not None or spec.dmode != DMODE_NONE
+                    spec.shared_d is not None
+                    or spec.dmode != DMODE_NONE
+                    or spec.haff
                 ):
                     admitted = None  # one domain-dynamic per group
                     break

@@ -121,7 +121,7 @@ class TestTopologyParity:
 
         args, statics = _topo_snapshot_args(self._pods_zonal_mix())
         # the hostname-cap AND domain-quota paths must both be active
-        g_hcap, g_dmode = np.asarray(args[5]), np.asarray(args[6])
+        g_hcap, g_dmode = np.asarray(args[5]), np.asarray(args[7])
         assert (g_hcap < 2**30).any(), "hostname cap path not exercised"
         assert (g_dmode > 0).any(), "domain-quota path not exercised"
 

@@ -16,7 +16,7 @@ from karpenter_tpu.scheduling.scheduler import Scheduler
 from karpenter_tpu.scheduling.topology import Topology
 from karpenter_tpu.solver import TpuSolver
 
-from helpers import make_nodepool, make_pod, make_pods
+from helpers import affinity_term, make_nodepool, make_pod, make_pods
 
 
 def run_both(pods, node_pools=None, instance_types=None, limits=None):
@@ -549,6 +549,192 @@ class TestHostnameTopology:
                 )
             ],
         )
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 3
+
+
+class TestHostnameAffinity:
+    """Hostname-keyed required pod affinity (co-locate on ONE node) rides
+    the kernel's single-entity pin (topologygroup.go:277-324 hostname
+    case): bootstrap picks the first fitting entity, priors pin to the
+    nodes already holding matching pods, overflow errors instead of
+    spilling to a second entity."""
+
+    def _solve(self, pods, state_nodes=(), backend="tpu", n_types=20):
+        from karpenter_tpu.solver.driver import SolverConfig
+
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(n_types)}
+        client = Client(TestClock())
+        for sn in state_nodes:
+            client.create(sn.node)
+            for p in sn.pods:
+                client.create(p)
+        topo = Topology(client, list(state_nodes), node_pools, its_by_pool, pods)
+        solver = TpuSolver(
+            node_pools, its_by_pool, topo, state_nodes=list(state_nodes),
+            config=SolverConfig(backend=backend),
+        )
+        return solver, solver.solve(pods)
+
+    def _mk_aff_pods(self, n, cpu="1", lbl=None):
+        lbl = lbl or {"app": "colo"}
+        term = affinity_term(labels.HOSTNAME, lbl)
+        return make_pods(n, cpu=cpu, labels=lbl, pod_affinity=[term])
+
+    @pytest.mark.parametrize("backend", ["tpu", "native"])
+    def test_bootstrap_colocates_on_one_claim(self, backend):
+        from karpenter_tpu.solver import encode as enc
+
+        pods = self._mk_aff_pods(5)
+        solver, results = self._solve(pods, backend=backend)
+        groups, rest = enc.partition_and_group(
+            pods, topology=solver.oracle.topology
+        )
+        assert groups and not rest  # tensorized, not oracle-routed
+        assert results.all_pods_scheduled()
+        holders = [c for c in results.new_node_claims if c.pods]
+        assert len(holders) == 1 and len(holders[0].pods) == 5
+
+    @pytest.mark.parametrize("backend", ["tpu", "native"])
+    def test_prior_pins_to_existing_node(self, backend):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.controllers.state import StateNode
+
+        lbl = {"app": "colo"}
+        node = Node(
+            metadata=ObjectMeta(
+                name="aff-n1",
+                labels={
+                    labels.TOPOLOGY_ZONE: "test-zone-a",
+                    labels.HOSTNAME: "aff-n1",
+                },
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("16"),
+            "memory": res.parse_quantity("64Gi"),
+            "pods": res.parse_quantity("110"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        sn = StateNode(node=node)
+        bound = make_pod(
+            labels=dict(lbl), node_name="aff-n1", phase="Running",
+        )
+        sn.update_pod(bound, is_daemon=False)
+
+        pods = self._mk_aff_pods(4)
+        solver, results = self._solve(pods, state_nodes=[sn], backend=backend)
+        assert results.all_pods_scheduled()
+        assert not results.new_node_claims  # all followed the prior node
+        en = results.existing_nodes[0]
+        assert len(en.pods) == 4
+
+    @pytest.mark.parametrize("backend", ["tpu", "native"])
+    def test_overflow_errors_not_second_entity(self, backend):
+        # pods than no single node type can hold: the remainder must error
+        # (the oracle refuses a second hostname domain), never split
+        pods = self._mk_aff_pods(400, cpu="1")
+        solver, results = self._solve(pods, backend=backend, n_types=8)
+        holders = [c for c in results.new_node_claims if c.pods]
+        assert len(holders) == 1
+        assert len(holders[0].pods) + len(results.pod_errors) == 400
+        assert results.pod_errors  # some pods must not fit one node
+
+    @pytest.mark.parametrize("backend", ["tpu", "native"])
+    def test_partial_pin_reports_remainder(self, backend):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.controllers.state import StateNode
+
+        lbl = {"app": "colo"}
+        node = Node(
+            metadata=ObjectMeta(
+                name="aff-small",
+                labels={
+                    labels.TOPOLOGY_ZONE: "test-zone-a",
+                    labels.HOSTNAME: "aff-small",
+                },
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("4"),
+            "memory": res.parse_quantity("8Gi"),
+            "pods": res.parse_quantity("110"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        sn = StateNode(node=node)
+        bound = make_pod(
+            cpu="1", labels=dict(lbl), node_name="aff-small", phase="Running",
+        )
+        sn.update_pod(bound, is_daemon=False)
+
+        # 6 x 1cpu pods onto a node with 3 cpu left: 3 follow the prior,
+        # 3 MUST error (the oracle refuses any other hostname domain) —
+        # never silently vanish, never land on a fresh claim
+        pods = self._mk_aff_pods(6, cpu="1")
+        solver, results = self._solve(pods, state_nodes=[sn], backend=backend)
+        assert not results.new_node_claims
+        placed = sum(len(e.pods) for e in results.existing_nodes)
+        assert placed == 3
+        assert len(results.pod_errors) == 3
+
+    def test_prior_outside_snapshot_demotes(self):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.solver import encode as enc
+
+        # the matching bound pod's node is known to the CLIENT but not part
+        # of the solve's state nodes (e.g. deleting): the kernel's candidate
+        # rows can't express the pin — must route to the oracle
+        lbl = {"app": "colo"}
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        client = Client(TestClock())
+        gone = Node(
+            metadata=ObjectMeta(
+                name="gone-node", labels={labels.HOSTNAME: "gone-node"}
+            ),
+        )
+        gone.status.ready = True
+        client.create(gone)
+        bound = make_pod(
+            labels=dict(lbl), node_name="gone-node", phase="Running"
+        )
+        client.create(bound)
+        pods = self._mk_aff_pods(3)
+        topo = Topology(client, [], node_pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not groups and len(rest) == 3
+
+    def test_matches_oracle_bootstrap(self):
+        from karpenter_tpu.solver.driver import SolverConfig
+
+        pods = self._mk_aff_pods(6)
+        _, kernel = self._solve(pods)
+        node_pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+        oracle = TpuSolver(
+            node_pools, its_by_pool, topo,
+            config=SolverConfig(force_oracle=True),
+        ).solve(pods)
+        assert oracle.all_pods_scheduled() and kernel.all_pods_scheduled()
+        k_hold = [c for c in kernel.new_node_claims if c.pods]
+        o_hold = [c for c in oracle.new_node_claims if c.pods]
+        assert len(k_hold) == len(o_hold) == 1
+        assert len(k_hold[0].pods) == len(o_hold[0].pods) == 6
+
+    def test_gate_affinity_demotes(self):
+        from karpenter_tpu.solver import encode as enc
+
+        # owner not selected by its own term: candidates never grow — the
+        # oracle's bootstrap right doesn't apply; stays host-side
+        term = affinity_term(labels.HOSTNAME, {"app": "other"})
+        pods = make_pods(3, labels={"app": "mine"}, pod_affinity=[term])
         node_pools = [make_nodepool()]
         its_by_pool = {"default": corpus.generate(20)}
         topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)

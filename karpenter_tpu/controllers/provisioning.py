@@ -212,7 +212,6 @@ class Provisioner:
             reserved_capacity_enabled=self.reserved_capacity_enabled,
         )
         results = solver.solve(pods)
-        results.truncate_instance_types(MAX_INSTANCE_TYPES)
         SCHEDULING_DURATION.observe(max(self.clock.now() - t0, 0.0))
         PODS_UNSCHEDULABLE.set(float(len(results.pod_errors)))
         scheduled = len(pods) - len(results.pod_errors)
@@ -247,7 +246,22 @@ class Provisioner:
         pools = {np_.name: np_ for np_ in self.client.list(NodePool)}
         created = []
         for claim_model in results.new_node_claims:
-            claim = materialize_claim(self.client, claim_model, pools)
+            try:
+                claim = materialize_claim(self.client, claim_model, pools)
+            except ValueError as exc:
+                # launch-time refusal (e.g. minValues unmet after the
+                # 60-type truncation): pods stay pending and retry next
+                # cycle, mirroring the reference's failed-launch event
+                for pod in claim_model.pods:
+                    self.recorder.publish(
+                        Event(
+                            object_uid=pod.uid,
+                            type="Warning",
+                            reason="FailedLaunch",
+                            message=str(exc),
+                        )
+                    )
+                continue
             NODECLAIMS_CREATED.inc(
                 labels={"nodepool": claim_model.template.node_pool_name}
             )

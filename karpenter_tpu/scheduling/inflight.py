@@ -236,10 +236,12 @@ class InFlightNodeClaim:
 
     def finalize(self) -> None:
         """Swap the placeholder hostname for the real claim name
-        (nodeclaim.go:242-258)."""
-        claim = self.template.to_node_claim()
+        (nodeclaim.go:242-258). Only the NAME is minted here — the full CR
+        materializes at launch (to_node_claim), where truncation-time
+        minValues validation may still refuse it."""
+        name = self.template.new_claim_name()
         self.topology.unregister(labels_mod.HOSTNAME, self.hostname)
-        self.hostname = claim.name
+        self.hostname = name
         self.topology.register(labels_mod.HOSTNAME, self.hostname)
         self.requirements.add(
             Requirement(labels_mod.HOSTNAME, Operator.IN, [self.hostname])

@@ -75,12 +75,24 @@ class Results:
         return not self.pod_errors
 
     def truncate_instance_types(self, max_types: int = MAX_INSTANCE_TYPES) -> "Results":
-        """Price-ordered truncation per new claim (scheduler.go:249-267)."""
+        """Price-ordered truncation per new claim (scheduler.go:249-267).
+
+        Runs at the end of every solve (oracle and TPU paths), so all
+        consumers — provisioning, disruption replacements, the solver
+        sidecar — see validated, launchable option sets. Claims already
+        within the cap skip the price sort; minValues (when present) is
+        still validated over the full set."""
         valid = []
         for claim in self.new_node_claims:
-            truncated, err = cp.truncate(
-                claim.instance_type_options, claim.requirements, max_types
-            )
+            options = claim.instance_type_options
+            reqs = claim.requirements
+            if len(options) <= max_types:
+                err = None
+                if reqs.has_min_values():
+                    _, err = cp.satisfies_min_values(options, reqs)
+                truncated = options
+            else:
+                truncated, err = cp.truncate(options, reqs, max_types)
             if err is not None:
                 for pod in claim.pods:
                     self.pod_errors[pod.uid] = (
@@ -302,7 +314,7 @@ class Scheduler:
             new_node_claims=self.new_node_claims,
             existing_nodes=self.existing_nodes,
             pod_errors=pod_errors,
-        )
+        ).truncate_instance_types()
 
 
 def _daemon_overhead(nct: NodeClaimTemplate, daemonset_pods: Sequence[Pod]) -> res.ResourceList:

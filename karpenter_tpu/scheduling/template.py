@@ -43,6 +43,9 @@ class NodeClaimTemplate:
         )
         self.requirements.add(*Requirements.from_labels(self.labels).values())
 
+    def new_claim_name(self) -> str:
+        return f"{self.node_pool_name}-{new_uid()[:8]}"
+
     def to_node_claim(
         self, instance_type_options=None, requirements=None
     ) -> NodeClaim:
@@ -67,7 +70,18 @@ class NodeClaimTemplate:
                 if r.key != labels_mod.HOSTNAME
             )
         )
-        ordered = cp.order_by_price(options, reqs)[:MAX_INSTANCE_TYPES]
+        # minValues is re-validated AFTER the 60-type truncation: the
+        # cheapest prefix may span too few distinct values even though the
+        # full option set satisfied the floor (nodeclaimtemplate
+        # ToNodeClaim; instance_selection_test.go:1337). Solve results are
+        # pre-validated (Results.truncate_instance_types); this guards
+        # direct launches.
+        ordered, err = cp.truncate(options, reqs, MAX_INSTANCE_TYPES)
+        if err is not None:
+            raise ValueError(
+                "minValues requirement is not met after truncation: " + err
+            )
+        ordered = ordered[:MAX_INSTANCE_TYPES]
         reqs.add(
             Requirement(
                 labels_mod.INSTANCE_TYPE,
@@ -76,7 +90,7 @@ class NodeClaimTemplate:
                 min_values=reqs.get(labels_mod.INSTANCE_TYPE).min_values,
             )
         )
-        name = f"{self.node_pool_name}-{new_uid()[:8]}"
+        name = self.new_claim_name()
         spec = NodeClaimSpec(
             requirements=[
                 NodeSelectorRequirement(

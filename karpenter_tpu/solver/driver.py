@@ -185,6 +185,18 @@ class TpuSolver:
             # fallback (scheduler.py:244-258) — inherently sequential;
             # the kernel ledger covers the default fallback mode
             return self.oracle.solve(pods)
+        mv_templates = [
+            nct
+            for nct in self.oracle.templates
+            if nct.requirements.has_min_values()
+        ]
+        if mv_templates and self._min_values_reachable(mv_templates, pods):
+            # minValues is enforced per-Add by the oracle's in-flight claim
+            # (inflight.py:82; types.go SatisfiesMinValues): each added pod
+            # may narrow the claim's distinct values below the floor. The
+            # kernel's bulk fills narrow options the same way but never
+            # count distinct values, so minValues pools serialize host-side.
+            return self.oracle.solve(pods)
         groups, rest = enc.partition_and_group(pods, topology=self.oracle.topology)
 
         tpu_claims: List[DecodedClaim] = []
@@ -204,7 +216,36 @@ class TpuSolver:
         )
         results.new_node_claims = list(results.new_node_claims) + list(tpu_claims)
         results.pod_errors.update(tpu_errors)
-        return results
+        # kernel claims get the same post-solve truncation/minValues
+        # validation the oracle's claims got (scheduler.go:249-267);
+        # oracle claims are already truncated, so this is a no-op for them
+        return results.truncate_instance_types()
+
+    def _min_values_reachable(self, mv_templates, pods) -> bool:
+        """True when any batch pod could land on a minValues pool — only
+        then must the batch serialize host-side. A minValues pool the batch
+        cannot reach (taints it doesn't tolerate, requirements it can't
+        meet) leaves the fast path on (the kernel's claims never open
+        there for these pods anyway)."""
+        from ..api import taints as taints_mod
+        from ..api.requirements import pod_requirements
+
+        for nct in mv_templates:
+            for p in pods:
+                if (
+                    taints_mod.tolerates(nct.taints, p.spec.tolerations)
+                    is not None
+                ):
+                    continue
+                if (
+                    nct.requirements.compatible(
+                        pod_requirements(p), labels_mod.WELL_KNOWN_LABELS
+                    )
+                    is not None
+                ):
+                    continue
+                return True
+        return False
 
     # -- fast path --------------------------------------------------------
 

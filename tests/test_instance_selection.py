@@ -1,0 +1,600 @@
+"""Instance-type selection behavior matrix.
+
+Mirrors the reference's scheduling/instance_selection_test.go: cheapest-
+instance selection under every combination of pod- and pool-level
+constraints (arch, os, zone, capacity type), no-match failures, resource
+fit, and the minValues discipline (In/NotIn/Gt/Lt, max-of-minValues,
+truncation interplay). Scenarios run through BOTH the host oracle and the
+TPU solver — the cheapest-launch discipline must hold on each path.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import NodeSelectorRequirement
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider import types as cp
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import TpuSolver
+from karpenter_tpu.solver.driver import SolverConfig
+
+from helpers import make_nodepool, make_pod, make_pods
+
+AMD = labels.ARCHITECTURE_AMD64
+ARM = labels.ARCHITECTURE_ARM64
+
+
+def diverse_catalog():
+    """Deterministic grid: {c,m,r} x {2,4,8,16} cpus x {amd64,arm64} x
+    {linux,windows}, spot+od in three zones — every selection axis the
+    reference's matrix exercises, with a known price model."""
+    its = []
+    for family in ("c", "m", "r"):
+        for cpu in (2, 4, 8, 16):
+            for arch in (AMD, ARM):
+                for os in ("linux", "windows"):
+                    its.append(
+                        corpus.make_instance_type(
+                            family, cpu, arch=arch, os=os
+                        )
+                    )
+    return its
+
+
+def run(pods, pools=None, its=None, force_oracle=False):
+    pools = pools or [make_nodepool()]
+    its = diverse_catalog() if its is None else its
+    its_by_pool = {p.name: list(its) for p in pools}
+    topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+    solver = TpuSolver(
+        pools, its_by_pool, topo,
+        config=SolverConfig(force_oracle=force_oracle),
+    )
+    return solver.solve(pods), its
+
+
+def launch_price(claim) -> float:
+    return min(
+        cp.min_compatible_price(it, claim.requirements)
+        for it in claim.instance_type_options
+    )
+
+
+def cheapest_feasible(its, claim) -> float:
+    """Cheapest price over ALL catalog types launchable for the claim —
+    the floor every claim's launch price must reach (the reference asserts
+    the node lands on one of the cheapest instances)."""
+    best = float("inf")
+    for it in its:
+        if claim.requirements.is_compatible(
+            it.requirements, labels.WELL_KNOWN_LABELS
+        ):
+            p = cp.min_compatible_price(it, claim.requirements)
+            best = min(best, p)
+    return best
+
+
+def assert_cheapest(results, its):
+    assert not results.pod_errors, results.pod_errors
+    assert results.new_node_claims
+    for claim in results.new_node_claims:
+        lp = launch_price(claim)
+        floor = cheapest_feasible(its, claim)
+        assert lp <= floor + 1e-9, (lp, floor)
+
+
+BOTH = pytest.mark.parametrize("force_oracle", [False, True])
+
+
+class TestCheapestSelection:
+    @BOTH
+    def test_unconstrained(self, force_oracle):
+        results, its = run(make_pods(3, cpu="1"), force_oracle=force_oracle)
+        assert_cheapest(results, its)
+
+    @BOTH
+    @pytest.mark.parametrize("arch", [AMD, ARM])
+    def test_pod_arch(self, force_oracle, arch):
+        pods = make_pods(
+            3,
+            requirements=[NodeSelectorRequirement(labels.ARCH, "In", (arch,))],
+        )
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+        for claim in results.new_node_claims:
+            assert claim.requirements.get(labels.ARCH).has(arch)
+            for it in claim.instance_type_options:
+                assert it.requirements.get(labels.ARCH).has(arch)
+
+    @BOTH
+    @pytest.mark.parametrize("arch", [AMD, ARM])
+    def test_pool_arch(self, force_oracle, arch):
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(labels.ARCH, "In", (arch,))]
+        )
+        results, its = run(
+            make_pods(3), pools=[pool], force_oracle=force_oracle
+        )
+        assert_cheapest(results, its)
+        for claim in results.new_node_claims:
+            for it in claim.instance_type_options:
+                assert it.requirements.get(labels.ARCH).has(arch)
+
+    @BOTH
+    @pytest.mark.parametrize("os", ["linux", "windows"])
+    def test_pod_os(self, force_oracle, os):
+        pods = make_pods(
+            3, requirements=[NodeSelectorRequirement(labels.OS, "In", (os,))]
+        )
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+        for claim in results.new_node_claims:
+            for it in claim.instance_type_options:
+                assert it.requirements.get(labels.OS).has(os)
+
+    @BOTH
+    @pytest.mark.parametrize("os", ["linux", "windows"])
+    def test_pool_os(self, force_oracle, os):
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(labels.OS, "In", (os,))]
+        )
+        results, its = run(
+            make_pods(3), pools=[pool], force_oracle=force_oracle
+        )
+        assert_cheapest(results, its)
+
+    @BOTH
+    def test_pool_zone(self, force_oracle):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    labels.TOPOLOGY_ZONE, "In", ("test-zone-b",)
+                )
+            ]
+        )
+        results, its = run(
+            make_pods(3), pools=[pool], force_oracle=force_oracle
+        )
+        assert_cheapest(results, its)
+        for claim in results.new_node_claims:
+            assert claim.requirements.get(labels.TOPOLOGY_ZONE).has(
+                "test-zone-b"
+            )
+
+    @BOTH
+    def test_pod_zone(self, force_oracle):
+        pods = make_pods(
+            3, node_selector={labels.TOPOLOGY_ZONE: "test-zone-b"}
+        )
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+
+    @BOTH
+    @pytest.mark.parametrize("ct", ["spot", "on-demand"])
+    def test_pool_capacity_type(self, force_oracle, ct):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    labels.CAPACITY_TYPE_LABEL_KEY, "In", (ct,)
+                )
+            ]
+        )
+        results, its = run(
+            make_pods(3), pools=[pool], force_oracle=force_oracle
+        )
+        assert_cheapest(results, its)
+
+    @BOTH
+    @pytest.mark.parametrize("ct", ["spot", "on-demand"])
+    def test_pod_capacity_type(self, force_oracle, ct):
+        pods = make_pods(
+            3, node_selector={labels.CAPACITY_TYPE_LABEL_KEY: ct}
+        )
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+
+    @BOTH
+    def test_pool_ct_and_zone(self, force_oracle):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    labels.CAPACITY_TYPE_LABEL_KEY, "In", ("on-demand",)
+                ),
+                NodeSelectorRequirement(
+                    labels.TOPOLOGY_ZONE, "In", ("test-zone-a",)
+                ),
+            ]
+        )
+        results, its = run(
+            make_pods(3), pools=[pool], force_oracle=force_oracle
+        )
+        assert_cheapest(results, its)
+
+    @BOTH
+    def test_pod_ct_and_zone(self, force_oracle):
+        pods = make_pods(
+            3,
+            node_selector={
+                labels.CAPACITY_TYPE_LABEL_KEY: "spot",
+                labels.TOPOLOGY_ZONE: "test-zone-a",
+            },
+        )
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+
+    @BOTH
+    def test_pool_ct_pod_zone_cross(self, force_oracle):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    labels.CAPACITY_TYPE_LABEL_KEY, "In", ("spot",)
+                )
+            ]
+        )
+        pods = make_pods(
+            3, node_selector={labels.TOPOLOGY_ZONE: "test-zone-b"}
+        )
+        results, its = run(pods, pools=[pool], force_oracle=force_oracle)
+        assert_cheapest(results, its)
+
+    @BOTH
+    def test_pool_quad_constraint(self, force_oracle):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    labels.CAPACITY_TYPE_LABEL_KEY, "In", ("on-demand",)
+                ),
+                NodeSelectorRequirement(
+                    labels.TOPOLOGY_ZONE, "In", ("test-zone-a",)
+                ),
+                NodeSelectorRequirement(labels.ARCH, "In", (ARM,)),
+                NodeSelectorRequirement(labels.OS, "In", ("windows",)),
+            ]
+        )
+        results, its = run(
+            make_pods(3), pools=[pool], force_oracle=force_oracle
+        )
+        assert_cheapest(results, its)
+        for claim in results.new_node_claims:
+            for it in claim.instance_type_options:
+                assert it.requirements.get(labels.ARCH).has(ARM)
+                assert it.requirements.get(labels.OS).has("windows")
+
+    @BOTH
+    def test_pod_quad_constraint(self, force_oracle):
+        pods = make_pods(
+            3,
+            node_selector={
+                labels.CAPACITY_TYPE_LABEL_KEY: "spot",
+                labels.TOPOLOGY_ZONE: "test-zone-b",
+                labels.ARCH: AMD,
+                labels.OS: "linux",
+            },
+        )
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+
+
+class TestNoMatchFailures:
+    @BOTH
+    def test_unknown_arch_fails(self, force_oracle):
+        pods = make_pods(
+            2,
+            requirements=[NodeSelectorRequirement(labels.ARCH, "In", ("arm",))],
+        )
+        results, _ = run(pods, force_oracle=force_oracle)
+        assert len(results.pod_errors) == 2
+        assert not results.new_node_claims
+
+    @BOTH
+    def test_arch_zone_cross_product_empty(self, force_oracle):
+        # arm64 types exist and zone-b types exist, but catalog has both;
+        # restrict to a combination the catalog lacks: strip arm64 from
+        # zone-b by building a catalog where arm64 only offers zone-a
+        its = [
+            corpus.make_instance_type("c", 4, arch=AMD),
+            corpus.make_instance_type(
+                "c", 4, arch=ARM, zones=("test-zone-a",)
+            ),
+        ]
+        pods = make_pods(
+            2,
+            requirements=[NodeSelectorRequirement(labels.ARCH, "In", (ARM,))],
+            node_selector={labels.TOPOLOGY_ZONE: "test-zone-b"},
+        )
+        results, _ = run(pods, its=its, force_oracle=force_oracle)
+        assert len(results.pod_errors) == 2
+
+    @BOTH
+    def test_pool_arch_pod_zone_conflict(self, force_oracle):
+        its = [
+            corpus.make_instance_type(
+                "m", 4, arch=ARM, zones=("test-zone-a",)
+            ),
+            corpus.make_instance_type("m", 4, arch=AMD),
+        ]
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement(labels.ARCH, "In", (ARM,))]
+        )
+        pods = make_pods(
+            2, node_selector={labels.TOPOLOGY_ZONE: "test-zone-c"}
+        )
+        results, _ = run(pods, pools=[pool], its=its, force_oracle=force_oracle)
+        assert len(results.pod_errors) == 2
+
+
+class TestResourceFit:
+    @BOTH
+    def test_large_pod_skips_small_cheap_types(self, force_oracle):
+        # 12-cpu pod: 2/4/8-cpu types are cheaper but infeasible; the claim
+        # must land on a 16-cpu type and still pick the cheapest of those
+        pods = [make_pod(cpu="12", memory="16Gi")]
+        results, its = run(pods, force_oracle=force_oracle)
+        assert_cheapest(results, its)
+        for claim in results.new_node_claims:
+            for it in claim.instance_type_options:
+                assert it.capacity[res.CPU] >= 12 * res.MILLI
+
+    @BOTH
+    def test_od_cheaper_than_other_spot(self, force_oracle):
+        # spot of a big memory-heavy type is pricier than on-demand of a
+        # small compute type: price ordering must cross capacity types
+        # (instance_selection_test.go:600)
+        its = [
+            corpus.make_instance_type(
+                "r", 16, capacity_types=("spot",)
+            ),
+            corpus.make_instance_type(
+                "c", 2, capacity_types=("on-demand",)
+            ),
+        ]
+        results, its2 = run(make_pods(1, cpu="1"), its=its,
+                            force_oracle=force_oracle)
+        assert_cheapest(results, its2)
+        # the cheap on-demand c-2x beats the big spot r-16x
+        claim = results.new_node_claims[0]
+        assert any(
+            it.name.startswith("c-2x") for it in claim.instance_type_options
+        )
+        assert launch_price(claim) == min(
+            o.price for o in its[1].offerings
+        )
+
+
+class TestMinValues:
+    def _family_pool(self, op, values, min_values):
+        return make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, op, tuple(values),
+                    min_values=min_values,
+                )
+            ]
+        )
+
+    @BOTH
+    def test_in_min_values_spans_families(self, force_oracle):
+        # minValues=2 over instance-family: each claim keeps options from
+        # >= 2 distinct families even though one family is cheapest
+        pool = self._family_pool("In", ("c", "m", "r"), 2)
+        results, its = run(
+            make_pods(4, cpu="1"), pools=[pool], force_oracle=force_oracle
+        )
+        assert not results.pod_errors
+        for claim in results.new_node_claims:
+            fams = {
+                it.requirements.get(corpus.INSTANCE_FAMILY_LABEL).any()
+                for it in claim.instance_type_options
+            }
+            assert len(fams) >= 2
+
+    @BOTH
+    def test_in_min_values_unsatisfiable(self, force_oracle):
+        pool = self._family_pool("In", ("c", "m", "r"), 4)  # only 3 exist
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], force_oracle=force_oracle
+        )
+        assert len(results.pod_errors) == 2
+
+    @BOTH
+    def test_gt_min_values(self, force_oracle):
+        # Gt over instance-cpu with minValues: enough distinct cpu values
+        # above the bound must survive (instance_selection_test.go:739)
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_CPU_LABEL, "Gt", ("2",), min_values=2
+                )
+            ]
+        )
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], force_oracle=force_oracle
+        )
+        assert not results.pod_errors
+        for claim in results.new_node_claims:
+            cpus = {
+                it.requirements.get(corpus.INSTANCE_CPU_LABEL).any()
+                for it in claim.instance_type_options
+            }
+            assert len(cpus) >= 2
+            assert all(int(c) > 2 for c in cpus)
+
+    @BOTH
+    def test_gt_min_values_unsatisfiable(self, force_oracle):
+        # only one cpu value above 8 in this catalog (16): minValues=2 fails
+        its = [
+            corpus.make_instance_type("c", c) for c in (2, 4, 8, 16)
+        ]
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_CPU_LABEL, "Gt", ("8",), min_values=2
+                )
+            ]
+        )
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], its=its,
+            force_oracle=force_oracle,
+        )
+        assert len(results.pod_errors) == 2
+
+    @BOTH
+    def test_lt_min_values(self, force_oracle):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_CPU_LABEL, "Lt", ("16",), min_values=3
+                )
+            ]
+        )
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], force_oracle=force_oracle
+        )
+        assert not results.pod_errors
+        for claim in results.new_node_claims:
+            cpus = {
+                int(it.requirements.get(corpus.INSTANCE_CPU_LABEL).any())
+                for it in claim.instance_type_options
+            }
+            assert len(cpus) >= 3 and all(c < 16 for c in cpus)
+
+    @BOTH
+    def test_lt_min_values_unsatisfiable(self, force_oracle):
+        its = [corpus.make_instance_type("c", c) for c in (2, 4, 8)]
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_CPU_LABEL, "Lt", ("4",), min_values=2
+                )
+            ]
+        )
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], its=its,
+            force_oracle=force_oracle,
+        )
+        assert len(results.pod_errors) == 2
+
+    @BOTH
+    def test_max_of_min_values_same_key(self, force_oracle):
+        # two requirements on one key: the STRICTER minValues governs
+        # (types.go SatisfiesMinValues takes the max per key)
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "In", ("c", "m", "r"),
+                    min_values=1,
+                ),
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "NotIn", ("g",),
+                    min_values=3,
+                ),
+            ]
+        )
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], force_oracle=force_oracle
+        )
+        assert not results.pod_errors
+        for claim in results.new_node_claims:
+            fams = {
+                it.requirements.get(corpus.INSTANCE_FAMILY_LABEL).any()
+                for it in claim.instance_type_options
+            }
+            assert len(fams) >= 3
+
+    @BOTH
+    def test_multiple_keys_min_values(self, force_oracle):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "In", ("c", "m", "r"),
+                    min_values=2,
+                ),
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_CPU_LABEL, "Exists", (), min_values=3
+                ),
+            ]
+        )
+        results, _ = run(
+            make_pods(2, cpu="1"), pools=[pool], force_oracle=force_oracle
+        )
+        assert not results.pod_errors
+        for claim in results.new_node_claims:
+            fams = {
+                it.requirements.get(corpus.INSTANCE_FAMILY_LABEL).any()
+                for it in claim.instance_type_options
+            }
+            cpus = {
+                it.requirements.get(corpus.INSTANCE_CPU_LABEL).any()
+                for it in claim.instance_type_options
+            }
+            assert len(fams) >= 2 and len(cpus) >= 3
+
+    def test_unreachable_min_values_pool_keeps_fast_path(self):
+        # a tainted minValues pool the batch doesn't tolerate must NOT
+        # serialize the whole solve host-side
+        from karpenter_tpu.api.objects import Taint
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+
+        mv_pool = make_nodepool(
+            name="mv",
+            taints=[Taint(key="team", value="x", effect="NoSchedule")],
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "In", ("c", "m"),
+                    min_values=2,
+                )
+            ],
+        )
+        open_pool = make_nodepool(name="open")
+        pools = [mv_pool, open_pool]
+        its = diverse_catalog()
+        its_by_pool = {p.name: list(its) for p in pools}
+        pods = make_pods(4, cpu="1")
+        topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+        solver = TpuSolver(pools, its_by_pool, topo)
+        mv = [
+            nct for nct in solver.oracle.templates
+            if nct.requirements.has_min_values()
+        ]
+        assert mv and not solver._min_values_reachable(mv, pods)
+        # and a tolerating batch flips it
+        tolerant = make_pods(
+            1,
+            tolerations=[
+                __import__(
+                    "karpenter_tpu.api.objects", fromlist=["Toleration"]
+                ).Toleration(key="team", operator="Exists")
+            ],
+        )
+        assert solver._min_values_reachable(mv, tolerant)
+
+    def test_min_values_survives_60_type_truncation(self):
+        # the 60-type truncation (nodeclaimtemplate 60-type cap) keeps the
+        # cheapest types; minValues must be evaluated AFTER truncation
+        # (instance_selection_test.go:1337) — build > 60 types where the
+        # 60 cheapest span only 1 family, with minValues=2 over families
+        its = []
+        for v in range(70):
+            its.append(
+                corpus.make_instance_type("c", 2, variant=v)
+            )
+        its += [corpus.make_instance_type("r", 96)]  # expensive 2nd family
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "In", ("c", "r"),
+                    min_values=2,
+                )
+            ]
+        )
+        results, _ = run(make_pods(2, cpu="1"), pools=[pool], its=its)
+        # every solve ends with Results.truncate_instance_types
+        # (scheduler.go:249-267): the 60 cheapest types span one family,
+        # so the solve itself must refuse — not silently drop to 1 family
+        assert len(results.pod_errors) == 2
+        assert not results.new_node_claims
+        for err in results.pod_errors.values():
+            assert "minValues" in err and "truncation" in err

@@ -1,0 +1,384 @@
+"""Topology behavior breadth: combined constraints and policies.
+
+Mirrors the reference's scheduling/topology_test.go scenario classes —
+combined hostname+zonal+capacity-type spread, spread composed with node
+affinity, NodeTaintsPolicy / NodeAffinityPolicy, and pod affinity/anti
+interplay — at the behavior level (placements, skews, failures), through
+both the oracle and the TPU solver paths where the shape tensorizes.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels, resources as res
+from karpenter_tpu.api.objects import (
+    NodeSelectorRequirement, Taint, Toleration, TopologySpreadConstraint,
+    LabelSelector,
+)
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import TpuSolver
+from karpenter_tpu.solver.driver import SolverConfig
+
+from helpers import (
+    affinity_term, make_nodepool, make_pod, make_pods, spread_constraint,
+)
+
+BOTH = pytest.mark.parametrize("force_oracle", [False, True])
+
+
+def run(pods, pools=None, its=None, force_oracle=False, n_types=20):
+    pools = pools or [make_nodepool()]
+    its = corpus.generate(n_types) if its is None else its
+    its_by_pool = {p.name: list(its) for p in pools}
+    topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+    solver = TpuSolver(
+        pools, its_by_pool, topo,
+        config=SolverConfig(force_oracle=force_oracle),
+    )
+    return solver.solve(pods)
+
+
+def zone_of(claim):
+    r = claim.requirements.get(labels.TOPOLOGY_ZONE)
+    return r.any() if not r.complement else None
+
+
+def ct_of(claim):
+    r = claim.requirements.get(labels.CAPACITY_TYPE_LABEL_KEY)
+    return r.any() if not r.complement else None
+
+
+def counts_by(results, keyfn, selector=None):
+    out = {}
+    for claim in results.new_node_claims:
+        k = keyfn(claim)
+        n = sum(
+            1 for p in claim.pods
+            if selector is None or selector(p)
+        )
+        if n:
+            out[k] = out.get(k, 0) + n
+    return out
+
+
+class TestCombinedSpread:
+    @BOTH
+    def test_hostname_and_zonal_and_ct(self, force_oracle):
+        """All three spread keys at once (topology_test.go:1714): hostname
+        forces wide nodes, zones and capacity types balance."""
+        app = {"app": "tri"}
+        pods = make_pods(
+            12, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.HOSTNAME, max_skew=2, labels=app),
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app),
+                spread_constraint(
+                    labels.CAPACITY_TYPE_LABEL_KEY, max_skew=1, labels=app
+                ),
+            ],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            assert len(claim.pods) <= 2  # hostname skew
+        zc = counts_by(results, zone_of)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        cc = counts_by(results, ct_of)
+        assert max(cc.values()) - min(cc.values()) <= 1
+
+    @BOTH
+    def test_zonal_and_ct(self, force_oracle):
+        app = {"app": "zc"}
+        pods = make_pods(
+            6, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app),
+                spread_constraint(
+                    labels.CAPACITY_TYPE_LABEL_KEY, max_skew=1, labels=app
+                ),
+            ],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        zc = counts_by(results, zone_of)
+        assert len(zc) == 3 and max(zc.values()) - min(zc.values()) <= 1
+        cc = counts_by(results, ct_of)
+        assert max(cc.values()) - min(cc.values()) <= 1
+
+    @BOTH
+    def test_zonal_spread_with_node_affinity_restriction(self, force_oracle):
+        """Spread composed with node affinity restricting zones
+        (topology_test.go:1752): only the affinity-admitted zones count."""
+        app = {"app": "za"}
+        pods = make_pods(
+            4, cpu="1", labels=app,
+            requirements=[
+                NodeSelectorRequirement(
+                    labels.TOPOLOGY_ZONE, "In",
+                    ("test-zone-a", "test-zone-b"),
+                )
+            ],
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app)
+            ],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        zc = counts_by(results, zone_of)
+        assert set(zc) == {"test-zone-a", "test-zone-b"}
+        assert sorted(zc.values()) == [2, 2]
+
+    @BOTH
+    def test_ct_spread_with_node_affinity(self, force_oracle):
+        """Capacity-type spread + affinity pinning one zone
+        (topology_test.go:1869)."""
+        app = {"app": "ca"}
+        pods = make_pods(
+            4, cpu="1", labels=app,
+            node_selector={labels.TOPOLOGY_ZONE: "test-zone-a"},
+            spread=[
+                spread_constraint(
+                    labels.CAPACITY_TYPE_LABEL_KEY, max_skew=1, labels=app
+                )
+            ],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            assert zone_of(claim) == "test-zone-a"
+        cc = counts_by(results, ct_of)
+        assert sorted(cc.values()) == [2, 2]
+
+    @BOTH
+    def test_spread_ignores_unrelated_pods(self, force_oracle):
+        """Only selector-matched pods count toward skew: a flood of
+        unrelated pods in one zone doesn't unbalance the spread."""
+        app = {"app": "sel"}
+        flood = make_pods(
+            9, cpu="1",
+            node_selector={labels.TOPOLOGY_ZONE: "test-zone-a"},
+        )
+        spreaders = make_pods(
+            3, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app)
+            ],
+        )
+        results = run(flood + spreaders, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        zc = counts_by(
+            results, zone_of,
+            selector=lambda p: p.metadata.labels.get("app") == "sel",
+        )
+        assert len(zc) == 3 and set(zc.values()) == {1}
+
+    @BOTH
+    def test_two_apps_spread_independently(self, force_oracle):
+        a, b = {"app": "a"}, {"app": "b"}
+        pods = make_pods(
+            3, cpu="1", labels=a,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=a)],
+        ) + make_pods(
+            6, cpu="2", labels=b,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=b)],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        for app in ("a", "b"):
+            zc = counts_by(
+                results, zone_of,
+                selector=lambda p, app=app: p.metadata.labels.get("app") == app,
+            )
+            assert max(zc.values()) - min(zc.values()) <= 1
+
+
+class TestNodeTaintsPolicy:
+    def _tainted_pool_env(self):
+        tainted = make_nodepool(
+            name="tainted",
+            weight=10,
+            taints=[Taint(key="team", value="x", effect="NoSchedule")],
+        )
+        open_ = make_nodepool(name="open", weight=1)
+        return [tainted, open_]
+
+    def test_honor_excludes_tainted_domains(self):
+        """NodeTaintsPolicy=Honor: domains only reachable through tainted
+        nodes don't count for the intolerant pod (topology_test.go:1186).
+        Honor-policy shapes serialize host-side by design."""
+        app = {"app": "tp"}
+        pods = make_pods(
+            2, cpu="1", labels=app,
+            spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=labels.TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels=app),
+                    node_taints_policy="Honor",
+                )
+            ],
+        )
+        results = run(pods, pools=self._tainted_pool_env())
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            assert claim.template.node_pool_name == "open"
+
+    def test_ignore_counts_tainted_domains(self):
+        app = {"app": "ti"}
+        pods = make_pods(
+            3, cpu="1", labels=app,
+            tolerations=[Toleration(key="team", operator="Exists")],
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app)
+            ],
+        )
+        results = run(pods, pools=self._tainted_pool_env())
+        assert results.all_pods_scheduled()
+        zc = counts_by(results, zone_of)
+        assert len(zc) == 3
+
+
+class TestPodAffinityInterplay:
+    @BOTH
+    def test_zonal_affinity_groups_colocate(self, force_oracle):
+        """Self-affinity on zone: each app's pods share one zone, distinct
+        apps may differ (topology_test.go:1938 class)."""
+        pods = []
+        for app in ("x", "y", "z"):
+            lbl = {"grp": app}
+            pods += make_pods(
+                3, cpu="1", labels=lbl,
+                pod_affinity=[affinity_term(labels.TOPOLOGY_ZONE, lbl)],
+            )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        for app in ("x", "y", "z"):
+            zones = {
+                zone_of(c)
+                for c in results.new_node_claims
+                if any(p.metadata.labels.get("grp") == app for p in c.pods)
+            }
+            assert len(zones) == 1
+
+    @BOTH
+    def test_hostname_anti_one_per_node_with_bystanders(self, force_oracle):
+        """Hostname anti-affinity pods singleton per node while unrelated
+        pods pack densely alongside."""
+        lbl = {"app": "singleton"}
+        anti = make_pods(
+            3, cpu="1", labels=lbl,
+            pod_anti_affinity=[affinity_term(labels.HOSTNAME, lbl)],
+        )
+        bulk = make_pods(9, cpu="1")
+        results = run(anti + bulk, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            n_anti = sum(1 for p in claim.pods if p in anti)
+            assert n_anti <= 1
+
+    @BOTH
+    def test_zonal_affinity_with_spread_partner(self, force_oracle):
+        """An affinity app and a spread app coexist: affinity pods in one
+        zone, spread pods balanced regardless."""
+        aff_l, spr_l = {"grp": "aff"}, {"app": "spr"}
+        aff = make_pods(
+            4, cpu="1", labels=aff_l,
+            pod_affinity=[affinity_term(labels.TOPOLOGY_ZONE, aff_l)],
+        )
+        spr = make_pods(
+            3, cpu="1", labels=spr_l,
+            spread=[spread_constraint(labels.TOPOLOGY_ZONE, labels=spr_l)],
+        )
+        results = run(aff + spr, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        aff_zones = {
+            zone_of(c)
+            for c in results.new_node_claims
+            if any(p in aff for p in c.pods)
+        }
+        assert len(aff_zones) == 1
+        zc = counts_by(
+            results, zone_of, selector=lambda p: p in spr
+        )
+        assert len(zc) == 3
+
+    def test_zonal_anti_affinity_late_committal(self):
+        """Zonal anti-affinity within ONE batch schedules only one pod:
+        the first claim's zone is uncommitted, so the oracle pessimistically
+        records every admitted zone as occupied — the reference documents
+        this 'downside of late committal' and expects the rest to resolve
+        over subsequent batches (topology_test.go:2678-2722)."""
+        lbl = {"app": "zanti"}
+        pods = make_pods(
+            3, cpu="1", labels=lbl,
+            pod_anti_affinity=[affinity_term(labels.TOPOLOGY_ZONE, lbl)],
+        )
+        results = run(pods)
+        scheduled = [c for c in results.new_node_claims if c.pods]
+        assert len(scheduled) == 1
+        assert len(results.pod_errors) == 2
+
+
+class TestSpreadEdgeCases:
+    @BOTH
+    def test_skew_respected_across_batches(self, force_oracle):
+        """Second batch sees the first batch's claims via topology priors:
+        a fresh solve on a cluster state is out of scope here, but within
+        one batch a 7-pod spread over 3 zones lands 3/2/2."""
+        app = {"app": "seven"}
+        pods = make_pods(
+            7, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app)
+            ],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        zc = counts_by(results, zone_of)
+        assert sorted(zc.values()) == [2, 2, 3]
+
+    @BOTH
+    def test_zone_limited_catalog_bounds_spread(self, force_oracle):
+        """Types only offer two zones: the spread universe is what the
+        catalog registers, not the static zone list."""
+        its = [
+            corpus.make_instance_type(
+                "m", c, zones=("test-zone-a", "test-zone-b")
+            )
+            for c in (4, 8)
+        ]
+        app = {"app": "2z"}
+        pods = make_pods(
+            4, cpu="1", labels=app,
+            spread=[
+                spread_constraint(labels.TOPOLOGY_ZONE, max_skew=1, labels=app)
+            ],
+        )
+        results = run(pods, its=its, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        zc = counts_by(results, zone_of)
+        assert set(zc) == {"test-zone-a", "test-zone-b"}
+        assert sorted(zc.values()) == [2, 2]
+
+    @BOTH
+    def test_schedule_anyway_never_blocks(self, force_oracle):
+        """ScheduleAnyway spread is preference-only: a zone-pinned workload
+        still schedules fully (relaxation host-side)."""
+        app = {"app": "anyway"}
+        pods = make_pods(
+            6, cpu="1", labels=app,
+            node_selector={labels.TOPOLOGY_ZONE: "test-zone-a"},
+            spread=[
+                spread_constraint(
+                    labels.TOPOLOGY_ZONE, max_skew=1, labels=app,
+                    when_unsatisfiable="ScheduleAnyway",
+                )
+            ],
+        )
+        results = run(pods, force_oracle=force_oracle)
+        assert results.all_pods_scheduled()
+        for claim in results.new_node_claims:
+            if claim.pods:
+                assert zone_of(claim) == "test-zone-a"

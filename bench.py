@@ -180,7 +180,11 @@ def run_config(
     solver = make_solver()
     routed = _routed_fraction(solver, pods)
 
-    # warm-up compiles the kernels for this shape bucket
+    # warm-up compiles the kernels for this shape bucket — twice: the
+    # first solve runs at the a-priori NMAX estimate and records the
+    # observed claim count in the shared EncodeCache; the second compiles
+    # the adaptive (smaller) shape the timed trials will actually run
+    make_solver().solve(pods)
     warm = make_solver().solve(pods)
     if warm.pod_errors:
         print(

@@ -300,14 +300,50 @@ class DisruptionController:
                     f"disrupting node via {command.reason}",
                 )
             )
-        replacement_names = self._launch_replacements(command)
+        try:
+            replacement_names = self._launch_replacements(command)
+        except ValueError as exc:
+            # launch refusal (e.g. minValues unmet after the replacement's
+            # option filtering): roll back so candidates aren't stranded
+            # cordoned — the reference un-taints on launch failure
+            # (controller.go:219-231)
+            for candidate in command.candidates:
+                node = self.ctx.client.try_get(Node, candidate.node.name)
+                if node is not None:
+                    node.taints = [
+                        t
+                        for t in node.taints
+                        if t.key != labels_mod.DISRUPTED_TAINT_KEY
+                    ]
+                    self.ctx.client.update(node)
+                self.ctx.cluster.unmark_for_deletion(candidate.provider_id)
+                self.ctx.recorder.publish(
+                    Event(
+                        candidate.node_claim.uid,
+                        "Warning",
+                        "DisruptionLaunchFailed",
+                        str(exc),
+                    )
+                )
+            return
         self.queue.add(command, replacement_names)
 
     def _launch_replacements(self, command: Command) -> List[str]:
+        from ...api.objects import NodeClaim
         from ..nodeclaim_disruption import materialize_claim
 
         pools = {np_.name: np_ for np_ in self.ctx.client.list(NodePool)}
-        return [
-            materialize_claim(self.ctx.client, claim_model, pools).name
-            for claim_model in command.replacements
-        ]
+        names: List[str] = []
+        created: List[NodeClaim] = []
+        try:
+            for claim_model in command.replacements:
+                claim = materialize_claim(self.ctx.client, claim_model, pools)
+                created.append(claim)
+                names.append(claim.name)
+        except ValueError:
+            # all-or-nothing: reap the replacements already created so a
+            # partial launch doesn't orphan unneeded capacity
+            for claim in created:
+                self.ctx.client.delete(claim)
+            raise
+        return names

@@ -81,7 +81,6 @@ class NodeClaimTemplate:
             raise ValueError(
                 "minValues requirement is not met after truncation: " + err
             )
-        ordered = ordered[:MAX_INSTANCE_TYPES]
         reqs.add(
             Requirement(
                 labels_mod.INSTANCE_TYPE,

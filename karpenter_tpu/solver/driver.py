@@ -230,8 +230,9 @@ class TpuSolver:
         from ..api import taints as taints_mod
         from ..api.requirements import pod_requirements
 
-        for nct in mv_templates:
-            for p in pods:
+        for p in pods:
+            reqs = pod_requirements(p)  # built once per pod, not per pair
+            for nct in mv_templates:
                 if (
                     taints_mod.tolerates(nct.taints, p.spec.tolerations)
                     is not None
@@ -239,7 +240,7 @@ class TpuSolver:
                     continue
                 if (
                     nct.requirements.compatible(
-                        pod_requirements(p), labels_mod.WELL_KNOWN_LABELS
+                        reqs, labels_mod.WELL_KNOWN_LABELS
                     )
                     is not None
                 ):
@@ -284,9 +285,24 @@ class TpuSolver:
                 avail = cache[avail_key] = self._offering_availability(
                     snap, reserved_enabled
                 )
+            nmax_hint = cache.get("nmax_hint")
         a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
+        if self.config.max_claims is None:
+            # adaptive sizing: the a-priori estimate sums per-group worst
+            # cases and overshoots shared packing by 2-4x; once a solve of
+            # this catalog has run, size off the observed claim count
+            # instead (x1.5 headroom, floored at the hard pods-capacity
+            # bound). Every [NMAX, T] op in the scan scales with this.
+            # Undershoot is caught by the overflow-doubling retry below.
+            hint = nmax_hint
+            if hint:
+                adaptive = max(
+                    enc._next_pow2(int(hint * 1.5) + 8, floor=8),
+                    enc._next_pow2(self._nmax_floor(snap, fit), floor=8),
+                )
+                nmax = min(nmax, adaptive)
         P = len(snap.templates)
         T = len(snap.instance_types)
         # bucketed axis sizes: the kernel runs on the padded snapshot, so
@@ -405,6 +421,11 @@ class TpuSolver:
             if not overflow:
                 break
             nmax *= 2
+        if self.config.max_claims is None:
+            with self._shared_cache.lock:
+                cache["nmax_hint"] = max(
+                    cache.get("nmax_hint", 0), int(n_open)
+                )
         return self._decode(
             snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills,
             unplaced, c_dzone, c_dct, c_resv,
@@ -521,37 +542,64 @@ class TpuSolver:
         # their placements jointly (a cross-shape anti-affinity Deployment
         # needs one claim per pod across ALL its shape groups), so demand
         # within a slot sums; distinct slots still share claims.
+        capped, demand = self._capped_demand(snap, per_group)
+        base = int(per_group[~capped].sum()) + demand
+        # domain-constrained groups open claims per domain (zonal spread
+        # water-fills across zones), so each may strand partial claims
+        # beyond its ceil — at most one per extra domain, and never more
+        # than its pod count affords (a 1-pod group strands none)
+        dyn = snap.g_dmode > 0
+        if len(snap.groups):
+            dregs = snap.g_dreg.sum(axis=1)
+            extra_per = np.clip(
+                np.minimum(snap.g_count - per_group, dregs - 1), 0, None
+            )
+            extra = int(extra_per[dyn].sum())
+        else:
+            extra = 0
+        # per-group partial-claim slack: only groups with >= 2 pods can
+        # leave a partial claim beyond their ceil
+        slack = int((snap.g_count >= 2).sum())
+        return enc._next_pow2(base + slack + extra + 8, floor=8)
+
+    def _capped_demand(self, snap: enc.EncodedSnapshot, per_group):
+        """(capped mask, claim demand) of hostname-capped groups: private
+        caps share claims (max); groups on one shared slot count jointly
+        (sum within slot, max across)."""
+        shared_cap = np.where(snap.g_hself, snap.g_hscap, enc.HCAP_NONE)
         priv_capped = (snap.g_hcap < enc.HCAP_NONE) & ~(
             snap.g_hself & (snap.g_hstg >= 0)
         )
         shared_self = (shared_cap < enc.HCAP_NONE) & (snap.g_hstg >= 0)
         capped = priv_capped | shared_self
-        base = int(per_group[~capped].sum())
         demands = []
         if priv_capped.any():
             demands.append(per_group[priv_capped].max())
         for slot in np.unique(snap.g_hstg[shared_self]):
-            demands.append(per_group[shared_self & (snap.g_hstg == slot)].sum())
-        if demands:
-            base += int(max(demands))
-        # domain-constrained groups open claims per domain (zonal spread
-        # water-fills across zones), so each may leave one partial claim
-        # per registered domain it can actually reach (bounded by its pod
-        # count — a 1-pod group never strands more than one partial claim)
-        dyn = snap.g_dmode > 0
-        extra = (
-            int(
-                np.minimum(
-                    snap.g_dreg[dyn].sum(axis=1), snap.g_count[dyn]
-                ).sum()
+            demands.append(
+                per_group[shared_self & (snap.g_hstg == slot)].sum()
             )
-            if len(snap.groups)
-            else 0
+        return capped, (int(max(demands)) if demands else 0)
+
+    def _nmax_floor(self, snap: enc.EncodedSnapshot, fit: np.ndarray) -> int:
+        """Hard lower bound on claims: total pods over the largest
+        pods-per-claim capacity, plus the hostname-capped demand (an
+        anti-affinity group needs a claim per pod regardless of capacity).
+        Keeps the adaptive hint from starting a doubling ladder far below
+        any feasible size."""
+        total = int(snap.g_count.sum())
+        cap = total
+        if "pods" in snap.resource_names and snap.t_cap.size:
+            col = snap.resource_names.index("pods")
+            cap = max(1, int(np.max(snap.t_cap[:, col])))
+        n_fit = np.where(np.isfinite(fit), fit, 0)
+        shared_cap = np.where(snap.g_hself, snap.g_hscap, enc.HCAP_NONE)
+        best = np.maximum(
+            np.minimum(np.minimum(n_fit.max(axis=1), snap.g_hcap), shared_cap),
+            1,
         )
-        return enc._next_pow2(
-            base + len(snap.groups) + extra + 8,
-            floor=8,
-        )
+        _, demand = self._capped_demand(snap, np.ceil(snap.g_count / best))
+        return max(-(-total // max(cap, 1)), demand)
 
     def _offering_availability(
         self, snap: enc.EncodedSnapshot, reserved_enabled: bool = False

@@ -376,44 +376,46 @@ def pack(
             # Wrapped in lax.cond so non-dynamic groups (the majority of a
             # realistic mix) skip the O(NMAX*T*V1) contractions at runtime.
             def _domain_avail(_):
-                av_z = (
-                    jnp.einsum("nc,tzc->ntz", cc.astype(jnp.float32), a_step_f) > 0
-                )
-                av_c = (
-                    jnp.einsum("nz,tzc->ntc", cz.astype(jnp.float32), a_step_f) > 0
-                )
-                if NRES:
-                    av_z = jnp.where(
-                        state.c_resv[:, None, None],
-                        jnp.einsum(
-                            "nc,tzc->ntz", cc.astype(jnp.float32), a_held_f
+                # only the constrained axis' [.., T, V1] table is consumed;
+                # branch on dkey so the OTHER axis' einsum + materialization
+                # (the big per-step temps, [NMAX, T, V1]) is never computed.
+                # One body serves both arms — only the einsum subscripts and
+                # the contracted/ANDed mask pairs swap.
+                def _axis(n_spec, p_spec, n_con, n_and, p_con, p_and):
+                    def branch(_):
+                        av = (
+                            jnp.einsum(
+                                n_spec, n_con.astype(jnp.float32), a_step_f
+                            )
+                            > 0
                         )
-                        > 0,
-                        av_z,
-                    )
-                    av_c = jnp.where(
-                        state.c_resv[:, None, None],
-                        jnp.einsum(
-                            "nz,tzc->ntc", cz.astype(jnp.float32), a_held_f
+                        if NRES:
+                            av = jnp.where(
+                                state.c_resv[:, None, None],
+                                jnp.einsum(
+                                    n_spec,
+                                    n_con.astype(jnp.float32),
+                                    a_held_f,
+                                )
+                                > 0,
+                                av,
+                            )
+                        pav = (
+                            jnp.einsum(
+                                p_spec, p_con.astype(jnp.float32), a_step_f
+                            )
+                            > 0
                         )
-                        > 0,
-                        av_c,
-                    )
-                toff_nt = jnp.where(
-                    dkey == 0, av_z & cz[:, None, :], av_c & cc[:, None, :]
-                )  # [NMAX, T, V1]
-                pav_z = (
-                    jnp.einsum("pc,tzc->ptz", pcm.astype(jnp.float32), a_step_f)
-                    > 0
+                        return av & n_and[:, None, :], pav & p_and[:, None, :]
+
+                    return branch
+
+                return jax.lax.cond(
+                    dkey == 0,
+                    _axis("nc,tzc->ntz", "pc,tzc->ptz", cc, cz, pcm, pzm),
+                    _axis("nz,tzc->ntc", "pz,tzc->ptc", cz, cc, pzm, pcm),
+                    None,
                 )
-                pav_c = (
-                    jnp.einsum("pz,tzc->ptc", pzm.astype(jnp.float32), a_step_f)
-                    > 0
-                )
-                toff_pt = jnp.where(
-                    dkey == 0, pav_z & pzm[:, None, :], pav_c & pcm[:, None, :]
-                )  # [P, T, V1]
-                return toff_nt, toff_pt
 
             def _no_domain(_):
                 return (
@@ -694,7 +696,12 @@ def pack(
         ch_cnt = state.ch_cnt + claim_fill[:, None] * jh_oh[None, :]
         c_def = state.c_def | (got[:, None] & gdef[None, :])
         c_neg = jnp.where(got[:, None], state.c_neg & gneg[None, :], state.c_neg)
-        still_fits = jnp.all(t_alloc[None, :, :] >= c_used[:, None, :], axis=-1)
+        # "type still fits the claim's load after this fill" — add_fit was
+        # computed against the pre-fill load, so the post-fill check is
+        # add_fit >= pods added ([NMAX, T], vs materializing the
+        # [NMAX, T, R] used-vs-alloc compare; dims this group doesn't
+        # request are already covered by the c_tmask invariant)
+        still_fits = add_fit >= claim_fill[:, None]
         surv = type_ok_row[state.c_pool] & off & still_fits
         if has_domains:
             # dynamic groups pin the claim to the selected domain (the

@@ -276,6 +276,10 @@ def main() -> None:
     # the reference's literal 5-class diverse mix (cross-selecting spread
     # serializes via the host oracle by design; routed fraction reported)
     grid.append(run_config("diverse-ref", 5_000, 400, trials=5, with_oracle=True))
+    # constrained shape WITH the oracle cost delta: the north-star config
+    # itself is beyond the oracle budget, so its cost discipline is proven
+    # at 10k pods on the same constraint mix
+    grid.append(run_config("constrained", 10_000, 400, trials=5, with_oracle=True))
 
     # size grid (reference harness shape, scheduling_benchmark_test.go:70-96)
     if full_grid:

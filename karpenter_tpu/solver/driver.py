@@ -197,7 +197,14 @@ class TpuSolver:
             # kernel's bulk fills narrow options the same way but never
             # count distinct values, so minValues pools serialize host-side.
             return self.oracle.solve(pods)
-        groups, rest = enc.partition_and_group(pods, topology=self.oracle.topology)
+        groups, rest = enc.partition_and_group(
+            pods,
+            topology=self.oracle.topology,
+            # the merge's exactness argument needs state-independent
+            # bootstrap inputs: a reservation ledger makes offering
+            # availability evolve across scan steps
+            merge_bootstrap_affinity=not self.oracle.reserved_capacity_enabled,
+        )
 
         tpu_claims: List[DecodedClaim] = []
         tpu_errors: Dict[str, object] = {}

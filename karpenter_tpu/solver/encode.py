@@ -931,6 +931,7 @@ def ffd_sort_key(g: "PodGroup"):
 def partition_and_group(
     pods: Sequence[Pod],
     topology=None,
+    merge_bootstrap_affinity: bool = True,
 ) -> Tuple[List[PodGroup], List[Pod]]:
     """One pass over the batch: route non-tensorizable pods to the host
     oracle and group the rest into equivalence classes, FFD-ordered
@@ -1058,7 +1059,10 @@ def partition_and_group(
         # this call), and an empty inverse map means no bound pod's
         # anti-affinity can gate placements — so there is nothing to demote
         # and no TopoSpec to build.
-        groups, demoted = _resolve_topology(groups, rest, topology)
+        groups, demoted = _resolve_topology(
+            groups, rest, topology,
+            merge_bootstrap_affinity=merge_bootstrap_affinity,
+        )
         rest.extend(demoted)
     # FFD order over groups: cpu desc, then memory desc (queue.go:76-112)
     groups.sort(key=ffd_sort_key)
@@ -1080,7 +1084,8 @@ def _pod_constraint_selectors(pod: Pod):
 
 
 def _resolve_topology(
-    groups: List[PodGroup], rest: List[Pod], topology
+    groups: List[PodGroup], rest: List[Pod], topology,
+    merge_bootstrap_affinity: bool = True,
 ) -> Tuple[List[PodGroup], List[Pod]]:
     """Global cross-group checks + TopoSpec construction (see
     partition_and_group docstring). Returns (kept groups, demoted pods)."""
@@ -1564,6 +1569,84 @@ def _resolve_topology(
         demote.update(partners.get(gi, ()))
         pending |= demote - before
 
-    kept = [g for gi, g in enumerate(groups) if gi not in demote]
+    # -- bootstrap-affinity group merge -------------------------------------
+    # Indistinguishable DMODE_AFFINITY groups (identical shape/requirements/
+    # domain universe, zero priors, no shared constraints or carries) all
+    # bootstrap to the SAME domain when no existing node can host them and
+    # the offering availability is static: d_fresh is the rank-min over
+    # fresh-feasible registered domains, which none of their placements move
+    # (topologygroup.go:291-324 run per group with identical inputs). The
+    # reference's diverse benchmark mix creates ~1 such group per pod
+    # (random self-affinity labels); merging collapses them to one scan
+    # step each per shape. Gated off by the driver when existing nodes or a
+    # reservation ledger make availability state-dependent.
+    merged: set = set()
+    if merge_bootstrap_affinity and not getattr(topology, "_state_nodes", ()):
+        # single-group shared-affinity FAMILIES are mergeable across
+        # families: the merge key pins (shape, requirements, universe), so
+        # every merged member computes the SAME static d_fresh — d_fresh is
+        # shape-dependent (fresh_ok_d is built from the group's own
+        # type_ok row, ops/packing.py), which is exactly why families with
+        # a second, differently-shaped sibling are excluded: the sibling
+        # reads the family carry the merged-away member would have
+        # written, and its own d_fresh may differ. Contributor-fed descs
+        # (options evolve from outside the family) and priors (the family
+        # follows its prior domain) are excluded too. Contributor descs
+        # are collected from EVERY group's topo — constraint-free
+        # contributor groups never enter group_specs.
+        contrib_descs = set()
+        for g in groups:
+            if g.topo is not None:
+                for d in g.topo.contrib_d:
+                    contrib_descs.add(id(d))
+        fam: Dict[int, List[int]] = {}
+        for gj, sp in group_specs.items():
+            if sp.shared_d is not None:
+                fam.setdefault(id(sp.shared_d), []).append(gj)
+
+        def _family_ok(spec) -> bool:
+            if spec.shared_d is None:
+                return True
+            did = id(spec.shared_d)
+            if did in contrib_descs:
+                return False
+            if any(spec.shared_d.prior.values()):
+                return False
+            return len(fam[did]) == 1
+
+        by_merge_key: Dict[tuple, int] = {}
+        for gi, g in enumerate(groups):
+            if gi in demote:
+                continue
+            spec = group_specs.get(gi)
+            if (
+                spec is None
+                or spec.dmode != DMODE_AFFINITY
+                or any(spec.dprior.values())
+                or spec.shared_h is not None
+                or spec.contrib_h
+                or spec.contrib_d
+                or spec.host_cap is not None
+                or spec.haff
+                or not _family_ok(spec)
+            ):
+                continue
+            key = (
+                tuple(sorted(g.requests.items())),
+                repr(g.requirements),
+                spec.dkey,
+                frozenset(spec.dreg),
+            )
+            prim = by_merge_key.get(key)
+            if prim is None:
+                by_merge_key[key] = gi
+            else:
+                groups[prim].pods.extend(g.pods)
+                merged.add(gi)
+
+    kept = [
+        g for gi, g in enumerate(groups)
+        if gi not in demote and gi not in merged
+    ]
     demoted_pods = [p for gi in demote for p in groups[gi].pods]
     return kept, demoted_pods

@@ -742,6 +742,171 @@ class TestHostnameAffinity:
         assert not groups and len(rest) == 3
 
 
+class TestBootstrapAffinityMerge:
+    """Indistinguishable zonal self-affinity families merge into one scan
+    step per shape (encode._resolve_topology): with no state nodes and
+    zero priors every family bootstraps to the same static d_fresh, so the
+    merged placement is exact. The diverse benchmark mix creates ~1 such
+    family per pod label."""
+
+    def _family_pods(self, n=120, fams=20, seed=7):
+        import random
+
+        from karpenter_tpu.api.objects import (
+            LabelSelector, ObjectMeta, Pod, PodAffinityTerm, PodSpec,
+        )
+
+        rng = random.Random(seed)
+        pods = []
+        for i in range(n):
+            f = rng.randrange(fams)
+            lbl = {"fam": f"v{f}"}
+            # single-shape families (the realistic Deployment shape — one
+            # pod spec per app): shape is a function of the family, so
+            # each family is ONE group and the cross-family merge applies
+            cpu = [500, 1000, 2000][f % 3]
+            pods.append(
+                Pod(
+                    metadata=ObjectMeta(name=f"fa-{i}", labels=lbl),
+                    spec=PodSpec(
+                        requests={
+                            res.CPU: cpu,
+                            res.MEMORY: 2**30 * 1000,
+                        },
+                        pod_affinity=[
+                            PodAffinityTerm(
+                                topology_key=labels.TOPOLOGY_ZONE,
+                                label_selector=LabelSelector(
+                                    match_labels=lbl
+                                ),
+                            )
+                        ],
+                    ),
+                )
+            )
+        return pods
+
+    def test_families_collapse_and_match_oracle(self):
+        from karpenter_tpu.solver import encode as enc
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+
+        pods = self._family_pods()
+        pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(30)}
+        cache = EncodeCache()
+
+        def solve(force):
+            topo = Topology(
+                Client(TestClock()), [], pools, its_by_pool, pods
+            )
+            s = TpuSolver(
+                pools, its_by_pool, topo,
+                config=SolverConfig(force_oracle=force),
+                encode_cache=cache,
+            )
+            return s, s.solve(pods)
+
+        s, kernel = solve(False)
+        groups, rest = enc.partition_and_group(
+            pods, topology=s.oracle.topology
+        )
+        unmerged, _ = enc.partition_and_group(
+            pods, topology=s.oracle.topology,
+            merge_bootstrap_affinity=False,
+        )
+        assert not rest
+        assert len(groups) <= 3 < len(unmerged)  # one group per shape
+        _, oracle = solve(True)
+        assert not kernel.pod_errors and not oracle.pod_errors
+        assert kernel.node_count() == oracle.node_count()
+        assert abs(kernel.total_price() - oracle.total_price()) <= (
+            0.02 * oracle.total_price() + 1e-9
+        )
+        # every family still co-zones
+        for fam in {p.metadata.labels["fam"] for p in pods}:
+            zones = set()
+            for c in kernel.new_node_claims:
+                if any(
+                    p.metadata.labels.get("fam") == fam for p in c.pods
+                ):
+                    zr = c.requirements.get(labels.TOPOLOGY_ZONE)
+                    zones.add(zr.any() if not zr.complement else None)
+            assert len(zones) <= 1, (fam, zones)
+
+    def test_multi_shape_families_do_not_merge(self):
+        from karpenter_tpu.api.objects import (
+            LabelSelector, ObjectMeta, Pod, PodAffinityTerm, PodSpec,
+        )
+        from karpenter_tpu.solver import encode as enc
+
+        # one family, two shapes: the small-shape member must NOT merge
+        # into another family's primary — d_fresh is shape-dependent, and
+        # the big sibling reads the family carry the merged-away member
+        # would have written
+        def pod(name, fam, cpu):
+            lbl = {"fam": fam}
+            return Pod(
+                metadata=ObjectMeta(name=name, labels=lbl),
+                spec=PodSpec(
+                    requests={res.CPU: cpu, res.MEMORY: 2**30 * 1000},
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=labels.TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels=lbl),
+                        )
+                    ],
+                ),
+            )
+
+        pods = [
+            pod("b1", "multi", 500), pod("b2", "multi", 4000),  # 2 shapes
+            pod("a1", "solo", 500), pod("a2", "solo2", 500),  # mergeable
+        ]
+        pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(20)}
+        topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+        groups, rest = enc.partition_and_group(pods, topology=topo)
+        assert not rest
+        # solo + solo2 merge (one group), multi keeps both its groups
+        by_count = sorted(len(g.pods) for g in groups)
+        assert by_count == [1, 1, 2]
+
+    def test_merge_disabled_with_state_nodes(self):
+        from karpenter_tpu.api.objects import Node, ObjectMeta
+        from karpenter_tpu.controllers.state import StateNode
+        from karpenter_tpu.solver import encode as enc
+
+        # an existing node makes the bootstrap state-dependent (d_exist
+        # evolves as nodes fill): families must NOT merge
+        node = Node(
+            metadata=ObjectMeta(
+                name="sn-1",
+                labels={
+                    labels.TOPOLOGY_ZONE: "test-zone-b",
+                    labels.HOSTNAME: "sn-1",
+                },
+            ),
+        )
+        node.status.capacity = {
+            "cpu": res.parse_quantity("8"),
+            "memory": res.parse_quantity("16Gi"),
+            "pods": res.parse_quantity("110"),
+        }
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.ready = True
+        sn = StateNode(node=node)
+        pods = self._family_pods(n=40, fams=8)
+        pools = [make_nodepool()]
+        its_by_pool = {"default": corpus.generate(30)}
+        client = Client(TestClock())
+        client.create(node)
+        topo = Topology(client, [sn], pools, its_by_pool, pods)
+        merged, _ = enc.partition_and_group(pods, topology=topo)
+        topo2 = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+        free, _ = enc.partition_and_group(pods, topology=topo2)
+        assert len(merged) > len(free)
+
+
 class TestCostDelta:
     """The kernel's grouped placement beats the oracle's per-pod FFD on
     mixed accelerator batches by avoiding type poisoning (small GPU pods

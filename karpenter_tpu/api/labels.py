@@ -48,11 +48,20 @@ DISRUPTED_TAINT_KEY = f"{GROUP}/disrupted"
 UNREGISTERED_TAINT_KEY = f"{GROUP}/unregistered"
 
 # WellKnownLabels: restricted-domain labels that pods/nodepools may still
-# constrain (reference: labels.go:79-92). The reservation-id label is
-# provider-registered in the reference (fake/cloudprovider.go:44 inserts it
-# into WellKnownLabels so reserved-offering compatibility checks pass);
-# this build's providers all use the one label, so it is registered here.
+# constrain (reference: labels.go:79-92). Cloud providers register their
+# labels into this set — the reference's AWS provider inserts
+# karpenter.k8s.aws/instance-* via apis.WellKnownLabels, and
+# fake/cloudprovider.go:44 inserts the reservation-id label. This build's
+# reference provider (cloudprovider/corpus.py) serves the instance
+# family/size/cpu/memory labels, so they are registered here: pods and
+# pools may constrain them, and the compat algebra treats them as
+# allow-undefined (a claim that doesn't pin them can still host the pod —
+# instance-type filtering resolves the constraint).
 RESERVATION_ID_LABEL = f"{GROUP}/reservation-id"
+INSTANCE_FAMILY_LABEL = f"{GROUP}/instance-family"
+INSTANCE_SIZE_LABEL = f"{GROUP}/instance-size"
+INSTANCE_CPU_LABEL = f"{GROUP}/instance-cpu"
+INSTANCE_MEMORY_LABEL = f"{GROUP}/instance-memory"
 WELL_KNOWN_LABELS = frozenset(
     {
         NODEPOOL_LABEL_KEY,
@@ -64,6 +73,10 @@ WELL_KNOWN_LABELS = frozenset(
         CAPACITY_TYPE_LABEL_KEY,
         WINDOWS_BUILD,
         RESERVATION_ID_LABEL,
+        INSTANCE_FAMILY_LABEL,
+        INSTANCE_SIZE_LABEL,
+        INSTANCE_CPU_LABEL,
+        INSTANCE_MEMORY_LABEL,
     }
 )
 
@@ -118,7 +131,8 @@ def is_restricted_node_label(key: str) -> bool:
 
 def is_restricted_label(key: str) -> Optional[str]:
     """Error string if the label may not be used in requirements at all
-    (reference: labels.go:109-118). Well-known labels are always allowed.
+    (reference: labels.go:109-118). Well-known labels (including
+    provider-registered instance labels) are always allowed.
     """
     if key in WELL_KNOWN_LABELS:
         return None

@@ -387,6 +387,16 @@ class Requirements:
                 errs.append((key, incoming, existing))
         return IntersectsError(errs) if errs else None
 
+    def single_valued_labels(self) -> Dict[str, str]:
+        """key -> value for every requirement pinned to exactly one value
+        (the label projection providers stamp onto launched claims and
+        serialized catalogs)."""
+        return {
+            key: next(iter(req.values))
+            for key, req in self._by_key.items()
+            if not req.complement and len(req.values) == 1
+        }
+
     def labels(self) -> Dict[str, str]:
         """Concrete node labels implied by the requirements
         (reference: requirements.go:264-274)."""

@@ -16,10 +16,12 @@ from ..api import resources as res
 from ..api.requirements import Operator, Requirement, Requirements
 from .types import InstanceType, InstanceTypeOverhead, Offering
 
-INSTANCE_FAMILY_LABEL = f"{labels_mod.GROUP}/instance-family"
-INSTANCE_SIZE_LABEL = f"{labels_mod.GROUP}/instance-size"
-INSTANCE_CPU_LABEL = f"{labels_mod.GROUP}/instance-cpu"
-INSTANCE_MEMORY_LABEL = f"{labels_mod.GROUP}/instance-memory"
+# provider instance labels live in api/labels.py (registered well-known);
+# aliased here for the corpus's public surface
+INSTANCE_FAMILY_LABEL = labels_mod.INSTANCE_FAMILY_LABEL
+INSTANCE_SIZE_LABEL = labels_mod.INSTANCE_SIZE_LABEL
+INSTANCE_CPU_LABEL = labels_mod.INSTANCE_CPU_LABEL
+INSTANCE_MEMORY_LABEL = labels_mod.INSTANCE_MEMORY_LABEL
 
 DEFAULT_ZONES = ("test-zone-a", "test-zone-b", "test-zone-c")
 
@@ -216,10 +218,7 @@ def dump_file(path: str, instance_types: List[InstanceType]) -> None:
 
     entries = []
     for it in instance_types:
-        labels = {}
-        for r in it.requirements:
-            if not r.complement and len(r.values) == 1:
-                labels[r.key] = next(iter(r.values))
+        labels = it.requirements.single_valued_labels()
         entries.append(
             {
                 "name": it.name,

@@ -96,6 +96,14 @@ class KwokCloudProvider(CloudProvider):
         node_claim.status.image_id = f"kwok-image-{it.name}"
         node_claim.status.capacity = dict(it.capacity)
         node_claim.status.allocatable = dict(it.allocatable())
+        # stamp the chosen type's single-valued requirement keys as labels
+        # (the reference's providers return the launched NodeClaim with the
+        # full instance label set): pre-registration state nodes answer
+        # labels() from the claim, so pods constraining provider labels
+        # (instance-cpu etc.) must match the in-flight node — otherwise the
+        # next provisioning cycle double-provisions
+        for key, v in it.requirements.single_valued_labels().items():
+            node_claim.metadata.labels.setdefault(key, v)
         node_claim.metadata.labels.setdefault(labels_mod.INSTANCE_TYPE, it.name)
         node_claim.metadata.labels.setdefault(
             labels_mod.CAPACITY_TYPE_LABEL_KEY, offering.capacity_type()

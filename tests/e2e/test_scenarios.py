@@ -142,12 +142,22 @@ class TestProvisioningScale:
 
 class TestDriftReplacement:
     def test_drift_replacement_cycle_100(self):
-        """Provision 100 replicas, drift the pool (template label change),
-        and run the roster until every old claim is replaced and the
-        workload is whole again (scheduling_test.go:56-91: drift until no
-        claims remain drifted)."""
+        """Provision 100 replicas over ~a dozen small nodes, drift the
+        pool (template label change), and run the roster until every old
+        claim is replaced and the workload is whole again
+        (scheduling_test.go:56-91: drift until no claims remain drifted).
+        The default 10% disruption budget must gate the rollout: only a
+        budgeted number of nodes may be disrupted at any instant."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        from karpenter_tpu.cloudprovider.corpus import INSTANCE_CPU_LABEL
+
         s = Scenario()
-        pool = make_nodepool()
+        # small nodes force a wide fleet so the budget actually bites
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(INSTANCE_CPU_LABEL, "In", ("8",))
+            ]
+        )
         pool.spec.disruption.consolidate_after = 30.0
         s.client.create(pool)
         dep = s.deployment(
@@ -158,7 +168,10 @@ class TestDriftReplacement:
         s.timer.end("provision", ticks=ticks)
 
         original = {c.uid for c in s.client.list(NodeClaim)}
-        assert original
+        assert len(original) >= 8  # a real fleet, not two jumbo nodes
+        import math
+
+        budget = max(1, math.ceil(0.1 * len(original)))  # default "10%"
 
         # drift: change the pool template (nodepool hash changes)
         pool.spec.template.labels["e2e-drift"] = "true"
@@ -170,24 +183,37 @@ class TestDriftReplacement:
             "at least one claim drifted",
         )
         # replacement converges: no drifted claims left, no old claims
-        # left, workload fully re-bound on replacement nodes
-        ticks = s.run_until(
-            lambda: (
+        # left, workload fully re-bound — while the 10% budget gates how
+        # many original nodes are ever disrupted (tainted) at once
+        max_tainted = 0
+
+        def converged():
+            nonlocal max_tainted
+            tainted = sum(
+                1
+                for n in s.client.list(Node)
+                if any(t.key == labels.DISRUPTED_TAINT_KEY for t in n.taints)
+            )
+            max_tainted = max(max_tainted, tainted)
+            return (
                 s.monitor.drifted_claim_count() == 0
                 and not (
                     {c.uid for c in s.client.list(NodeClaim)} & original
                 )
                 and dep.all_bound()
-            ),
-            600,
-            "all drifted claims replaced and pods re-bound",
+            )
+
+        ticks = s.run_until(
+            converged, 600, "all drifted claims replaced and pods re-bound"
         )
         s.timer.end(
             "drift",
             ticks=ticks,
             replaced=len(original),
             nodes=s.monitor.node_count(),
+            max_concurrent_disruptions=max_tainted,
         )
+        assert max_tainted <= budget, (max_tainted, budget)
         for claim in s.client.list(NodeClaim):
             assert claim.metadata.labels.get("e2e-drift") == "true"
         record("drift_replacement_100", s.timer)

@@ -62,16 +62,25 @@ def launch_price(claim) -> float:
 
 
 def cheapest_feasible(its, claim) -> float:
-    """Cheapest price over ALL catalog types launchable for the claim —
-    the floor every claim's launch price must reach (the reference asserts
-    the node lands on one of the cheapest instances)."""
+    """Cheapest price over catalog types launchable for the claim AND able
+    to host its pod set — the floor every claim's launch price must reach
+    (the reference asserts the node lands on one of the cheapest
+    instances)."""
+    total = (
+        res.merge(*(p.spec.requests for p in claim.pods))
+        if claim.pods
+        else {}
+    )
     best = float("inf")
     for it in its:
-        if claim.requirements.is_compatible(
+        if not claim.requirements.is_compatible(
             it.requirements, labels.WELL_KNOWN_LABELS
         ):
-            p = cp.min_compatible_price(it, claim.requirements)
-            best = min(best, p)
+            continue
+        if not res.fits(total, it.allocatable()):
+            continue
+        p = cp.min_compatible_price(it, claim.requirements)
+        best = min(best, p)
     return best
 
 
@@ -598,3 +607,49 @@ class TestMinValues:
         assert not results.new_node_claims
         for err in results.pod_errors.values():
             assert "minValues" in err and "truncation" in err
+
+
+class TestProviderLabels:
+    """Provider-registered instance labels (karpenter.tpu/instance-*) are
+    well-known: legal in pod selectors and pool requirements, honored at
+    provisioning, and stamped onto launched claims so in-flight capacity
+    matches pre-registration (no double-provisioning)."""
+
+    def test_pool_requirement_passes_validation(self):
+        from karpenter_tpu.api import validation
+
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_CPU_LABEL, "In", ("8", "16")
+                )
+            ]
+        )
+        assert not validation.validate_node_pool(pool)
+
+    def test_no_double_provision_before_registration(self):
+        from karpenter_tpu.api.objects import NodeClaim
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.sim import Binder
+
+        clock = TestClock()
+        client = Client(clock)
+        provider = KwokCloudProvider(client, corpus.generate(24))
+        op = Operator(client, provider)
+        binder = Binder(client)
+        client.create(make_nodepool())
+        pod = make_pod(
+            cpu="1", node_selector={corpus.INSTANCE_CPU_LABEL: "8"}
+        )
+        client.create(pod)
+        counts = []
+        for _ in range(6):
+            op.step(force_provision=True)
+            binder.bind_all()
+            clock.step(1)
+            counts.append(len(client.list(NodeClaim)))
+        # in-flight claims carry the chosen type's labels, so the second
+        # forced cycle packs onto them instead of re-provisioning
+        assert counts == [1] * 6, counts
+        assert pod.spec.node_name

@@ -166,3 +166,9 @@ class TestStoreAndClusterRaces:
             t.join(120)
         assert not errors, errors
         assert len(results) == 20
+        # the adaptive NMAX hint is a max-merge under the cache lock: after
+        # 20 concurrent solves it must hold the LARGEST observed claim
+        # count (a lost update would leave a smaller thread's value and
+        # re-trigger the overflow ladder on the next big solve)
+        hint = cache.cache.get("nmax_hint")
+        assert hint is not None and hint >= max(results), (hint, results)

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Tier-4 static/CI checks (the reference's `make presubmit` analog,
+# Makefile:14,95-124): bytecode-compile every module (syntax/import-time
+# errors), build the native core, compile-check the graft entry points on
+# the virtual CPU mesh, then run the full suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== compileall =="
+python -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
+
+echo "== native build =="
+python -c "from karpenter_tpu import native; native.build(force=True); print('ok')"
+
+echo "== graft entry + multichip dryrun (virtual CPU mesh) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+assert int(out[2]) > 0
+g.dryrun_multichip(8)
+PY
+
+echo "== test suite =="
+python -m pytest tests/ -q
+
+echo "presubmit OK"

@@ -12,6 +12,9 @@ python -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
 echo "== native build =="
 python -c "from karpenter_tpu import native; native.build(force=True); print('ok')"
 
+# deliberately conftest-free: the round driver invokes __graft_entry__
+# directly (no pytest bootstrap), so this validates that exact path even
+# though tests/test_parallel.py covers the same entry points under pytest
 echo "== graft entry + multichip dryrun (virtual CPU mesh) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PY'
 import jax

@@ -112,6 +112,37 @@ def make_nodepool(
     )
 
 
+def make_state_node(
+    name: str = "node-1",
+    cpu: str = "16",
+    memory: str = "64Gi",
+    zone: str = "test-zone-a",
+    extra_labels: Optional[Dict[str, str]] = None,
+):
+    """A ready Node wrapped in a StateNode — the shared scaffold for tests
+    that need existing cluster capacity."""
+    from karpenter_tpu.controllers.state import StateNode
+
+    node = Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                labels_mod.TOPOLOGY_ZONE: zone,
+                labels_mod.HOSTNAME: name,
+                **(extra_labels or {}),
+            },
+        ),
+    )
+    node.status.capacity = {
+        "cpu": res.parse_quantity(cpu),
+        "memory": res.parse_quantity(memory),
+        "pods": res.parse_quantity("110"),
+    }
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.ready = True
+    return StateNode(node=node)
+
+
 def spread_constraint(
     topology_key: str,
     max_skew: int = 1,

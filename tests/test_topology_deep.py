@@ -304,6 +304,70 @@ class TestPodAffinityInterplay:
         )
         assert len(zc) == 3
 
+    def test_inverse_anti_affinity_blocks_selected_pod(self):
+        """Anti-affinity is symmetric: zone-pinned anti pods occupy all
+        three zones, so a plain pod MATCHING their selector cannot land
+        anywhere (topology_test.go:2476 'inverse')."""
+        lbl = {"security": "s2"}
+        anti = [affinity_term(labels.TOPOLOGY_ZONE, lbl)]
+        zoned = [
+            make_pod(
+                cpu="2",
+                pod_anti_affinity=anti,
+                node_selector={labels.TOPOLOGY_ZONE: z},
+            )
+            for z in ("test-zone-a", "test-zone-b", "test-zone-c")
+        ]
+        selected = make_pod(cpu="1", labels=dict(lbl))
+        results = run(zoned + [selected])
+        for p in zoned:
+            assert p.uid not in results.pod_errors
+        assert selected.uid in results.pod_errors
+
+    def test_schroedinger_anti_affinity_blocks_until_committal(self):
+        """An unpinned anti pod could land in ANY zone, so a selected pod
+        in the same batch cannot schedule (topology_test.go:2512); once
+        the anti pod's node is real (zone committed), a later batch
+        schedules the selected pod in a different zone."""
+        from helpers import make_state_node
+
+        lbl = {"security": "s2"}
+        anywhere = make_pod(
+            cpu="2",
+            pod_anti_affinity=[affinity_term(labels.TOPOLOGY_ZONE, lbl)],
+        )
+        selected = make_pod(cpu="1", labels=dict(lbl))
+        results = run([anywhere, selected])
+        assert anywhere.uid not in results.pod_errors
+        assert selected.uid in results.pod_errors
+
+        # second batch: the anti pod is bound to a real node in zone-a —
+        # the selected pod must now schedule, in a different zone
+        sn = make_state_node(name="committed", cpu="4", memory="8Gi")
+        bound = make_pod(
+            cpu="2",
+            pod_anti_affinity=[affinity_term(labels.TOPOLOGY_ZONE, lbl)],
+            node_name="committed",
+            phase="Running",
+        )
+        sn.update_pod(bound, is_daemon=False)
+        client = Client(TestClock())
+        client.create(sn.node)
+        client.create(bound)
+        pools = [make_nodepool()]
+        its_by_pool = {p.name: corpus.generate(20) for p in pools}
+        selected2 = make_pod(cpu="1", labels=dict(lbl))
+        topo = Topology(client, [sn], pools, its_by_pool, [selected2])
+        solver = TpuSolver(
+            pools, its_by_pool, topo, state_nodes=[sn]
+        )
+        results2 = solver.solve([selected2])
+        assert selected2.uid not in results2.pod_errors
+        zones = {
+            zone_of(c) for c in results2.new_node_claims if c.pods
+        }
+        assert zones and "test-zone-a" not in zones
+
     def test_zonal_anti_affinity_late_committal(self):
         """Zonal anti-affinity within ONE batch schedules only one pod:
         the first claim's zone is uncommitted, so the oracle pessimistically

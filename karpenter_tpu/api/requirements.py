@@ -13,6 +13,8 @@ the TPU kernels, and tests assert the two agree.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import random
 from enum import Enum
 from typing import Dict, FrozenSet, Iterable, Iterator, List, Optional, Set
@@ -299,7 +301,7 @@ class Requirements:
 
     @classmethod
     def from_labels(cls, labels: Dict[str, str]) -> "Requirements":
-        return cls(*(Requirement(k, Operator.IN, [v]) for k, v in labels.items()))
+        return cls(*(_label_requirement(k, v) for k, v in labels.items()))
 
     def add(self, *requirements: Requirement) -> None:
         for req in requirements:
@@ -464,3 +466,13 @@ def _pod_requirements(pod, include_preferred: bool) -> Requirements:
 def has_preferred_node_affinity(pod) -> bool:
     affinity = pod.spec.node_affinity
     return affinity is not None and bool(affinity.preferred)
+
+
+@lru_cache(maxsize=65536)
+def _label_requirement(key: str, value: str) -> Requirement:
+    """Shared single-value IN requirement for a node label. Requirement
+    objects are never mutated in place (set algebra builds new instances),
+    so one instance per (key, value) serves every ExistingNode/Topology
+    construction — from_labels runs per node per simulation probe, and the
+    re-parse dominated consolidation's host-side profile."""
+    return Requirement(key, Operator.IN, [value])

@@ -115,14 +115,23 @@ def simulate_scheduling(
     candidates: Sequence[Candidate],
     solver_config=None,
     encode_cache=None,
+    state_snapshot=None,
 ) -> Results:
     """Re-run the scheduler as if the candidates were gone
     (helpers.go:49-117): state snapshot minus candidates, their
-    reschedulable pods plus pending pods as the workload."""
+    reschedulable pods plus pending pods as the workload.
+
+    ``state_snapshot`` lets a caller that probes repeatedly (multi-node
+    consolidation's binary search, single-node's sweep) deep-copy the
+    cluster ONCE and share it: solves never mutate StateNodes (the
+    scheduler's ExistingNode model keeps its own fills), and the per-probe
+    copy of a 2k-node cluster dominated the decision's host time."""
     candidate_ids = {c.provider_id for c in candidates}
     state_nodes = [
         sn
-        for sn in cluster.nodes()
+        for sn in (
+            state_snapshot if state_snapshot is not None else cluster.nodes()
+        )
         if sn.provider_id not in candidate_ids
         and not (sn.mark_for_deletion or sn.deleting())
     ]

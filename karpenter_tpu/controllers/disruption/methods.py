@@ -151,10 +151,13 @@ class ConsolidationBase(Method):
 
     # -- the core replacement computation ------------------------------
 
-    def compute_consolidation(self, candidates: List[Candidate]) -> Command:
+    def compute_consolidation(
+        self, candidates: List[Candidate], state_snapshot=None
+    ) -> Command:
         results = simulate_scheduling(
             self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, candidates,
             encode_cache=self.ctx.encode_cache,
+            state_snapshot=state_snapshot,
         )
         if results.pod_errors:
             return Command()
@@ -273,12 +276,14 @@ class MultiNodeConsolidation(ConsolidationBase):
         deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
         lo, hi = 1, len(candidates)
         last_valid = Command()
+        # one cluster snapshot serves every probe of the binary search
+        snapshot = self.ctx.cluster.nodes()
         while lo <= hi:
             if self.ctx.clock.now() >= deadline:
                 break
             mid = (lo + hi) // 2
             subset = candidates[:mid]
-            cmd = self.compute_consolidation(subset)
+            cmd = self.compute_consolidation(subset, state_snapshot=snapshot)
             # don't replace nodes with the same type we're deleting
             # (filterOutSameType, multinodeconsolidation.go:185-222)
             if cmd.decision == "replace":
@@ -343,12 +348,15 @@ class SingleNodeConsolidation(ConsolidationBase):
         deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
         seen_pools: set = set()
         timed_out = False
+        # one cluster snapshot serves the whole per-candidate sweep; taken
+        # lazily so budget-exhausted reconciles don't pay the deep copy
+        snapshot = self.ctx.cluster.nodes() if budgeted else []
         for c in budgeted:
             if self.ctx.clock.now() >= deadline:
                 timed_out = True
                 break
             seen_pools.add(c.node_pool.name)
-            cmd = self.compute_consolidation([c])
+            cmd = self.compute_consolidation([c], state_snapshot=snapshot)
             if cmd.decision != "no-op":
                 # early success: unseen-pool bookkeeping keeps its prior
                 # value, like the reference's early return

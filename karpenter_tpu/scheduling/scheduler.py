@@ -291,7 +291,7 @@ class Scheduler:
             list(pods), {uid: d.requests for uid, d in self.cached_pod_data.items()}
         )
         pod_errors: Dict[str, str] = {}
-        pods_by_uid = {p.uid: p for p in pods}
+        relaxed_uids: set = set()
         while True:
             pod = queue.pop()
             if pod is None:
@@ -303,6 +303,17 @@ class Scheduler:
             pod_errors[pod.uid] = err
             relaxed = False
             if not err.reserved:
+                if pod.uid not in relaxed_uids:
+                    # relaxation mutates the pod spec, but callers hand us
+                    # LIVE store objects (and disruption probes share pods
+                    # across simulations): mutate a private copy, the way
+                    # the reference's cache-backed client hands its
+                    # scheduler deep copies (preferences.go:38-146 relaxes
+                    # without ever touching the informer's object)
+                    import copy
+
+                    pod = copy.deepcopy(pod)
+                    relaxed_uids.add(pod.uid)
                 relaxed = self.preferences.relax(pod)
                 if relaxed:
                     self.topology.update(pod)

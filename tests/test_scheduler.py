@@ -474,3 +474,48 @@ class TestTopologyOwnership:
         assert results.all_pods_scheduled()
         # plain pods pack together; only the spread pod is zone-pinned
         assert results.node_count() <= 2
+
+
+class TestRelaxationIsolation:
+    def test_relaxation_never_mutates_caller_pods(self):
+        """Preference relaxation works on a private copy: the caller's pod
+        objects (live store objects; pods shared across disruption probes)
+        keep every term (the reference's cache-backed client hands its
+        scheduler deep copies)."""
+        from karpenter_tpu.api.objects import (
+            NodeAffinity, NodeSelectorRequirement, PreferredSchedulingTerm,
+        )
+        from karpenter_tpu.api import labels as labels_mod
+
+        affinity = NodeAffinity(
+            required=[
+                (
+                    NodeSelectorRequirement(
+                        labels_mod.TOPOLOGY_ZONE, "In", ("mars",)
+                    ),
+                ),
+                (
+                    NodeSelectorRequirement(
+                        labels_mod.TOPOLOGY_ZONE, "In", ("test-zone-a",)
+                    ),
+                ),
+            ],
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=10,
+                    requirements=(
+                        NodeSelectorRequirement(
+                            labels_mod.TOPOLOGY_ZONE, "In", ("test-zone-b",)
+                        ),
+                    ),
+                )
+            ],
+        )
+        pod = make_pod()
+        pod.spec.node_affinity = affinity
+        results = solve([pod])
+        # the pod scheduled only because relaxation dropped the mars term
+        assert pod.uid not in results.pod_errors
+        # ...on a COPY: the caller's object is untouched
+        assert len(pod.spec.node_affinity.required) == 2
+        assert len(pod.spec.node_affinity.preferred) == 1

@@ -60,6 +60,10 @@ class DisruptionContext:
     clock: object
     recorder: Recorder
     spot_to_spot_enabled: bool = False
+    # the operator's SolverConfig (backend/mesh selection): every
+    # scheduling simulation this engine runs must use the same solver the
+    # provisioner does
+    solver_config: object = None
     # one catalog-fingerprinted encode cache shared by every scheduling
     # simulation this engine runs: the multi-node binary search's O(log n)
     # probes (methods.py) and the 15s-TTL validation re-simulations all hit

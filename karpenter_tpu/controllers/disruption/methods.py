@@ -109,6 +109,7 @@ class Drift(Method):
             results = simulate_scheduling(
                 self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, [c],
                 encode_cache=self.ctx.encode_cache,
+                solver_config=self.ctx.solver_config,
             )
             if results.pod_errors:
                 continue
@@ -158,6 +159,7 @@ class ConsolidationBase(Method):
             self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider, candidates,
             encode_cache=self.ctx.encode_cache,
             state_snapshot=state_snapshot,
+            solver_config=self.ctx.solver_config,
         )
         if results.pod_errors:
             return Command()

@@ -79,6 +79,7 @@ class Validator:
             self.ctx.cloud_provider,
             [fresh_by_pid[c.provider_id] for c in command.candidates],
             encode_cache=self.ctx.encode_cache,
+            solver_config=self.ctx.solver_config,
         )
         if results.pod_errors:
             return "pods are no longer fully re-schedulable"

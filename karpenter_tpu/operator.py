@@ -61,6 +61,12 @@ class OperatorOptions:
     @classmethod
     def from_options(cls, opts: "Options") -> "OperatorOptions":
         """Map parsed CLI/env Options (options.py) onto the operator knobs."""
+        solver_config = None
+        if opts.solver_backend != "tpu" or opts.solver_mesh:
+            solver_config = SolverConfig(
+                backend=opts.solver_backend,
+                mesh=opts.solver_mesh or None,
+            )
         return cls(
             batch_idle_duration=opts.batch_idle_duration,
             batch_max_duration=opts.batch_max_duration,
@@ -72,6 +78,7 @@ class OperatorOptions:
             leader_election_namespace=opts.leader_election_namespace
             or "kube-system",
             enable_profiling=opts.enable_profiling,
+            solver_config=solver_config,
         )
 
 
@@ -111,6 +118,7 @@ class Operator:
                 clock=self.clock,
                 recorder=self.recorder,
                 spot_to_spot_enabled=self.options.spot_to_spot_consolidation,
+                solver_config=self.options.solver_config,
             ),
             provisioner=self.provisioner,
         )

@@ -134,6 +134,11 @@ class Options:
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
     # kwok-style extension (kwok/options/options.go)
     instance_types_file_path: str = ""
+    # solver: "tpu" (jitted JAX kernels) or "native" (C++ host core);
+    # solver-mesh "auto" shards solves over every local device when more
+    # than one is present (SolverConfig.mesh), "" = single device
+    solver_backend: str = "tpu"
+    solver_mesh: str = ""
 
     def validate(self) -> None:
         if self.log_level not in VALID_LOG_LEVELS:
@@ -144,6 +149,16 @@ class Options:
             raise ValueError("batch-max-duration must be positive")
         if self.batch_idle_duration <= 0:
             raise ValueError("batch-idle-duration must be positive")
+        if self.solver_backend not in ("tpu", "native"):
+            raise ValueError(
+                f"invalid solver backend {self.solver_backend!r},"
+                " must be 'tpu' or 'native'"
+            )
+        if self.solver_mesh not in ("", "auto"):
+            raise ValueError(
+                f"invalid solver mesh {self.solver_mesh!r},"
+                " must be '' or 'auto'"
+            )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -195,6 +210,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--instance-types-file-path", dest="instance_types_file_path",
                    default=_env_str(
                        "INSTANCE_TYPES_FILE_PATH", d.instance_types_file_path))
+    p.add_argument("--solver-backend", dest="solver_backend",
+                   default=_env_str("SOLVER_BACKEND", d.solver_backend))
+    p.add_argument("--solver-mesh", dest="solver_mesh",
+                   default=_env_str("SOLVER_MESH", d.solver_mesh))
     return p
 
 
@@ -220,6 +239,8 @@ def parse_options(argv: Optional[List[str]] = None) -> Options:
         batch_idle_duration=parse_duration(ns.batch_idle_duration),
         feature_gates=FeatureGates.parse(ns.feature_gates),
         instance_types_file_path=ns.instance_types_file_path,
+        solver_backend=ns.solver_backend,
+        solver_mesh=ns.solver_mesh,
     )
     opts.validate()
     return opts

@@ -303,7 +303,9 @@ class Scheduler:
             pod_errors[pod.uid] = err
             relaxed = False
             if not err.reserved:
-                if pod.uid not in relaxed_uids:
+                if pod.uid not in relaxed_uids and _has_relaxable_terms(
+                    pod, self.preferences.tolerate_prefer_no_schedule
+                ):
                     # relaxation mutates the pod spec, but callers hand us
                     # LIVE store objects (and disruption probes share pods
                     # across simulations): mutate a private copy, the way
@@ -326,6 +328,25 @@ class Scheduler:
             existing_nodes=self.existing_nodes,
             pod_errors=pod_errors,
         ).truncate_instance_types()
+
+
+def _has_relaxable_terms(pod: Pod, tolerate_pns: bool) -> bool:
+    """Anything Preferences.relax could mutate (preferences.py): extra
+    required node-affinity OR-terms, preferred terms, ScheduleAnyway
+    spreads, or (when pools taint PreferNoSchedule) the toleration append.
+    Pods with none of these skip the defensive deep copy."""
+    spec = pod.spec
+    na = spec.node_affinity
+    if na is not None and (na.preferred or len(na.required) > 1):
+        return True
+    if spec.preferred_pod_affinity or spec.preferred_pod_anti_affinity:
+        return True
+    if any(
+        t.when_unsatisfiable == "ScheduleAnyway"
+        for t in spec.topology_spread_constraints
+    ):
+        return True
+    return tolerate_pns
 
 
 def _daemon_overhead(nct: NodeClaimTemplate, daemonset_pods: Sequence[Pod]) -> res.ResourceList:

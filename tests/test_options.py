@@ -132,3 +132,34 @@ class TestOperatorOptionsBridge:
         assert oo.node_repair
         assert oo.reserved_capacity
         assert oo.spot_to_spot_consolidation
+
+
+class TestSolverFlags:
+    def test_defaults(self):
+        opts = parse_options([])
+        assert opts.solver_backend == "tpu" and opts.solver_mesh == ""
+        from karpenter_tpu.operator import OperatorOptions
+
+        assert OperatorOptions.from_options(opts).solver_config is None
+
+    def test_native_backend_flag(self):
+        opts = parse_options(["--solver-backend", "native"])
+        from karpenter_tpu.operator import OperatorOptions
+
+        cfg = OperatorOptions.from_options(opts).solver_config
+        assert cfg is not None and cfg.backend == "native"
+
+    def test_mesh_auto_flag(self):
+        opts = parse_options(["--solver-mesh", "auto"])
+        from karpenter_tpu.operator import OperatorOptions
+
+        cfg = OperatorOptions.from_options(opts).solver_config
+        assert cfg is not None and cfg.mesh == "auto"
+
+    def test_invalid_values_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            parse_options(["--solver-backend", "gpu"])
+        with pytest.raises(ValueError):
+            parse_options(["--solver-mesh", "2x4"])

@@ -742,6 +742,43 @@ class TestHostnameAffinity:
         assert not groups and len(rest) == 3
 
 
+class TestDiverseReferenceMix:
+    """The reference's literal 5-class benchmark mix at unit scale: generic
+    + cross-selecting zonal/hostname spread (gates + contributors via the
+    shared-constraint carries) + zonal self-affinity families + hostname
+    anti-affinity — the heaviest encode machinery in one batch, pinned
+    against the oracle (scheduling_benchmark_test.go:236-249)."""
+
+    def test_kernel_matches_oracle(self):
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import diverse_reference_mix
+
+        pods = diverse_reference_mix(800)
+        pools = [example_nodepool()]
+        its_by_pool = {pools[0].name: corpus.generate(60)}
+        cache = EncodeCache()
+
+        def solve(force):
+            topo = Topology(
+                Client(TestClock()), [], pools, its_by_pool, pods
+            )
+            return TpuSolver(
+                pools, its_by_pool, topo,
+                config=SolverConfig(force_oracle=force),
+                encode_cache=cache,
+            ).solve(pods)
+
+        kernel = solve(False)
+        oracle = solve(True)
+        assert len(kernel.pod_errors) == len(oracle.pod_errors) == 0
+        assert kernel.node_count() == oracle.node_count()
+        delta = (
+            kernel.total_price() - oracle.total_price()
+        ) / oracle.total_price()
+        assert delta <= 0.02, delta
+
+
 class TestBootstrapAffinityMerge:
     """Indistinguishable zonal self-affinity families merge into one scan
     step per shape (encode._resolve_topology): with no state nodes and

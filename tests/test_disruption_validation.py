@@ -2,6 +2,7 @@
 
 import pytest
 
+from karpenter_tpu.api import labels
 from karpenter_tpu.api.objects import Budget, NodeClaim, Node, Pod
 from karpenter_tpu.cloudprovider import corpus
 from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
@@ -214,3 +215,83 @@ class TestSingleNodeOrdering:
         ]
         ordered = m.sort_candidates(cands)
         assert [c.node_pool.name for c in ordered] == ["b", "a"]
+
+
+class TestSpotToSpotRule:
+    """consolidation.go:232-305: single-node spot->spot needs >= 15 cheaper
+    spot types (churn protection) and caps launch flexibility at 15;
+    multi-node skips the floor; disabled gate refuses outright."""
+
+    def _method(self, spot_enabled=True):
+        from karpenter_tpu.controllers.disruption.controller import (
+            DisruptionContext,
+        )
+        from karpenter_tpu.controllers.disruption.methods import (
+            SingleNodeConsolidation,
+        )
+        from karpenter_tpu.kube import Client, TestClock
+
+        clock = TestClock()
+        ctx = DisruptionContext(
+            client=Client(clock), cluster=None, cloud_provider=None,
+            clock=clock, recorder=None, spot_to_spot_enabled=spot_enabled,
+        )
+        return SingleNodeConsolidation(ctx)
+
+    def _replacement(self, n_types):
+        from karpenter_tpu.api.requirements import (
+            Operator, Requirement, Requirements,
+        )
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.scheduling.template import NodeClaimTemplate
+        from karpenter_tpu.solver.driver import DecodedClaim
+
+        from helpers import make_nodepool
+
+        its = [
+            corpus.make_instance_type("c", 2, variant=v)
+            for v in range(n_types)
+        ]
+        return DecodedClaim(
+            template=NodeClaimTemplate(make_nodepool()),
+            pods=[],
+            instance_type_options=its,
+            requirements=Requirements(
+                Requirement(
+                    labels.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [labels.CAPACITY_TYPE_SPOT, labels.CAPACITY_TYPE_ON_DEMAND],
+                )
+            ),
+        )
+
+    def test_single_node_needs_15_cheaper_spot_types(self):
+        m = self._method()
+        rep = self._replacement(10)
+        cmd = m._spot_to_spot([object()], rep, candidate_price=1e9)
+        assert cmd.decision == "no-op"
+
+    def test_single_node_caps_flexibility_at_15(self):
+        m = self._method()
+        rep = self._replacement(40)
+        cmd = m._spot_to_spot([object()], rep, candidate_price=1e9)
+        assert cmd.decision == "replace"
+        assert len(cmd.replacements[0].instance_type_options) == 15
+
+    def test_multi_node_skips_the_floor(self):
+        m = self._method()
+        rep = self._replacement(3)
+        cmd = m._spot_to_spot([object(), object()], rep, candidate_price=1e9)
+        assert cmd.decision == "replace"
+
+    def test_gate_off_refuses(self):
+        m = self._method(spot_enabled=False)
+        rep = self._replacement(40)
+        cmd = m._spot_to_spot([object()], rep, candidate_price=1e9)
+        assert cmd.decision == "no-op"
+
+    def test_pricier_types_never_survive(self):
+        m = self._method()
+        rep = self._replacement(40)
+        cmd = m._spot_to_spot([object()], rep, candidate_price=0.0001)
+        assert cmd.decision == "no-op"  # nothing strictly cheaper remains

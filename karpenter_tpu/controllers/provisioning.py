@@ -35,6 +35,10 @@ QUEUE_DEPTH = Gauge("scheduler_queue_depth", "Pods waiting in the batcher")
 PODS_SCHEDULED = Counter("pods_scheduled_total", "Pods placed by the provisioner")
 PODS_UNSCHEDULABLE = Gauge("unschedulable_pods_count", "Pods that failed to schedule")
 NODECLAIMS_CREATED = Counter("nodeclaims_created_total", "NodeClaims created")
+UNFINISHED_WORK = Gauge(
+    "scheduler_unfinished_work_seconds",
+    "Age of the in-flight Solve (scheduling/metrics.go:34-72)",
+)
 
 
 class Batcher:
@@ -211,7 +215,29 @@ class Provisioner:
             volume_resolver=self.volume_resolver,
             reserved_capacity_enabled=self.reserved_capacity_enabled,
         )
-        results = solver.solve(pods)
+        # the in-flight-solve age gauge ticks on a side thread so the
+        # metrics server can observe long solves mid-flight, the way the
+        # reference's ticker does (scheduling/metrics.go:34-72)
+        import threading
+        import time as _time
+
+        stop = threading.Event()
+        wall0 = _time.monotonic()
+
+        def _tick():
+            while not stop.wait(1.0):
+                UNFINISHED_WORK.set(_time.monotonic() - wall0)
+            # the ticker owns the final reset: a pending set() racing a
+            # main-thread reset could otherwise leave the gauge stuck
+            # nonzero between batches
+            UNFINISHED_WORK.set(0.0)
+
+        ticker = threading.Thread(target=_tick, daemon=True)
+        ticker.start()
+        try:
+            results = solver.solve(pods)
+        finally:
+            stop.set()
         SCHEDULING_DURATION.observe(max(self.clock.now() - t0, 0.0))
         PODS_UNSCHEDULABLE.set(float(len(results.pod_errors)))
         scheduled = len(pods) - len(results.pod_errors)

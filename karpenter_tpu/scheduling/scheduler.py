@@ -11,6 +11,8 @@ is parity-tested against this.
 
 from __future__ import annotations
 
+import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -123,6 +125,9 @@ class Results:
         return total
 
 
+_LOG = logging.getLogger("karpenter_tpu.scheduler")
+
+
 class Scheduler:
     def __init__(
         self,
@@ -136,6 +141,7 @@ class Scheduler:
         clock=None,
         volume_resolver=None,
     ):
+        self.clock = clock
         self.volume_resolver = volume_resolver
         # tolerate PreferNoSchedule during relaxation if any pool taints with it
         tolerate_pns = any(
@@ -292,13 +298,31 @@ class Scheduler:
         )
         pod_errors: Dict[str, str] = {}
         relaxed_uids: set = set()
+        # injected clock when provided (the project's clock convention,
+        # kube/clock.py) — tests can then drive the progress threshold
+        _now = self.clock.now if self.clock is not None else time.monotonic
+        solve_start = _now()
+        last_progress = solve_start
+        placed = 0
         while True:
             pod = queue.pop()
             if pod is None:
                 break
+            # the reference logs progress every minute inside long Solves
+            # (scheduler.go:297-300)
+            now = _now()
+            if now - last_progress >= 60.0:
+                last_progress = now
+                _LOG.info(
+                    "computing scheduling decision for provisionable pods: "
+                    "%d placed, elapsed %.0fs",
+                    placed,
+                    now - solve_start,
+                )
             err = self._add(pod)
             if err is None:
                 pod_errors.pop(pod.uid, None)
+                placed += 1
                 continue
             pod_errors[pod.uid] = err
             relaxed = False

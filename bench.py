@@ -261,7 +261,130 @@ def run_consolidation(n_nodes: int) -> Dict:
     }
 
 
+def _entry_key(e: Dict) -> tuple:
+    return (e.get("config"), e.get("pods"), e.get("types"), e.get("nodes"))
+
+
+def compare_grids(
+    old_path: str, new_path: str, max_regression: float = 0.20
+) -> int:
+    """benchstat-style per-config comparison of two bench_grid.json files
+    (the reference documents benchstat as its perf workflow,
+    scheduling_benchmark_test.go:57-69). Exits nonzero when any matching
+    config's best_ms regresses by more than ``max_regression``.
+
+    Grids from different platforms (a CPU-fallback run vs a TPU run) are
+    reported but never enforced — the delta would be meaningless.
+    """
+    try:
+        with open(old_path) as fh:
+            old = json.load(fh)
+        with open(new_path) as fh:
+            new = json.load(fh)
+    except (OSError, ValueError) as exc:
+        # a truncated grid (crash mid-write) must not wedge the gate
+        print(f"bench-compare: unreadable grid ({exc}); skipping",
+              file=sys.stderr)
+        return 0
+    old_by_key = {_entry_key(e): e for e in old.get("grid", [])}
+    same_platform = old.get("platform") == new.get("platform")
+    if not same_platform:
+        print(
+            f"bench-compare: platform mismatch ({old.get('platform')} vs"
+            f" {new.get('platform')}) — informational only, not enforced",
+            file=sys.stderr,
+        )
+    print(
+        f"{'config':<28} {'old ms':>10} {'new ms':>10} {'delta':>8}",
+        file=sys.stderr,
+    )
+    worst = 0.0
+    matched = 0
+    for e in new.get("grid", []):
+        o = old_by_key.get(_entry_key(e))
+        if o is None or not o.get("best_ms") or not e.get("best_ms"):
+            continue
+        matched += 1
+        delta = (e["best_ms"] - o["best_ms"]) / o["best_ms"]
+        worst = max(worst, delta)
+        name = f"{e['config']}-{e.get('pods') or e.get('nodes')}x{e.get('types') or ''}"
+        flag = "  <-- REGRESSION" if delta > max_regression else ""
+        print(
+            f"{name:<28} {o['best_ms']:>10.1f} {e['best_ms']:>10.1f}"
+            f" {delta:>+7.1%}{flag}",
+            file=sys.stderr,
+        )
+    if not matched:
+        print("bench-compare: no matching configs", file=sys.stderr)
+        return 0
+    if same_platform and worst > max_regression:
+        print(
+            f"bench-compare: worst regression {worst:+.1%} exceeds"
+            f" {max_regression:.0%} bound",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def record_floors() -> None:
+    """Measure the in-test floor configs on THIS platform and write
+    bench_floors.json; tests/test_perf_floor.py asserts half the recorded
+    throughput thereafter (the grid-pinned floor VERDICT r4 asked for)."""
+    plat, _ = init_backend()
+    import time as _t
+
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.workloads import constrained_mix, mixed_pods
+
+    def measure(pods):
+        pools = [example_nodepool()]
+        its = {pools[0].name: corpus.generate(100)}
+
+        def one():
+            topo = Topology(Client(TestClock()), [], pools, its, pods)
+            s = TpuSolver(pools, its, topo)
+            t0 = _t.perf_counter()
+            s.solve(pods)
+            return _t.perf_counter() - t0
+
+        one(); one()  # a-priori + adaptive shape warm-ups
+        return len(pods) / min(one(), one())
+
+    floors = {
+        "mixed-500": round(measure(mixed_pods(500, gpu_fraction=0.0)), 1),
+        "mixed-2000": round(measure(mixed_pods(2000, gpu_fraction=0.0)), 1),
+        "constrained-2000": round(measure(constrained_mix(2000)), 1),
+    }
+    path = os.path.join(os.path.dirname(__file__) or ".", "bench_floors.json")
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        data = {}
+    data[plat] = floors
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1)
+    print(f"bench: recorded {plat} floors: {floors}", file=sys.stderr)
+
+
 def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--record-floors":
+        record_floors()
+        return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--compare":
+        # bench.py --compare old_grid.json [new_grid.json]
+        old = sys.argv[2]
+        new = (
+            sys.argv[3]
+            if len(sys.argv) > 3
+            else os.path.join(os.path.dirname(__file__) or ".", "bench_grid.json")
+        )
+        sys.exit(compare_grids(old, new))
     plat, fell_back = init_backend()
     full_grid = os.environ.get("BENCH_FULL_GRID", "1") != "0"
 
@@ -338,7 +461,14 @@ def _emit(plat: str, fell_back: bool, grid: List[Dict], headline: Dict) -> None:
             + " ".join(f"{k}={v}" for k, v in e.items() if v is not None),
             file=sys.stderr,
         )
-    with open(os.path.join(os.path.dirname(__file__) or ".", "bench_grid.json"), "w") as fh:
+    grid_path = os.path.join(
+        os.path.dirname(__file__) or ".", "bench_grid.json"
+    )
+    # keep the previous grid for mechanical regression comparison
+    # (`bench.py --compare bench_grid_prev.json`)
+    if os.path.exists(grid_path):
+        os.replace(grid_path, grid_path.replace(".json", "_prev.json"))
+    with open(grid_path, "w") as fh:
         json.dump({"platform": plat, "grid": grid}, fh, indent=1)
 
     value = headline["pods_per_sec"]

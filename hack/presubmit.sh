@@ -30,4 +30,11 @@ PY
 echo "== test suite =="
 python -m pytest tests/ -q
 
+# mechanical perf-regression gate (benchstat analog): enforced when a
+# previous same-platform grid exists next to the current one
+if [[ -f bench_grid_prev.json && -f bench_grid.json ]]; then
+  echo "== bench grid comparison =="
+  python bench.py --compare bench_grid_prev.json bench_grid.json
+fi
+
 echo "presubmit OK"

@@ -79,6 +79,29 @@ def greedy_prefix_fill(cap, n):
     return jnp.clip(n - before, 0, cap)
 
 
+def waterfill1(npods, cap, n, iters: int = 32):
+    """waterfill with a serial-free fast path for n <= 1.
+
+    For n == 1 the water level is trivially min(npods over cap>0 slots) and
+    the single pod lands on the first least-loaded slot — an argmin/one_hot
+    instead of ``iters`` serial bisection trips (the dominant per-step
+    latency for batches of tiny groups, e.g. the reference's diverse mix
+    where the median group is a singleton). For n == 0 both paths return
+    zeros. Bit-exact with waterfill: bisection's deficit hand-out breaks
+    ties by slot index, exactly argmin's tie rule.
+    """
+
+    def _fast(_):
+        elig = cap > 0
+        tstar = jnp.argmin(jnp.where(elig, npods, _BIGI))
+        fills = jax.nn.one_hot(tstar, npods.shape[0], dtype=jnp.int32)
+        return jnp.where((n >= 1) & jnp.any(elig), fills, 0)
+
+    return jax.lax.cond(
+        n <= 1, _fast, lambda _: waterfill(npods, cap, n, iters=iters), None
+    )
+
+
 def waterfill(npods, cap, n, iters: int = 32):
     """Distribute n pods to slots, always to the least-loaded slot with
     remaining capacity (ties by slot index). Returns fills [NSLOTS] int32.
@@ -90,12 +113,13 @@ def waterfill(npods, cap, n, iters: int = 32):
     f(L) = sum(clip(L - npods, 0, cap)) >= n by bisection, then hand the
     deficit layer out by slot index.
 
-    ``iters`` (static) is the bisection trip count: 32 covers any int32
-    level; the driver passes ceil(log2(level bound)) + 1 when it can prove
-    a tighter per-snapshot bound (each trip is a serial [NSLOTS] reduction,
-    so on a scan-step critical path trimmed trips are real latency). The
-    search range starts at the max level over slots with cap > 0 — dead
-    slots often carry _BIGI sentinels in npods and must not inflate it.
+    The bisection runs as a converge-early while_loop: the search range
+    starts at the max level over slots with cap > 0 (dead slots often
+    carry _BIGI sentinels in npods and must not inflate it), so trips are
+    ceil(log2(hi0)) for the ACTUAL level bound of this call — single-digit
+    for the small counts/priors that dominate fragmented batches — rather
+    than a static worst case. ``iters`` is kept as a hard ceiling (each
+    trip is a serial [NSLOTS] reduction on the scan-step critical path).
     """
     n = jnp.minimum(n, jnp.sum(cap))
 
@@ -104,14 +128,18 @@ def waterfill(npods, cap, n, iters: int = 32):
 
     hi0 = jnp.max(jnp.where(cap > 0, npods + cap, 0)) + 1
 
-    def body(_, lo_hi):
-        lo, hi = lo_hi
+    def cond(carry):
+        i, lo, hi = carry
+        return (hi - lo > 1) & (i < iters)
+
+    def body(carry):
+        i, lo, hi = carry
         mid = (lo + hi) // 2
         ge = f(mid) >= n
-        return jnp.where(ge, lo, mid), jnp.where(ge, mid, hi)
+        return i + 1, jnp.where(ge, lo, mid), jnp.where(ge, mid, hi)
 
-    lo, hi = jax.lax.fori_loop(
-        0, iters, body, (jnp.int32(0), hi0.astype(jnp.int32))
+    _, lo, hi = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int32(0), hi0.astype(jnp.int32))
     )
     level = hi  # smallest L with f(L) >= n
     base = jnp.clip((level - 1) - npods, 0, cap)
@@ -1029,4 +1057,874 @@ def pack(
     state, (exist_fills, claim_fills, unplaced) = jax.lax.scan(
         step, state, (jnp.arange(G),)
     )
+    return state, exist_fills, claim_fills, unplaced
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "nmax", "lmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
+        "tile_feasibility", "wf_iters",
+    ),
+)
+def pack_classed(
+    # groups (FFD order) — identical layout to pack()
+    g_count, g_req, g_def, g_neg, g_mask,
+    g_hcap, g_haff,
+    g_dmode, g_dkey, g_dskew, g_dmin0,
+    g_dprior, g_dreg, g_drank,
+    g_hstg, g_hscap, g_dtg,
+    g_hself, g_hcontrib, g_dcontrib,
+    compat_pg, type_ok_pgt, n_fit_pgt,
+    cap_ng,
+    t_alloc, t_cap,
+    a_tzc, res_cap0, a_res,
+    p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol, p_titype_ok,
+    t_def, t_mask,
+    o_avail, o_zone, o_ct,
+    n_def, n_mask, n_avail, n_base, n_tol,
+    n_hcnt, n_dzone, n_dct,
+    nh_cnt0, dd0, dtg_key,
+    well_known,
+    # class partition (driver-computed): groups sorted FFD fall into
+    # contiguous runs with identical feasibility rows (same requests,
+    # requirement masks, tolerations) — the FFD key IS the class key
+    class_start, class_len,  # [C] int32
+    class_dyn,  # [C] bool — any member carries a domain-keyed constraint
+    class_dkey,  # [C] int32 — the (single) dynamic axis of the class
+    inv_idx,  # [G] int32 — row of (class, member) holding group gi's fills
+    nmax: int,
+    lmax: int,
+    zone_kid: int,
+    ct_kid: int,
+    has_domains: bool = True,
+    has_contrib: bool = False,
+    tile_feasibility: bool = False,
+    wf_iters: int = 32,
+):
+    """pack() restructured as a scan over feasibility CLASSES.
+
+    Batches like the reference's diverse 5-class mix
+    (scheduling_benchmark_test.go:236-249) fragment into ~2,000 tiny groups
+    — one scan step each in pack() — because the group key includes the
+    label signature feeding cross-group topology selectors. But those
+    groups share ~30 distinct (requests, requirements, tolerations)
+    signatures, and the FFD sort key (cpu desc, mem desc) makes class
+    members CONTIGUOUS in scan order. This kernel runs one scan step per
+    class: the expensive class-invariant tables (feasibility rows, the
+    offering einsums, the per-domain [NMAX, T, V1] availability) are
+    computed once at the class head, and an inner fori_loop places each
+    member group with cheap incremental maintenance:
+
+    - ``add_fit``/``exist_cap`` shift by exact integer decrements — all
+      members request the same vector, so filling k pods lowers every
+      fits_count by exactly k (quantized requests are integer-valued f32
+      well inside the 2^24 exact range, so the float floor identity holds);
+    - claims touched within the class merge the SAME requirement masks, so
+      the head's compatibility/offering rows stay valid; claims pinned or
+      opened mid-class get their rows by O(NMAX·T) selects from the head
+      tables instead of fresh einsums.
+
+    Placement semantics are bit-identical to pack() — same member order,
+    same fills, same carries (asserted kernel-vs-kernel by
+    tests/test_classed_kernel.py). The reservation ledger makes offering
+    availability evolve across members, so the driver routes NRES > 0
+    batches to pack().
+    """
+    G = g_count.shape[0]
+    C = class_start.shape[0]
+    P, T = p_titype_ok.shape
+    N = n_avail.shape[0]
+    R = t_alloc.shape[1]
+    K, V1 = g_mask.shape[1], g_mask.shape[2]
+    NSLOT = V1 + 2
+    ANY, DEAD = V1, V1 + 1
+    NRES = res_cap0.shape[0]
+    assert NRES == 0, "pack_classed requires an empty reservation ledger"
+
+    a_tzc_f = a_tzc.astype(jnp.float32)
+
+    state = PackState(
+        exist_used=n_base,
+        c_used=jnp.zeros((nmax, R), jnp.float32),
+        c_npods=jnp.zeros((nmax,), jnp.int32),
+        c_active=jnp.zeros((nmax,), bool),
+        c_pool=jnp.zeros((nmax,), jnp.int32),
+        c_tmask=jnp.zeros((nmax, T), bool),
+        c_def=jnp.zeros((nmax, K), bool),
+        c_neg=jnp.zeros((nmax, K), bool),
+        c_mask=jnp.ones((nmax, K, V1), bool),
+        c_dzone=jnp.full((nmax,), -1, jnp.int32),
+        c_dct=jnp.full((nmax,), -1, jnp.int32),
+        ch_cnt=jnp.zeros((nmax, nh_cnt0.shape[1]), jnp.int32),
+        nhc=nh_cnt0.astype(jnp.int32),
+        ddc=dd0.astype(jnp.int32),
+        res_rem=res_cap0.astype(jnp.int32),
+        c_resv=jnp.zeros((nmax,), bool),
+        pool_rem=p_limit,
+        n_open=jnp.int32(0),
+        overflow=jnp.bool_(False),
+    )
+
+    if tile_feasibility:
+        t_neg_z = jnp.zeros_like(t_def)
+
+    slots = jnp.arange(nmax, dtype=jnp.int32)
+    JH = nh_cnt0.shape[1]
+    JD = dd0.shape[0]
+
+    def _class_body(state: PackState, cs, cl, cdyn, cdk):
+        # ---- class-invariant head tables (one set per ~60 members) ------
+        gih = cs
+        req = g_req[gih]
+        gdef, gneg, gmask = g_def[gih], g_neg[gih], g_mask[gih]
+        if tile_feasibility:
+            # tiled HBM mode: one row computation per CLASS, not per group
+            c_defm, c_negm, c_maskm = merge_requirements(
+                p_def, p_neg, p_mask,
+                gdef[None, :], gneg[None, :], gmask[None, :, :],
+            )
+            compat_row = p_tol[:, gih] & requirements_compatible(
+                p_def, p_neg, p_mask,
+                gdef[None, :], gneg[None, :], gmask[None, :, :], well_known,
+            )
+            type_compat = requirements_intersect(
+                t_def[None, :, :], t_neg_z[None, :, :], t_mask[None, :, :, :],
+                c_defm[:, None, :], c_negm[:, None, :], c_maskm[:, None, :, :],
+            )
+            off_row_p = offering_ok(
+                c_maskm[:, None, zone_kid, :], c_maskm[:, None, ct_kid, :],
+                o_avail[None, :, :], o_zone[None, :, :], o_ct[None, :, :],
+            )
+            n_fit_row = fits_count(
+                t_alloc[None, :, :], p_daemon[:, None, :], req[None, None, :]
+            )
+            type_ok_row = (
+                type_compat
+                & off_row_p
+                & (n_fit_row >= 1)
+                & p_titype_ok
+                & compat_row[:, None]
+            )
+            if N:
+                n_neg_z = jnp.zeros_like(n_def)
+                ncompat = requirements_compatible(
+                    n_def, n_neg_z, n_mask,
+                    gdef[None, :], gneg[None, :], gmask[None, :, :],
+                    jnp.zeros_like(well_known),
+                )
+                ncap = fits_count(n_avail, n_base, req[None, :])
+                cap_row = jnp.where(ncompat & n_tol[:, gih], ncap, 0)
+            else:
+                cap_row = jnp.zeros((0,), jnp.int32)
+        else:
+            compat_row = compat_pg[:, gih]  # [P]
+            type_ok_row = type_ok_pgt[:, gih, :]  # [P, T]
+            n_fit_row = n_fit_pgt[:, gih, :]  # [P, T]
+            cap_row = cap_ng[:, gih]  # [N]
+
+        gz = gmask[zone_kid]  # [V1]
+        gc = gmask[ct_kid]
+        # claim-side merged-mask previews: within the class every touch
+        # merges the SAME gmask, so these rows are valid for all members
+        cz0 = jnp.take(state.c_mask, zone_kid, axis=1) & gz[None, :]
+        cc0 = jnp.take(state.c_mask, ct_kid, axis=1) & gc[None, :]
+        pzm = p_mask[:, zone_kid, :] & gz[None, :]  # [P, V1]
+        pcm = p_mask[:, ct_kid, :] & gc[None, :]
+
+        # head offering admissibility for every open claim, and the
+        # group-mask-only row every claim OPENED this class will carry
+        # (a fresh claim's mask is gmask, so its einsum row is off_grp)
+        off0 = (
+            jnp.einsum(
+                "nz,tzc,nc->nt",
+                cz0.astype(jnp.float32), a_tzc_f, cc0.astype(jnp.float32),
+            )
+            > 0
+        )  # [NMAX, T]
+        off_grp = (
+            jnp.einsum(
+                "z,tzc,c->t",
+                gz.astype(jnp.float32), a_tzc_f, gc.astype(jnp.float32),
+            )
+            > 0
+        )  # [T]
+
+        if has_domains:
+            # per-domain availability on the class's dynamic axis — ONE
+            # [NMAX, T, V1] contraction per class (pack() pays it per
+            # dynamic group); toff_grp is the fresh-claim row analog
+            def _mk_toff(_):
+                def _axis(n_spec, p_spec, g_spec, n_con, n_and, p_con, p_and,
+                          g_con, g_and):
+                    def branch(_):
+                        av = (
+                            jnp.einsum(
+                                n_spec, n_con.astype(jnp.float32), a_tzc_f
+                            )
+                            > 0
+                        )
+                        pav = (
+                            jnp.einsum(
+                                p_spec, p_con.astype(jnp.float32), a_tzc_f
+                            )
+                            > 0
+                        )
+                        gav = (
+                            jnp.einsum(
+                                g_spec, g_con.astype(jnp.float32), a_tzc_f
+                            )
+                            > 0
+                        )
+                        return (
+                            av & n_and[:, None, :],
+                            pav & p_and[:, None, :],
+                            gav & g_and[None, :],
+                        )
+
+                    return branch
+
+                return jax.lax.cond(
+                    cdk == 0,
+                    _axis("nc,tzc->ntz", "pc,tzc->ptz", "c,tzc->tz",
+                          cc0, cz0, pcm, pzm, gc, gz),
+                    _axis("nz,tzc->ntc", "pz,tzc->ptc", "z,tzc->tc",
+                          cz0, cc0, pzm, pcm, gz, gc),
+                    None,
+                )
+
+            def _no_toff(_):
+                return (
+                    jnp.zeros((nmax, T, V1), bool),
+                    jnp.zeros((P, T, V1), bool),
+                    jnp.zeros((T, V1), bool),
+                )
+
+            toff_nt0, toff_pt, toff_grp = jax.lax.cond(
+                cdyn, _mk_toff, _no_toff, None
+            )
+            # hoisted: fresh-feasible domains (class-static in pack() too)
+            fresh_ok_d0 = jnp.any(
+                type_ok_row[:, :, None] & toff_pt, axis=(0, 1)
+            )  # [V1]
+        else:
+            toff_nt0 = toff_pt = toff_grp = None
+            fresh_ok_d0 = None
+
+        # head incremental tables
+        exist_cap0 = (
+            jnp.where(
+                cap_row > 0,
+                fits_count(n_avail, state.exist_used, req[None, :]),
+                0,
+            )
+            if N
+            else jnp.zeros((0,), jnp.int32)
+        )
+        add_fit0 = fits_count(
+            t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
+        )  # [NMAX, T]
+        # head claim compatibility (invariant under same-class touches:
+        # merging identical requirement rows never flips these tests)
+        overlap = jnp.any(state.c_mask & gmask[None, :, :], axis=-1)
+        exempt = state.c_neg & gneg[None, :]
+        key_ok = overlap | exempt | ~(state.c_def & gdef[None, :])
+        custom_ok = jnp.all(
+            ~gdef[None, :] | well_known[None, :] | state.c_def | gneg[None, :],
+            axis=-1,
+        )
+        live0 = (
+            jnp.all(key_ok, axis=-1)
+            & custom_ok
+            & p_tol[state.c_pool, gih]
+            & compat_row[state.c_pool]
+        )  # [NMAX] — c_active applied per member (opens flip it mid-class)
+        tor0 = type_ok_row[state.c_pool]  # [NMAX, T]
+
+        # snapshots for pin-on-read and opened-this-class classification
+        n_open0 = state.n_open
+        pin0_rel = jnp.where(cdk == 0, state.c_dzone, state.c_dct)
+        kid_sel = jnp.where(cdk == 0, zone_kid, ct_kid)
+
+        def _member_body(j, state: PackState, exist_cap, add_fit, live, tor):
+            gi = cs + j
+            count = g_count[gi]
+            hcap = g_hcap[gi]
+            haff = g_haff[gi]
+            jh = g_hstg[gi]
+            has_h = jh >= 0
+            hself = g_hself[gi]
+            jhc = jnp.clip(jh, 0, JH - 1)
+            jh_oh = (
+                jax.nn.one_hot(jhc, JH, dtype=jnp.int32) * (has_h & hself)
+            )
+            scap_h = g_hscap[gi]
+
+            def _h_allow(cnt):
+                return jnp.where(
+                    has_h,
+                    jnp.where(
+                        hself,
+                        jnp.maximum(scap_h - cnt, 0),
+                        jnp.where(cnt > scap_h, 0, _BIGI),
+                    ),
+                    _BIGI,
+                )
+
+            jd = g_dtg[gi]
+            has_d = jd >= 0
+            jdc = jnp.clip(jd, 0, JD - 1)
+            mode = g_dmode[gi]
+            dyn = mode > 0
+            skew = g_dskew[gi]
+            min0 = g_dmin0[gi]
+            D0 = g_dprior[gi] + jnp.where(has_d, state.ddc[jdc], 0)
+            reg = g_dreg[gi]
+            drank = g_drank[gi]
+
+            # effective offering/per-domain rows: head rows for claims that
+            # existed at class start, select-derived rows for claims opened
+            # or pinned during the class (see pack()'s per-step einsums —
+            # these selects reproduce them exactly for same-class masks)
+            is_new = slots >= n_open0
+            pin_rel = jnp.where(cdk == 0, state.c_dzone, state.c_dct)
+            if has_domains:
+                pinc = jnp.clip(pin_rel, 0, V1 - 1)
+                newpin = (pin_rel >= 0) & (pin_rel != pin0_rel) & ~is_new
+                toff_at_pin = jnp.take_along_axis(
+                    toff_nt0, pinc[:, None, None], axis=2
+                )[..., 0]  # [NMAX, T]
+                grp_at_pin = jnp.take(toff_grp.T, pinc, axis=0)  # [NMAX, T]
+                off_new = jnp.where(
+                    (pin_rel >= 0)[:, None], grp_at_pin, off_grp[None, :]
+                )
+                off_eff = jnp.where(
+                    is_new[:, None],
+                    off_new,
+                    jnp.where(newpin[:, None], toff_at_pin, off0),
+                )
+            else:
+                off_eff = jnp.where(is_new[:, None], off_grp[None, :], off0)
+
+            # ---- 1. existing nodes --------------------------------------
+            e_cap = jnp.minimum(
+                exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0)
+            )
+            if N:
+                e_cap = jnp.minimum(e_cap, _h_allow(state.nhc[:, jhc]))
+                prior_nodes = n_hcnt[:, gi] > 0
+                haff_has_prior = jnp.any(prior_nodes)
+                free = e_cap >= 1
+                haff_has_free = jnp.any(free)
+                pin_oh = jax.nn.one_hot(
+                    jnp.argmax(free), N, dtype=e_cap.dtype
+                )
+                haff_cap = jnp.where(
+                    haff_has_prior,
+                    jnp.where(prior_nodes, e_cap, 0),
+                    jnp.where(haff_has_free, pin_oh * e_cap, 0),
+                )
+                e_cap = jnp.where(haff, haff_cap, e_cap)
+                haff_exist_served = haff & (haff_has_prior | haff_has_free)
+            else:
+                haff_exist_served = jnp.bool_(False)
+
+            if has_domains:
+                nd_raw = jnp.where(cdk == 0, n_dzone, n_dct)  # [N]
+                nd_ok = (nd_raw >= 0) & jnp.take(
+                    reg, jnp.clip(nd_raw, 0, V1 - 1)
+                )
+                nd_slot = jnp.where(dyn, jnp.where(nd_ok, nd_raw, DEAD), ANY)
+                nd_oh = jax.nn.one_hot(nd_slot, NSLOT, dtype=jnp.int32)
+
+                czcap_exist = jnp.sum(e_cap[:, None] * nd_oh, axis=0)[:V1]
+                realcap = jnp.minimum(
+                    czcap_exist + jnp.where(fresh_ok_d0, _BIGI, 0), _BIGI
+                )
+                emax = jnp.where(reg, D0 + realcap, _BIGI)
+                mfloor = jnp.where(min0, 0, jnp.min(emax))
+                lstar = skew + mfloor
+                scap = jnp.minimum(
+                    jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0), count
+                )
+                q_spread = waterfill1(
+                    jnp.where(reg, D0, _BIGI), scap, count, iters=wf_iters
+                )
+
+                if N:
+                    n_elig = (e_cap >= 1) & (nd_slot < V1)
+                    has_exist = jnp.any(n_elig)
+                    first_n = jnp.argmax(n_elig)
+                    d_exist = jnp.clip(nd_raw[first_n], 0, V1 - 1)
+                else:
+                    has_exist = jnp.bool_(False)
+                    d_exist = jnp.int32(0)
+                fresh_feas = fresh_ok_d0 & reg
+                d_fresh = jnp.argmin(jnp.where(fresh_feas, drank, _BIGI))
+                nonempty = (D0 > 0) & reg
+                d_follow = jnp.argmin(jnp.where(nonempty, drank, _BIGI))
+                follow = jnp.any(nonempty)
+                aff_feasible = follow | has_exist | jnp.any(fresh_feas)
+                d_aff = jnp.where(
+                    follow, d_follow, jnp.where(has_exist, d_exist, d_fresh)
+                )
+                q_aff = jnp.where(
+                    aff_feasible,
+                    jax.nn.one_hot(d_aff, V1, dtype=jnp.int32) * count,
+                    jnp.zeros((V1,), jnp.int32),
+                )
+
+                mstat = jnp.where(min0, 0, jnp.min(jnp.where(reg, D0, _BIGI)))
+                allowed_gate = reg & jnp.where(
+                    mode == DMODE_GATE_AFF, D0 > 0, D0 - mstat <= skew
+                )
+                scap_gate = jnp.where(
+                    allowed_gate, jnp.minimum(realcap, count), 0
+                )
+                q_gate = waterfill1(
+                    jnp.where(reg, D0, _BIGI), scap_gate, count,
+                    iters=wf_iters,
+                )
+
+                q_dom = jnp.where(
+                    mode == DMODE_SPREAD,
+                    q_spread,
+                    jnp.where(
+                        mode == DMODE_AFFINITY,
+                        q_aff,
+                        jnp.where(mode >= DMODE_GATE_SPREAD, q_gate, 0),
+                    ),
+                )
+                qd = (
+                    jnp.zeros((NSLOT,), jnp.int32)
+                    .at[:V1]
+                    .set(jnp.where(dyn, q_dom, 0))
+                    .at[ANY]
+                    .set(jnp.where(dyn, 0, count))
+                )
+
+                pre = _cumsum_excl(e_cap[:, None] * nd_oh, axis=0)
+                pre_own = jnp.sum(pre * nd_oh, axis=1)
+                budget = qd[nd_slot]
+                exist_fill = jnp.clip(budget - pre_own, 0, e_cap)
+                qrem = qd - jnp.sum(exist_fill[:, None] * nd_oh, axis=0)
+            else:
+                qd = jnp.zeros((NSLOT,), jnp.int32).at[ANY].set(count)
+                exist_fill = greedy_prefix_fill(e_cap, count)
+                qrem = qd.at[ANY].add(-jnp.sum(exist_fill))
+            qrem = jnp.where(haff_exist_served, jnp.zeros_like(qrem), qrem)
+            exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
+            nhc = state.nhc + exist_fill[:, None] * jh_oh[None, :]
+            exist_cap = exist_cap - exist_fill  # same-req decrement is exact
+
+            # ---- 2. open claims -----------------------------------------
+            claim_live = state.c_active & live
+            add_fit_m = add_fit
+            tm = state.c_tmask & tor & off_eff & (add_fit_m >= 1)
+            cap_any = jnp.where(
+                claim_live,
+                jnp.max(jnp.where(tm, add_fit_m, 0), axis=-1),
+                0,
+            )
+
+            def _clamp(cap):
+                cap = jnp.minimum(cap, hcap)
+                cap = jnp.minimum(cap, count)
+                return jnp.minimum(cap, _h_allow(state.ch_cnt[:, jhc]))
+
+            def _tier2_any(_):
+                c_slot = jnp.full((nmax,), ANY, jnp.int32)
+                claim_cap = _clamp(cap_any)
+                elig = claim_cap >= 1
+                haff_any_claim = haff & jnp.any(elig)
+                tstar = jnp.argmin(jnp.where(elig, state.c_npods, _BIGI))
+                pin = (
+                    jax.nn.one_hot(tstar, nmax, dtype=claim_cap.dtype)
+                    * claim_cap
+                )
+                claim_cap = jnp.where(
+                    haff, jnp.where(haff_any_claim, pin, 0), claim_cap
+                )
+                claim_fill = waterfill1(
+                    state.c_npods, claim_cap, qrem[ANY], iters=wf_iters
+                )
+                qrem2 = qrem.at[ANY].add(-jnp.sum(claim_fill))
+                qrem2 = jnp.where(
+                    haff_any_claim, jnp.zeros_like(qrem2), qrem2
+                )
+                return c_slot, claim_fill, qrem2
+
+            if has_domains:
+
+                def _tier2_domains(_):
+                    pin_keep = (pin_rel < 0)[:, None] | jax.nn.one_hot(
+                        jnp.clip(pin_rel, 0, V1 - 1), V1, dtype=bool
+                    )
+                    toff_eff = (
+                        jnp.where(
+                            is_new[:, None, None],
+                            toff_grp[None, :, :],
+                            toff_nt0,
+                        )
+                        & pin_keep[:, None, :]
+                    )
+                    percap = jnp.max(
+                        jnp.where(
+                            tm[:, :, None] & toff_eff,
+                            add_fit_m[:, :, None],
+                            0,
+                        ),
+                        axis=1,
+                    )
+                    adm = (
+                        claim_live[:, None]
+                        & (percap >= 1)
+                        & (qrem[:V1] > 0)[None, :]
+                    )
+                    d_star = jnp.argmax(
+                        jnp.where(adm, qrem[:V1][None, :], -1), axis=1
+                    )
+                    c_slot = jnp.where(jnp.any(adm, axis=1), d_star, DEAD)
+                    cap_dom = jnp.take_along_axis(
+                        percap, d_star[:, None], axis=1
+                    )[:, 0]
+                    claim_cap = _clamp(jnp.where(c_slot < V1, cap_dom, 0))
+
+                    def wf_slot(slot_idx, slot_budget):
+                        m = c_slot == slot_idx
+                        return waterfill(
+                            jnp.where(m, state.c_npods, _BIGI),
+                            jnp.where(m, claim_cap, 0),
+                            slot_budget,
+                            iters=wf_iters,
+                        )
+
+                    fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)
+                    claim_fill = jnp.sum(fills_sd, axis=0)
+                    return c_slot, claim_fill, qrem - jnp.sum(fills_sd, axis=1)
+
+                c_slot, claim_fill, qrem = jax.lax.cond(
+                    dyn, _tier2_domains, _tier2_any, None
+                )
+            else:
+                c_slot, claim_fill, qrem = _tier2_any(None)
+
+            got = claim_fill > 0
+            c_used = state.c_used + claim_fill[:, None] * req[None, :]
+            c_npods = state.c_npods + claim_fill
+            ch_cnt = state.ch_cnt + claim_fill[:, None] * jh_oh[None, :]
+            c_def = state.c_def | (got[:, None] & gdef[None, :])
+            c_neg = jnp.where(
+                got[:, None], state.c_neg & gneg[None, :], state.c_neg
+            )
+            merged_mask = state.c_mask & gmask[None, :, :]
+            still_fits = add_fit_m >= claim_fill[:, None]
+            surv = tor & off_eff & still_fits
+            if has_domains:
+                tighten = dyn & got & (c_slot < V1)
+                d_oh = jax.nn.one_hot(
+                    jnp.clip(c_slot, 0, V1 - 1), V1, dtype=bool
+                )
+                krow = jax.nn.one_hot(kid_sel, K, dtype=bool)
+                tight_mask = merged_mask & (
+                    ~krow[None, :, None] | d_oh[:, None, :]
+                )
+                c_mask = jnp.where(
+                    got[:, None, None],
+                    jnp.where(tighten[:, None, None], tight_mask, merged_mask),
+                    state.c_mask,
+                )
+                cslotc = jnp.clip(c_slot, 0, V1 - 1)
+                toff_at = jnp.where(
+                    is_new[:, None],
+                    jnp.take(toff_grp.T, cslotc, axis=0),
+                    jnp.take_along_axis(
+                        toff_nt0, cslotc[:, None, None], axis=2
+                    )[..., 0],
+                )
+                surv = surv & jnp.where(tighten[:, None], toff_at, True)
+                pin = cslotc
+                c_dzone2 = jnp.where(tighten & (cdk == 0), pin, state.c_dzone)
+                c_dct2 = jnp.where(tighten & (cdk == 1), pin, state.c_dct)
+            else:
+                c_mask = jnp.where(
+                    got[:, None, None], merged_mask, state.c_mask
+                )
+                c_dzone2, c_dct2 = state.c_dzone, state.c_dct
+            c_tmask = jnp.where(got[:, None], state.c_tmask & surv, state.c_tmask)
+            add_fit = add_fit_m - claim_fill[:, None]
+
+            # ---- 3. fresh claims ----------------------------------------
+            def body(carry):
+                st, qrem, fills, ddead, add_fit, live, tor = carry
+                d_sel = jnp.argmax(jnp.where(ddead, -1, qrem))
+                rem_d = qrem[d_sel]
+                is_any = d_sel == ANY
+                if has_domains:
+                    tdok = jnp.where(
+                        is_any,
+                        jnp.ones((P, T), bool),
+                        toff_pt[:, :, jnp.clip(d_sel, 0, V1 - 1)],
+                    )
+                else:
+                    tdok = jnp.ones((P, T), bool)
+                within_limits = jnp.where(
+                    p_has_limit[:, None],
+                    jnp.all(
+                        t_cap[None, :, :] <= st.pool_rem[:, None, :], axis=-1
+                    ),
+                    True,
+                )
+                avail = type_ok_row & within_limits & tdok
+                feas_p = jnp.any(avail, axis=-1)
+                p_star = jnp.argmax(feas_p)
+                any_feasible = jnp.any(feas_p)
+                n_per = jnp.minimum(
+                    jnp.max(jnp.where(avail[p_star], n_fit_row[p_star], 0)),
+                    hcap,
+                )
+                n_per = jnp.minimum(
+                    n_per, jnp.where(has_h & hself, scap_h, _BIGI)
+                )
+
+                debit = jnp.max(
+                    jnp.where(avail[p_star][:, None], t_cap, 0), axis=0
+                )
+                with_debit = debit > 0
+                k_limit = jnp.where(
+                    p_has_limit[p_star],
+                    jnp.min(
+                        jnp.where(
+                            with_debit,
+                            jnp.floor(
+                                st.pool_rem[p_star]
+                                / jnp.maximum(debit, 1e-9)
+                            ),
+                            jnp.inf,
+                        )
+                    ),
+                    jnp.inf,
+                )
+                k_want = jnp.minimum(
+                    jnp.ceil(rem_d / jnp.maximum(n_per, 1)).astype(jnp.int32),
+                    jnp.where(
+                        jnp.isinf(k_limit), 2**30, k_limit
+                    ).astype(jnp.int32),
+                )
+                slot = st.n_open
+                k_slots = jnp.maximum(nmax - slot, 0)
+                k_want = jnp.where(haff, jnp.minimum(k_want, 1), k_want)
+                k = jnp.minimum(k_want, k_slots)
+                ok = any_feasible & (k > 0) & (n_per > 0)
+                k = jnp.where(ok, k, 0)
+
+                in_bulk = (slots >= slot) & (slots < slot + k)
+                takes = jnp.clip(rem_d - (slots - slot) * n_per, 0, n_per)
+                takes = jnp.where(in_bulk, takes, 0)
+                placed = jnp.sum(takes)
+
+                tmask_new = avail[p_star] & (
+                    n_fit_row[p_star] >= takes[:, None]
+                )
+                used_new = (
+                    p_daemon[p_star][None, :]
+                    + takes[:, None].astype(jnp.float32) * req[None, :]
+                )
+                if has_domains:
+                    kr = jax.nn.one_hot(kid_sel, K, dtype=bool)
+                    open_mask = jnp.where(
+                        dyn & ~is_any,
+                        gmask
+                        & (
+                            ~kr[:, None]
+                            | jax.nn.one_hot(
+                                jnp.clip(d_sel, 0, V1 - 1), V1, dtype=bool
+                            )[None, :]
+                        ),
+                        gmask,
+                    )
+                    d_pin = jnp.where(
+                        dyn & ~is_any, jnp.clip(d_sel, 0, V1 - 1), -1
+                    )
+                else:
+                    open_mask = gmask
+                    d_pin = jnp.int32(-1)
+                write = lambda arr, val: jnp.where(
+                    _bcast(in_bulk, arr.ndim), val, arr
+                )
+                pool_rem = jnp.where(
+                    ok & p_has_limit[p_star],
+                    st.pool_rem.at[p_star].add(-debit * k.astype(jnp.float32)),
+                    st.pool_rem,
+                )
+                st = st._replace(
+                    c_used=write(st.c_used, used_new),
+                    c_npods=write(st.c_npods, takes),
+                    c_active=write(st.c_active, True),
+                    c_pool=write(st.c_pool, p_star),
+                    c_tmask=write(st.c_tmask, tmask_new),
+                    c_def=write(st.c_def, gdef[None, :]),
+                    c_neg=write(st.c_neg, gneg[None, :]),
+                    c_mask=write(st.c_mask, open_mask[None, :, :]),
+                    c_dzone=write(
+                        st.c_dzone, jnp.where(cdk == 0, d_pin, -1)
+                    ),
+                    c_dct=write(st.c_dct, jnp.where(cdk == 1, d_pin, -1)),
+                    ch_cnt=write(st.ch_cnt, takes[:, None] * jh_oh[None, :]),
+                    pool_rem=pool_rem,
+                    n_open=slot + k,
+                    overflow=st.overflow
+                    | (any_feasible & (n_per > 0) & (k_want > k_slots)),
+                )
+                # maintained-table rows for the slots just opened (later
+                # members read them): fits under the bulk's takes, and the
+                # class-invariant type row of the chosen template
+                add_fit = write(add_fit, n_fit_row[p_star][None, :] - takes[:, None])
+                live = live | in_bulk
+                tor = write(tor, type_ok_row[p_star][None, :])
+                fills = fills + takes
+                qrem = qrem.at[d_sel].add(-placed)
+                ddead = ddead.at[d_sel].set(
+                    ddead[d_sel] | (placed == 0) | haff
+                )
+                return st, qrem, fills, ddead, add_fit, live, tor
+
+            def cond2(carry):
+                st, qrem, fills, ddead, _af, _lv, _tr = carry
+                return jnp.any((qrem > 0) & ~ddead) & ~st.overflow
+
+            new_state = state._replace(
+                exist_used=exist_used,
+                c_used=c_used,
+                c_npods=c_npods,
+                c_def=c_def,
+                c_neg=c_neg,
+                c_mask=c_mask,
+                c_tmask=c_tmask,
+                c_dzone=c_dzone2,
+                c_dct=c_dct2,
+                ch_cnt=ch_cnt,
+                nhc=nhc,
+            )
+            ddead0 = jnp.zeros((NSLOT,), bool).at[DEAD].set(True)
+            (new_state, qrem_fin, claim_fill, _dd, add_fit, live, tor) = (
+                jax.lax.while_loop(
+                    cond2,
+                    body,
+                    (new_state, qrem, claim_fill, ddead0, add_fit, live, tor),
+                )
+            )
+            new_state = new_state._replace(
+                ddc=new_state.ddc.at[jdc].add(
+                    jnp.where(
+                        has_d & (mode < DMODE_GATE_SPREAD),
+                        qd[:V1] - qrem_fin[:V1],
+                        0,
+                    )
+                )
+            )
+            if has_contrib:
+                hrow = g_hcontrib[gi].astype(jnp.int32)
+                drow = g_dcontrib[gi].astype(jnp.int32)
+                if N:
+                    nz_oh = jax.nn.one_hot(
+                        jnp.where(n_dzone >= 0, n_dzone, V1), V1 + 1,
+                        dtype=jnp.int32,
+                    )[:, :V1]
+                    nc_oh = jax.nn.one_hot(
+                        jnp.where(n_dct >= 0, n_dct, V1), V1 + 1,
+                        dtype=jnp.int32,
+                    )[:, :V1]
+                    ze = jnp.sum(exist_fill[:, None] * nz_oh, axis=0)
+                    ce = jnp.sum(exist_fill[:, None] * nc_oh, axis=0)
+                else:
+                    ze = jnp.zeros((V1,), jnp.int32)
+                    ce = jnp.zeros((V1,), jnp.int32)
+                zrow = jnp.take(new_state.c_mask, zone_kid, axis=1)
+                crow = jnp.take(new_state.c_mask, ct_kid, axis=1)
+                z_single = jnp.sum(zrow, axis=1) == 1
+                c_single = jnp.sum(crow, axis=1) == 1
+                zc = jnp.sum(
+                    jnp.where(z_single, claim_fill, 0)[:, None]
+                    * zrow.astype(jnp.int32),
+                    axis=0,
+                )
+                cc_cnt = jnp.sum(
+                    jnp.where(c_single, claim_fill, 0)[:, None]
+                    * crow.astype(jnp.int32),
+                    axis=0,
+                )
+                per_slot = jnp.where(
+                    (dtg_key == 0)[:, None],
+                    (ze + zc)[None, :],
+                    (ce + cc_cnt)[None, :],
+                )
+                new_state = new_state._replace(
+                    nhc=new_state.nhc + exist_fill[:, None] * hrow[None, :],
+                    ch_cnt=new_state.ch_cnt + claim_fill[:, None] * hrow[None, :],
+                    ddc=new_state.ddc + drow[:, None] * per_slot,
+                )
+            unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
+            return new_state, exist_cap, add_fit, live, tor, (
+                exist_fill, claim_fill, unplaced
+            )
+
+        def _member(j, carry):
+            state, exist_cap, add_fit, live, tor, ebuf, cbuf, ubuf = carry
+            gi = cs + j
+
+            def _run(_):
+                st, ec, af, lv, tr, (ef, cf, up) = _member_body(
+                    j, state, exist_cap, add_fit, live, tor
+                )
+                return st, ec, af, lv, tr, ef, cf, up
+
+            def _skip(_):
+                return (
+                    state, exist_cap, add_fit, live, tor,
+                    jnp.zeros((N,), jnp.int32),
+                    jnp.zeros((nmax,), jnp.int32),
+                    jnp.int32(0),
+                )
+
+            st, ec, af, lv, tr, ef, cf, up = jax.lax.cond(
+                g_count[gi] > 0, _run, _skip, None
+            )
+            ebuf = jax.lax.dynamic_update_slice(ebuf, ef[None, :], (j, 0))
+            cbuf = jax.lax.dynamic_update_slice(cbuf, cf[None, :], (j, 0))
+            ubuf = ubuf.at[j].set(up)
+            return st, ec, af, lv, tr, ebuf, cbuf, ubuf
+
+        carry0 = (
+            state, exist_cap0, add_fit0, live0, tor0,
+            jnp.zeros((lmax, N), jnp.int32),
+            jnp.zeros((lmax, nmax), jnp.int32),
+            jnp.zeros((lmax,), jnp.int32),
+        )
+        out = jax.lax.fori_loop(0, cl, _member, carry0)
+        state = out[0]
+        return state, (out[5], out[6], out[7])
+
+    def class_step(state: PackState, xs):
+        cs, cl, cdyn, cdk = xs
+
+        def _skip(st):
+            return st, (
+                jnp.zeros((lmax, N), jnp.int32),
+                jnp.zeros((lmax, nmax), jnp.int32),
+                jnp.zeros((lmax,), jnp.int32),
+            )
+
+        def _run(st):
+            return _class_body(st, cs, cl, cdyn, cdk)
+
+        return jax.lax.cond(cl > 0, _run, _skip, state)
+
+    state, (ebufs, cbufs, ubufs) = jax.lax.scan(
+        class_step, state, (class_start, class_len, class_dyn, class_dkey)
+    )
+    # scatter per-(class, member) rows back to the group axis
+    exist_fills = ebufs.reshape(C * lmax, N)[inv_idx]
+    claim_fills = cbufs.reshape(C * lmax, nmax)[inv_idx]
+    unplaced = ubufs.reshape(C * lmax)[inv_idx]
     return state, exist_fills, claim_fills, unplaced

@@ -5,20 +5,80 @@ round-trip latency regardless of compute, so the feasibility tables
 (ops/feasibility.py) and the packing scan (ops/packing.py) are fused into a
 single jitted call: one host->device transfer of the snapshot, one dispatch,
 one device->host readback of the (small) placement matrices.
+
+Two kernel variants share everything but the scan structure: solve_core
+drives the per-group scan (pack), solve_core_classed the class-batched scan
+(pack_classed) the driver routes fragmented batches to.
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 from .feasibility import existing_node_feasibility, fresh_claim_feasibility
-from .packing import pack
+from .packing import pack, pack_classed
 
 
-def solve_core(
+def _feasibility_tables(
+    g_count, g_def, g_neg, g_mask, g_req,
+    p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+    t_def, t_mask, t_alloc,
+    o_avail, o_zone, o_ct,
+    n_def, n_mask, n_avail, n_base, n_tol,
+    well_known,
+    zone_kid: int,
+    ct_kid: int,
+    tile_feasibility: bool,
+):
+    """The precomputed [P,G(,T)] / [N,G] tables both kernels consume — or
+    zero-G placeholders in the tiled HBM-scaling mode (SURVEY §7.4.6),
+    where the scan computes its own rows per step/class."""
+    if tile_feasibility:
+        P, T = p_titype_ok.shape
+        N = n_avail.shape[0]
+        compat_pg = jnp.zeros((P, 0), bool)
+        type_ok = jnp.zeros((P, 0, T), bool)
+        n_fit = jnp.zeros((P, 0, T), jnp.int32)
+        cap_ng = jnp.zeros((N, 0), jnp.int32)
+        return compat_pg, type_ok, n_fit, cap_ng
+    compat_pg, type_ok, n_fit = fresh_claim_feasibility(
+        g_def, g_neg, g_mask, g_req,
+        p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+        t_def, t_mask, t_alloc,
+        o_avail, o_zone, o_ct,
+        well_known,
+        zone_kid=zone_kid,
+        ct_kid=ct_kid,
+    )
+    if n_avail.shape[0]:
+        cap_ng = existing_node_feasibility(
+            g_def, g_neg, g_mask, g_req,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            well_known,
+        )
+    else:
+        cap_ng = jnp.zeros((0, g_count.shape[0]), jnp.int32)
+    return compat_pg, type_ok, n_fit, cap_ng
+
+
+def _pack_results(state, exist_fills, claim_fills, unplaced):
+    return (
+        state.c_pool,
+        state.c_tmask,
+        state.n_open,
+        state.overflow,
+        exist_fills,
+        claim_fills,
+        unplaced,
+        state.c_dzone,
+        state.c_dct,
+        state.c_resv,
+    )
+
+
+def _solve_with(
+    packer,
     g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
     g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
     g_hstg, g_hscap, g_dtg,
@@ -30,44 +90,27 @@ def solve_core(
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     nh_cnt0, dd0, dtg_key,
     well_known,
-    nmax: int,
+    *extra_args,
     zone_kid: int,
     ct_kid: int,
-    has_domains: bool = True,
-    has_contrib: bool = False,
-    tile_feasibility: bool = False,
-    wf_iters: int = 32,
+    has_domains: bool,
+    has_contrib: bool,
+    tile_feasibility: bool,
+    wf_iters: int,
+    **packer_statics,
 ):
-    if tile_feasibility:
-        # HBM-scaling mode (SURVEY §7.4.6): the packing scan computes each
-        # group's feasibility row in-step; only zero-G placeholders ride
-        # the table slots
-        P, T = p_titype_ok.shape
-        N = n_avail.shape[0]
-        compat_pg = jnp.zeros((P, 0), bool)
-        type_ok = jnp.zeros((P, 0, T), bool)
-        n_fit = jnp.zeros((P, 0, T), jnp.int32)
-        cap_ng = jnp.zeros((N, 0), jnp.int32)
-    else:
-        compat_pg, type_ok, n_fit = fresh_claim_feasibility(
-            g_def, g_neg, g_mask, g_req,
-            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
-            t_def, t_mask, t_alloc,
-            o_avail, o_zone, o_ct,
-            well_known,
-            zone_kid=zone_kid,
-            ct_kid=ct_kid,
-        )
-        if n_avail.shape[0]:
-            cap_ng = existing_node_feasibility(
-                g_def, g_neg, g_mask, g_req,
-                n_def, n_mask, n_avail, n_base, n_tol,
-                well_known,
-            )
-        else:
-            cap_ng = jnp.zeros((0, g_count.shape[0]), jnp.int32)
-
-    state, exist_fills, claim_fills, unplaced = pack(
+    compat_pg, type_ok, n_fit, cap_ng = _feasibility_tables(
+        g_count, g_def, g_neg, g_mask, g_req,
+        p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+        t_def, t_mask, t_alloc,
+        o_avail, o_zone, o_ct,
+        n_def, n_mask, n_avail, n_base, n_tol,
+        well_known,
+        zone_kid=zone_kid,
+        ct_kid=ct_kid,
+        tile_feasibility=tile_feasibility,
+    )
+    state, exist_fills, claim_fills, unplaced = packer(
         g_count, g_req, g_def, g_neg, g_mask,
         g_hcap, g_haff,
         g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
@@ -86,25 +129,59 @@ def solve_core(
         n_dzone, n_dct,
         nh_cnt0, dd0, dtg_key,
         well_known,
-        nmax=nmax,
+        *extra_args,
         zone_kid=zone_kid,
         ct_kid=ct_kid,
         has_domains=has_domains,
         has_contrib=has_contrib,
         tile_feasibility=tile_feasibility,
         wf_iters=wf_iters,
+        **packer_statics,
     )
-    return (
-        state.c_pool,
-        state.c_tmask,
-        state.n_open,
-        state.overflow,
-        exist_fills,
-        claim_fills,
-        unplaced,
-        state.c_dzone,
-        state.c_dct,
-        state.c_resv,
+    return _pack_results(state, exist_fills, claim_fills, unplaced)
+
+
+def solve_core(
+    *args,
+    nmax: int,
+    zone_kid: int,
+    ct_kid: int,
+    has_domains: bool = True,
+    has_contrib: bool = False,
+    tile_feasibility: bool = False,
+    wf_iters: int = 32,
+):
+    return _solve_with(
+        pack, *args,
+        zone_kid=zone_kid, ct_kid=ct_kid,
+        has_domains=has_domains, has_contrib=has_contrib,
+        tile_feasibility=tile_feasibility, wf_iters=wf_iters,
+        nmax=nmax,
+    )
+
+
+def solve_core_classed(
+    *args,
+    nmax: int,
+    lmax: int,
+    zone_kid: int,
+    ct_kid: int,
+    has_domains: bool = True,
+    has_contrib: bool = False,
+    tile_feasibility: bool = False,
+    wf_iters: int = 32,
+):
+    """solve_core over the class-batched scan (ops/packing.py:pack_classed)
+    — one scan step per feasibility class, members placed by an inner loop.
+    Trailing positional args: class_start, class_len, class_dyn,
+    class_dkey, inv_idx (driver's enc.class_partition). Outputs are
+    bit-identical to solve_core (tests/test_classed_kernel.py)."""
+    return _solve_with(
+        pack_classed, *args,
+        zone_kid=zone_kid, ct_kid=ct_kid,
+        has_domains=has_domains, has_contrib=has_contrib,
+        tile_feasibility=tile_feasibility, wf_iters=wf_iters,
+        nmax=nmax, lmax=lmax,
     )
 
 
@@ -120,24 +197,15 @@ solve_all = jax.jit(
 _BIT_WEIGHTS = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
 
 
-def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
-                      has_domains: bool = True, has_contrib: bool = False,
-                      tile_feasibility: bool = False, wf_iters: int = 32,
-                      fills_dtype=jnp.int32):
-    """solve_core with a wire-compact output layout.
-
-    The axon tunnel charges ~60 ms fixed latency per readback plus
-    bandwidth, so the bulky outputs are shrunk on device: the [NMAX, T]
-    claim/type mask is bit-packed 8x into uint8, and the fill matrices are
-    narrowed to int16 when the driver proves the per-claim fill bound fits
-    (packing.py caps each fill at n_fit <= capacity/request, so the bound
-    is static per snapshot).
-    """
+def _wire_pack(outs, fills_dtype):
+    """Wire-compact output layout: the axon tunnel charges ~60 ms fixed
+    latency per readback plus bandwidth, so the bulky outputs shrink on
+    device — the [NMAX, T] claim/type mask bit-packs 8x into uint8, and
+    the fill matrices narrow to int16 when the driver proves the per-claim
+    fill bound fits (packing.py caps each fill at n_fit <=
+    capacity/request, so the bound is static per snapshot)."""
     (c_pool, c_tmask, n_open, overflow,
-     exist_fills, claim_fills, unplaced, c_dzone, c_dct, c_resv) = solve_core(
-        *args, nmax=nmax, zone_kid=zone_kid, ct_kid=ct_kid,
-        has_domains=has_domains, has_contrib=has_contrib,
-        tile_feasibility=tile_feasibility, wf_iters=wf_iters)
+     exist_fills, claim_fills, unplaced, c_dzone, c_dct, c_resv) = outs
     n, t = c_tmask.shape
     t_pad = -(-t // 8) * 8
     padded = jnp.pad(c_tmask, ((0, 0), (0, t_pad - t))).reshape(n, t_pad // 8, 8)
@@ -156,10 +224,26 @@ def solve_core_packed(*args, nmax: int, zone_kid: int, ct_kid: int,
     )
 
 
+def solve_core_packed(*args, fills_dtype=jnp.int32, **statics):
+    return _wire_pack(solve_core(*args, **statics), fills_dtype)
+
+
+def solve_core_classed_packed(*args, fills_dtype=jnp.int32, **statics):
+    return _wire_pack(solve_core_classed(*args, **statics), fills_dtype)
+
+
 solve_all_packed = jax.jit(
     solve_core_packed,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
+        "tile_feasibility", "wf_iters", "fills_dtype",
+    ),
+)
+
+solve_all_classed_packed = jax.jit(
+    solve_core_classed_packed,
+    static_argnames=(
+        "nmax", "lmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
         "tile_feasibility", "wf_iters", "fills_dtype",
     ),
 )

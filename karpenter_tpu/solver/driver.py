@@ -119,6 +119,12 @@ class SolverConfig:
     # or "auto" to build one over all local devices when more than one is
     # present. None = single device. Only meaningful with backend="tpu".
     mesh: Optional[object] = None
+    # class-batched kernel (ops/packing.py:pack_classed): one scan step per
+    # feasibility class instead of per group — the structural fix for
+    # many-tiny-group batches (the reference's diverse mix fragments 5k
+    # pods into ~1.9k groups sharing ~30 classes). None = auto-route when
+    # the mean class size crosses _CLASSED_MIN_MEAN_SIZE; True/False force.
+    classed: Optional[bool] = None
 
 
 @dataclass
@@ -339,8 +345,10 @@ class TpuSolver:
         # XLA compilation per solve. The native backend has no compilation
         # to amortize, so it runs the exact shapes.
         if self.config.backend == "tpu":
-            args = snap.padded(G, N).solve_args(a_tzc, res_cap0, a_res)
+            snap_run = snap.padded(G, N)
+            args = snap_run.solve_args(a_tzc, res_cap0, a_res)
         else:
+            snap_run = snap
             args = snap.solve_args(a_tzc, res_cap0, a_res)
 
         if self.config.backend == "native":
@@ -382,7 +390,7 @@ class TpuSolver:
             import jax
             import jax.numpy as jnp
 
-            from ..ops.solve import solve_all_packed
+            from ..ops.solve import solve_all_classed_packed, solve_all_packed
 
             # args ride WITH the dispatch (no separate device_put leg: the
             # tunnel charges fixed latency per RPC, and jit transfers host
@@ -395,10 +403,19 @@ class TpuSolver:
                 jnp.int16 if self._fill_bound(snap, fit) < 2**15 else jnp.int32
             )
 
+            classed_args = self._classed_partition(snap_run, res_cap0)
+
             def call(nmax):
-                out = solve_all_packed(
-                    *args, nmax=nmax, fills_dtype=fills_dtype, **statics
-                )
+                if classed_args is not None:
+                    cls_arrays, lmax = classed_args
+                    out = solve_all_classed_packed(
+                        *args, *cls_arrays, nmax=nmax, lmax=lmax,
+                        fills_dtype=fills_dtype, **statics,
+                    )
+                else:
+                    out = solve_all_packed(
+                        *args, nmax=nmax, fills_dtype=fills_dtype, **statics
+                    )
                 (c_pool, packed, n_open, overflow,
                  exist_fills, claim_fills, unplaced, c_dzone, c_dct,
                  c_resv) = [
@@ -437,6 +454,38 @@ class TpuSolver:
             snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills,
             unplaced, c_dzone, c_dct, c_resv,
         )
+
+    # below this mean (real groups per feasibility class), per-class head
+    # amortization cannot beat the per-group scan's simpler carry
+    _CLASSED_MIN_MEAN_SIZE = 4.0
+
+    def _classed_partition(self, snap_run, res_cap0):
+        """Class arrays for the class-batched kernel, or None to use the
+        per-group scan. Auto mode routes by mean class size: batches like
+        the diverse mix (~63 groups/class) win big; batches where every
+        group is its own class (constrained/mixed) stay on pack(). The
+        reservation ledger evolves offering availability across members,
+        so NRES > 0 always uses pack(). KTPU_CLASSED=1/0 overrides auto
+        (the test suite uses it to force every scenario through the
+        classed kernel for equivalence coverage)."""
+        import os
+
+        cfg = self.config.classed
+        if cfg is None:
+            env = os.environ.get("KTPU_CLASSED")
+            if env is not None:
+                cfg = env == "1"
+        if cfg is False or res_cap0.shape[0] != 0:
+            return None
+        cs, cl, cdyn, cdk, inv, lmax = enc.class_partition(snap_run)
+        if cfg is not True:
+            n_classes = int((cl > 0).sum())
+            if (
+                n_classes == 0
+                or len(snap_run.groups) / n_classes < self._CLASSED_MIN_MEAN_SIZE
+            ):
+                return None
+        return (cs, cl, cdyn, cdk, inv), lmax
 
     def _resolve_mesh(self):
         """The mesh to shard the solve over, or None for single-device.

@@ -910,6 +910,95 @@ def encode(
     )
 
 
+def class_partition(snap: "EncodedSnapshot"):
+    """Partition the (FFD-sorted, possibly padded) group axis into
+    contiguous feasibility classes for ops/packing.py:pack_classed.
+
+    Two adjacent groups share a class when every class-invariant input the
+    kernel's head tables derive from is identical: requests (g_req),
+    requirement masks (g_def/g_neg/g_mask), template tolerations (p_tol
+    column), and node tolerations (n_tol column). A run additionally
+    breaks when its dynamic (domain-keyed) members would mix axes — the
+    head's per-domain tables are built for ONE axis per class.
+
+    Returns (class_start, class_len, class_dyn, class_dkey, inv_idx, lmax)
+    as numpy arrays / int, with the class axis padded to a power of two and
+    lmax the power-of-two member-capacity bucket. Classes whose members
+    are all count-0 padding get len 0 (the kernel skips them whole).
+    """
+    G = len(snap.g_count)
+    # vectorized adjacent-equality over every class-invariant input: this
+    # runs on the solve hot path for EVERY routed batch (including ones
+    # the heuristic then sends to pack()), so no per-group Python loop
+    same = np.zeros((G,), bool)
+    if G > 1:
+        same[1:] = (
+            (snap.g_req[1:] == snap.g_req[:-1]).all(axis=1)
+            & (snap.g_def[1:] == snap.g_def[:-1]).all(axis=1)
+            & (snap.g_neg[1:] == snap.g_neg[:-1]).all(axis=1)
+            & (snap.g_mask[1:] == snap.g_mask[:-1]).all(axis=(1, 2))
+            & (snap.p_tol[:, 1:] == snap.p_tol[:, :-1]).all(axis=0)
+        )
+        if snap.n_tol.size:
+            same[1:] &= (snap.n_tol[:, 1:] == snap.n_tol[:, :-1]).all(axis=0)
+    sig_starts = np.flatnonzero(~same)
+    dyn_g = np.asarray(snap.g_dmode) > 0
+    dk_g = np.where(dyn_g, np.asarray(snap.g_dkey), -1)
+    starts: List[int] = []
+    lens: List[int] = []
+    dyns: List[bool] = []
+    dkeys: List[int] = []
+    for ri, s in enumerate(sig_starts):
+        e = sig_starts[ri + 1] if ri + 1 < len(sig_starts) else G
+        # split the run wherever a dynamic member's axis conflicts with
+        # the run's current one (the head's per-domain tables serve a
+        # single axis per class); conflicts are rare, so the split walk
+        # touches only the offending runs
+        while s < e:
+            dk_run = dk_g[s:e]
+            dyn_idx = np.flatnonzero(dk_run >= 0)
+            if dyn_idx.size:
+                first_dk = dk_run[dyn_idx[0]]
+                conflicts = dyn_idx[dk_run[dyn_idx] != first_dk]
+                cut = int(conflicts[0]) if conflicts.size else e - s
+            else:
+                first_dk = -1
+                cut = e - s
+            starts.append(int(s))
+            lens.append(int(cut))
+            dyns.append(bool(dyn_idx.size and dyn_idx[0] < cut))
+            dkeys.append(int(first_dk))
+            s += cut
+    # classes of pure padding (all counts 0) are skipped whole; their
+    # original spans still map groups for inv_idx below
+    spans = list(lens)
+    for ci in range(len(starts)):
+        s, l = starts[ci], lens[ci]
+        if not snap.g_count[s : s + l].any():
+            lens[ci] = 0
+    n_real = len(starts)
+    lmax = _next_pow2(max(lens) if lens else 1, floor=1)
+    C = _next_pow2(n_real, floor=1)
+    class_start = np.zeros((C,), np.int32)
+    class_len = np.zeros((C,), np.int32)
+    class_dyn = np.zeros((C,), bool)
+    class_dkey = np.zeros((C,), np.int32)
+    class_start[:n_real] = starts
+    class_len[:n_real] = lens
+    class_dyn[:n_real] = dyns
+    class_dkey[:n_real] = np.maximum(dkeys, 0)
+    # group gi of class ci at member offset j reads buffer row ci*lmax + j;
+    # len-0 (padding) classes point at their cond-skipped zero rows, which
+    # is correct for count-0 groups
+    spans_arr = np.asarray(spans, np.int64)
+    ci_of_g = np.repeat(np.arange(n_real, dtype=np.int64), spans_arr)
+    j_of_g = np.arange(G, dtype=np.int64) - np.repeat(
+        np.asarray(starts, np.int64), spans_arr
+    )
+    inv_idx = (ci_of_g * lmax + np.minimum(j_of_g, lmax - 1)).astype(np.int32)
+    return class_start, class_len, class_dyn, class_dkey, inv_idx, lmax
+
+
 def build_groups(pods: Sequence[Pod]) -> List[PodGroup]:
     """Group tensorizable pods into equivalence classes, FFD-ordered."""
     groups, rest = partition_and_group(pods)

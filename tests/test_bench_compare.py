@@ -1,0 +1,90 @@
+"""bench.py --compare: the benchstat-analog regression gate.
+
+The reference documents benchstat comparison as its perf workflow
+(scheduling_benchmark_test.go:57-69); compare_grids() is the mechanical
+equivalent over two bench_grid.json files, enforced in presubmit when a
+previous same-platform grid exists.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bench import compare_grids  # noqa: E402
+
+
+def _grid(platform, entries):
+    return {"platform": platform, "grid": entries}
+
+
+def _entry(config, pods, types, best_ms):
+    return {
+        "config": config, "pods": pods, "types": types,
+        "best_ms": best_ms, "pods_per_sec": pods / best_ms * 1000,
+    }
+
+
+def _write(tmp_path, name, grid):
+    p = tmp_path / name
+    p.write_text(json.dumps(grid))
+    return str(p)
+
+
+class TestCompareGrids:
+    def test_no_regression_passes(self, tmp_path):
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 100.0),
+            _entry("constrained", 50000, 800, 420.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 95.0),
+            _entry("constrained", 50000, 800, 410.0),
+        ]))
+        assert compare_grids(old, new) == 0
+
+    def test_regression_fails(self, tmp_path):
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 100.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 130.0),  # +30% > 20% bound
+        ]))
+        assert compare_grids(old, new) == 1
+
+    def test_platform_mismatch_not_enforced(self, tmp_path):
+        old = _write(tmp_path, "old.json", _grid("cpu", [
+            _entry("mixed", 5000, 400, 100.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 500.0),
+        ]))
+        assert compare_grids(old, new) == 0
+
+    def test_unmatched_configs_ignored(self, tmp_path):
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 500, 400, 10.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("diverse-ref", 5000, 400, 100.0),
+        ]))
+        assert compare_grids(old, new) == 0
+
+    def test_cli_entrypoint(self, tmp_path):
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 100.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 99.0),
+        ]))
+        out = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--compare", old, new],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "mixed-5000x400" in out.stderr

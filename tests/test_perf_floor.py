@@ -23,6 +23,30 @@ MIN_PODS_PER_SEC = 100.0  # the reference's asserted floor
 COST_DELTA_BOUND = 0.02  # BASELINE.json
 
 
+def _floor(config: str, n_pods: int) -> float:
+    """The throughput floor for a config: half of the last recorded
+    same-platform measurement when bench_floors.json carries one
+    (regenerate with `python bench.py --record-floors`), else the
+    reference's 100 pods/s. Pinning to measured numbers makes this tier
+    catch real regressions, not just catastrophes (VERDICT r4 weak #7)."""
+    import json
+    import os
+
+    import jax
+
+    path = os.path.join(os.path.dirname(__file__), "..", "bench_floors.json")
+    try:
+        with open(path) as fh:
+            floors = json.load(fh)
+    except (OSError, ValueError):
+        return MIN_PODS_PER_SEC
+    plat = jax.devices()[0].platform
+    val = floors.get(plat, {}).get(f"{config}-{n_pods}")
+    if not val:
+        return MIN_PODS_PER_SEC
+    return max(val * 0.5, MIN_PODS_PER_SEC)
+
+
 def _solve(pods, n_types=100, force_oracle=False):
     pools = [example_nodepool()]
     its = {pools[0].name: corpus.generate(n_types)}
@@ -43,14 +67,16 @@ class TestPerfFloor:
         _solve(pods)
         results, dt = _solve(pods)
         assert results.all_pods_scheduled()
-        assert n_pods / dt >= MIN_PODS_PER_SEC, f"{n_pods / dt:.0f} pods/sec"
+        floor = _floor("mixed", n_pods)
+        assert n_pods / dt >= floor, f"{n_pods / dt:.0f} < {floor:.0f} pods/sec"
 
     def test_constrained_throughput_floor(self):
         pods = constrained_mix(2000)
         _solve(pods)
         results, dt = _solve(pods)
         assert results.all_pods_scheduled()
-        assert 2000 / dt >= MIN_PODS_PER_SEC, f"{2000 / dt:.0f} pods/sec"
+        floor = _floor("constrained", 2000)
+        assert 2000 / dt >= floor, f"{2000 / dt:.0f} < {floor:.0f} pods/sec"
 
 
 class TestCostBound:

@@ -1,0 +1,161 @@
+"""Round-trip tests for the declarative schema artifacts (api/schema.py).
+
+Two guarantees, mirroring the reference's generated-CRD discipline
+(pkg/apis/crds/*.yaml is regenerated and diffed in CI):
+
+1. The checked-in YAML artifacts match a fresh generation — schema drift
+   without regeneration fails.
+2. The artifact's rule CONTENT agrees with the runtime validator
+   (api/validation.py): the same constants, and the same accept/reject
+   verdicts on behavioral probes.
+"""
+
+import json
+import os
+import re
+
+import yaml
+
+from karpenter_tpu.api import labels as labels_mod
+from karpenter_tpu.api import schema as schema_mod
+from karpenter_tpu.api import validation as val
+from karpenter_tpu.api.objects import (
+    Budget, NodeSelectorRequirement, Taint,
+)
+
+
+def _load(name):
+    with open(os.path.join(schema_mod.CRD_DIR, name)) as fh:
+        return fh.read()
+
+
+class TestArtifactsUpToDate:
+    def test_regeneration_matches_checked_in(self, tmp_path):
+        generated = schema_mod.generate(str(tmp_path))
+        for name, text in generated.items():
+            assert _load(name) == text, (
+                f"{name} is stale — run `python -m karpenter_tpu.api.schema`"
+            )
+
+
+class TestRuleContentMatchesValidator:
+    def setup_method(self):
+        self.np_schema = yaml.safe_load(_load("karpenter_tpu_nodepools.yaml"))
+        self.nc_schema = yaml.safe_load(_load("karpenter_tpu_nodeclaims.yaml"))
+
+    def _req_schema(self, root):
+        props = root["spec"]["properties"]
+        if "template" in props:
+            return (
+                props["template"]["properties"]["spec"]["properties"]
+                ["requirements"]["items"]
+            )
+        return props["requirements"]["items"]
+
+    def test_operator_enum_matches(self):
+        enum = self._req_schema(self.np_schema)["properties"]["operator"]["enum"]
+        assert set(enum) == set(val.SUPPORTED_OPERATORS)
+
+    def test_taint_effects_match(self):
+        taints = (
+            self.np_schema["spec"]["properties"]["template"]["properties"]
+            ["spec"]["properties"]["taints"]
+        )
+        enum = taints["items"]["properties"]["effect"]["enum"]
+        # the validator's accepted effects (validation.py:_validate_taints)
+        for effect in enum:
+            errs = val._validate_taints(
+                [Taint(key="k", value="v", effect=effect)], "taints"
+            )
+            assert not errs
+        errs = val._validate_taints(
+            [Taint(key="k", value="v", effect="Bogus")], "taints"
+        )
+        assert errs
+
+    def test_budget_nodes_pattern_matches(self):
+        budget = (
+            self.np_schema["spec"]["properties"]["disruption"]["properties"]
+            ["budgets"]["items"]
+        )
+        pattern = re.compile(budget["properties"]["nodes"]["pattern"])
+        for nodes, ok in (
+            ("10", True), ("100%", True), ("0%", True), ("55%", True),
+            ("101%", False), ("-1", False), ("ten", False),
+        ):
+            b = Budget(nodes=nodes)
+            assert bool(pattern.match(nodes)) == ok
+            assert (not val._validate_budget(b)) == ok
+
+    def test_schedule_duration_pairing_rule(self):
+        budget = (
+            self.np_schema["spec"]["properties"]["disruption"]["properties"]
+            ["budgets"]["items"]
+        )
+        rules = [r["rule"] for r in budget["x-validations"]]
+        assert any("schedule" in r and "duration" in r for r in rules)
+        assert val._validate_budget(Budget(nodes="10", schedule="@daily"))
+        assert not val._validate_budget(
+            Budget(nodes="10", schedule="@daily", duration="4h")
+        )
+
+    def test_weight_bounds_match(self):
+        w = self.np_schema["spec"]["properties"]["weight"]
+        assert (w["minimum"], w["maximum"]) == (1, 100)
+        from karpenter_tpu.solver.example import example_nodepool
+
+        pool = example_nodepool()
+        pool.spec.weight = 0
+        assert any("weight" in e for e in val.validate_node_pool(pool))
+        pool.spec.weight = 100
+        assert not any("weight" in e for e in val.validate_node_pool(pool))
+
+    def test_restricted_domains_match(self):
+        req = self._req_schema(self.np_schema)
+        restricted_rule = next(
+            r for r in req["x-validations"] if "x-restricted-domains" in r
+        )
+        assert set(restricted_rule["x-restricted-domains"]) == set(
+            labels_mod.RESTRICTED_LABEL_DOMAINS
+        )
+        assert set(restricted_rule["x-domain-exceptions"]) == set(
+            labels_mod.LABEL_DOMAIN_EXCEPTIONS
+        )
+
+    def test_requirement_behavior_probes(self):
+        """jsonschema-validatable subset agrees with validate_requirement."""
+        import jsonschema
+
+        req_schema = dict(self._req_schema(self.nc_schema))
+        # the x-* extensions are CEL analogs; the structural subset is
+        # directly jsonschema-checkable
+        probes = [
+            ({"key": "k", "operator": "In", "values": ["a"]}, True),
+            ({"key": "k", "operator": "Bogus", "values": []}, False),
+            ({"key": "k", "operator": "Exists", "values": [],
+              "minValues": 0}, False),  # minValues >= 1
+        ]
+        for obj, ok in probes:
+            try:
+                jsonschema.validate(obj, req_schema)
+                valid = True
+            except jsonschema.ValidationError:
+                valid = False
+            assert valid == ok, obj
+        # runtime validator agrees on the operator probe
+        assert not val.validate_requirement(
+            NodeSelectorRequirement("k", "In", ("a",))
+        )
+        assert val.validate_requirement(
+            NodeSelectorRequirement("k", "Bogus", ())
+        )
+
+    def test_min_values_rule_agrees(self):
+        errs = val.validate_requirement(
+            NodeSelectorRequirement("k", "In", ("a",), min_values=2)
+        )
+        assert errs
+        rules = [
+            r["rule"] for r in self._req_schema(self.nc_schema)["x-validations"]
+        ]
+        assert any("minValues" in r for r in rules)

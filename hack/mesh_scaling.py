@@ -1,0 +1,222 @@
+"""Multi-chip scaling measurement over the virtual CPU mesh.
+
+Runs the constrained north-star snapshot through the sharded solve at
+1/2/4/8 devices and every (data, model) factorization, asserting output
+equality against the single-device program and timing (a) the full fused
+solve and (b) the feasibility stage alone under the same shardings. CPU
+virtual devices share the host's cores, so the numbers measure GSPMD
+partitioning + collective overhead (the scaling *shape*), not real ICI
+speedup — exactly what can be validated without multi-chip hardware.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+           python hack/mesh_scaling.py [n_pods] [n_types]
+Writes hack/mesh_scaling.json and prints a markdown table for PARITY.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np  # noqa: E402
+
+
+def build_snapshot(n_pods: int, n_types: int):
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver import encode as enc
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.workloads import constrained_mix
+
+    pods = constrained_mix(n_pods)
+    pools = [example_nodepool()]
+    its_by_pool = {pools[0].name: corpus.generate(n_types)}
+    topology = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+    solver = TpuSolver(pools, its_by_pool, topology)
+    groups, rest = enc.partition_and_group(pods, topology=topology)
+    assert not rest, f"{len(rest)} pods not tensorizable"
+    templates = solver.oracle.templates
+    snap = enc.encode(
+        groups, templates,
+        {t.node_pool_name: t.instance_type_options for t in templates},
+        daemon_overhead=solver.oracle.daemon_overhead,
+    )
+    a_tzc, res_cap0, a_res = solver._offering_availability(snap)
+    fit = solver._fit_matrix(snap)
+    nmax = solver._estimate_nmax(snap, fit)
+    statics = dict(
+        nmax=nmax,
+        zone_kid=snap.zone_kid,
+        ct_kid=snap.ct_kid,
+        has_domains=bool((snap.g_dmode > 0).any()),
+        has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
+        wf_iters=solver._wf_iters(snap),
+    )
+    args = snap.solve_args(a_tzc, res_cap0, a_res)
+    return args, statics
+
+
+def time_fn(run, reps=3):
+    run()  # warm (compile)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def feasibility_only_fn(mesh, statics):
+    """The feasibility stage alone, under the same input shardings — the
+    embarrassingly-parallel part whose scaling the mesh exists for."""
+    from karpenter_tpu.ops.solve import _feasibility_tables
+    from karpenter_tpu.parallel.mesh import snapshot_shardings
+
+    def feas(*args):
+        (
+            g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
+            g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+            g_hstg, g_hscap, g_dtg, g_hself, g_hcontrib, g_dcontrib,
+            p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
+            p_titype_ok,
+            t_def, t_mask, t_alloc, t_cap,
+            o_avail, o_zone, o_ct, a_tzc, res_cap0, a_res,
+            n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
+            nh_cnt0, dd0, dtg_key, well_known,
+        ) = args
+        return _feasibility_tables(
+            g_count, g_def, g_neg, g_mask, g_req,
+            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+            t_def, t_mask, t_alloc,
+            o_avail, o_zone, o_ct,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            well_known,
+            zone_kid=statics["zone_kid"],
+            ct_kid=statics["ct_kid"],
+            tile_feasibility=False,
+        )
+
+    if mesh is None:
+        return jax.jit(feas)
+    return jax.jit(
+        feas,
+        in_shardings=snapshot_shardings(mesh),
+        out_shardings=jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        ),
+    )
+
+
+def main():
+    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    n_types = int(sys.argv[2]) if len(sys.argv) > 2 else 800
+    from karpenter_tpu.ops.solve import solve_all
+    from karpenter_tpu.parallel.mesh import (
+        make_mesh, pad_args_for_mesh, sharded_solve_fn,
+    )
+
+    args, statics = build_snapshot(n_pods, n_types)
+    G, T = args[0].shape[0], args[30].shape[0]
+    print(
+        f"snapshot: pods={n_pods} types={n_types} G={G} T={T}"
+        f" nmax={statics['nmax']}",
+        file=sys.stderr,
+    )
+
+    base_t, base_out = time_fn(lambda: solve_all(*args, **statics))
+    feas1 = feasibility_only_fn(None, statics)
+    base_feas_t, _ = time_fn(lambda: feas1(*args))
+    rows = [{
+        "devices": 1, "data": 1, "model": 1,
+        "solve_ms": round(base_t * 1000, 1),
+        "feas_ms": round(base_feas_t * 1000, 1),
+    }]
+    print(
+        f"single-device: solve={base_t * 1000:.0f}ms"
+        f" feas={base_feas_t * 1000:.0f}ms",
+        file=sys.stderr,
+    )
+
+    ref = [np.asarray(x) for x in jax.device_get(base_out)]
+    n_open = int(ref[2])
+
+    configs = []
+    for n in (2, 4, 8):
+        for data in (1, 2, 4, 8):
+            if data <= n and n % data == 0:
+                configs.append((n, data, n // data))
+    for n, data, model in configs:
+        mesh = make_mesh(n, data=data)
+        margs = pad_args_for_mesh(args, mesh)
+        fn = sharded_solve_fn(mesh, **statics)
+
+        def run():
+            with mesh:
+                return fn(*margs)
+
+        t, out = time_fn(run)
+        got = [np.asarray(x) for x in jax.device_get(out)]
+        assert int(got[2]) == n_open, (n, data, model, int(got[2]), n_open)
+        np.testing.assert_array_equal(
+            got[0][:n_open], ref[0][:n_open], err_msg="c_pool"
+        )
+        np.testing.assert_array_equal(
+            got[5][:, : ref[5].shape[1]][: ref[5].shape[0]],
+            ref[5],
+            err_msg="claim_fills",
+        )
+        feas = feasibility_only_fn(mesh, statics)
+
+        def run_feas():
+            with mesh:
+                return feas(*margs)
+
+        ft, _ = time_fn(run_feas)
+        rows.append({
+            "devices": n, "data": data, "model": model,
+            "solve_ms": round(t * 1000, 1),
+            "feas_ms": round(ft * 1000, 1),
+        })
+        print(
+            f"mesh {data}x{model} ({n} dev): solve={t * 1000:.0f}ms"
+            f" feas={ft * 1000:.0f}ms (outputs equal)",
+            file=sys.stderr,
+        )
+
+    out_path = os.path.join(os.path.dirname(__file__), "mesh_scaling.json")
+    with open(out_path, "w") as fh:
+        json.dump(
+            {"pods": n_pods, "types": n_types, "G": G, "T": T,
+             "platform": "cpu-virtual", "rows": rows},
+            fh, indent=1,
+        )
+    print(f"\n| devices | data x model | solve ms | feasibility ms |")
+    print("|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| {r['devices']} | {r['data']}x{r['model']} |"
+            f" {r['solve_ms']} | {r['feas_ms']} |"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -280,12 +280,21 @@ class MultiNodeConsolidation(ConsolidationBase):
         last_valid = Command()
         # one cluster snapshot serves every probe of the binary search
         snapshot = self.ctx.cluster.nodes()
+        # per-probe wall times for the bench's probe-count x per-probe
+        # breakdown (multinodeconsolidation.go:112-167 is the shape)
+        self.last_probe_ms: List[float] = []
+        import time as _time
+
         while lo <= hi:
             if self.ctx.clock.now() >= deadline:
                 break
             mid = (lo + hi) // 2
             subset = candidates[:mid]
+            _t0 = _time.perf_counter()
             cmd = self.compute_consolidation(subset, state_snapshot=snapshot)
+            self.last_probe_ms.append(
+                round((_time.perf_counter() - _t0) * 1000, 1)
+            )
             # don't replace nodes with the same type we're deleting
             # (filterOutSameType, multinodeconsolidation.go:185-222)
             if cmd.decision == "replace":

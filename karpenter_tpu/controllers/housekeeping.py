@@ -124,11 +124,23 @@ class HealthController:
                     ):
                         unhealthy.append(node)
                         break
+        # deletions made THIS pass are tracked by name: the listing above
+        # is a snapshot, and depending on the store backend a just-issued
+        # delete may (shared-reference memory store) or may not (any store
+        # returning copies, kube/filestore.py) be reflected in it — a
+        # name-deduplicated union counts correctly either way
+        marked: Dict[str, set] = {}
         for node in unhealthy:
             pool = node.metadata.labels.get(labels_mod.NODEPOOL_LABEL_KEY, "")
             pool_nodes = by_pool.get(pool, [])
-            repairing = sum(
-                1 for n in pool_nodes if n.metadata.deletion_timestamp is not None
+            pool_marked = marked.setdefault(pool, set())
+            repairing = len(
+                {
+                    n.name
+                    for n in pool_nodes
+                    if n.metadata.deletion_timestamp is not None
+                }
+                | pool_marked
             )
             # <=20% of a pool may repair at once, rounding UP like PDB
             # percentages (health/controller.go:195-198): 1 of 3 is fine
@@ -138,6 +150,7 @@ class HealthController:
             if node.metadata.deletion_timestamp is None:
                 NODES_REPAIRED.inc(labels={"nodepool": pool})
                 self.client.delete(node)
+                pool_marked.add(node.name)
 
 
 class ConsistencyController:

@@ -1,4 +1,5 @@
 from .clock import Clock, RealClock, TestClock
+from .filestore import FileClient
 from .store import Client, Event, NotFoundError, ConflictError, AlreadyExistsError
 
 __all__ = [
@@ -6,6 +7,7 @@ __all__ = [
     "RealClock",
     "TestClock",
     "Client",
+    "FileClient",
     "Event",
     "NotFoundError",
     "ConflictError",

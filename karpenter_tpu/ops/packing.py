@@ -1341,12 +1341,32 @@ def pack_classed(
         )  # [NMAX] — c_active applied per member (opens flip it mid-class)
         tor0 = type_ok_row[state.c_pool]  # [NMAX, T]
 
+        # per-claim capacity summaries: the per-member scan reads and
+        # maintains ONLY these [NMAX]-vectors. Filling k <= capv pods of
+        # the class request keeps the max-fit type alive (its fit count is
+        # capv >= k), so capv decrements by exactly k; the same survival
+        # argument per domain gives percapv' = max(percapv - k, 0), and a
+        # pin collapses capv to percapv[pin]. Claims therefore never need
+        # their [T] rows re-reduced mid-class.
+        tm0 = state.c_tmask & tor0 & off0
+        capv0 = jnp.max(jnp.where(tm0, add_fit0, 0), axis=-1)  # [NMAX]
+        if has_domains:
+            percapv0 = jnp.max(
+                jnp.where(tm0[:, :, None] & toff_nt0, add_fit0[:, :, None], 0),
+                axis=1,
+            )  # [NMAX, V1] (zeros when the class has no dynamic member)
+        else:
+            percapv0 = jnp.zeros((nmax, 0), jnp.int32)
+
         # snapshots for pin-on-read and opened-this-class classification
         n_open0 = state.n_open
         pin0_rel = jnp.where(cdk == 0, state.c_dzone, state.c_dct)
         kid_sel = jnp.where(cdk == 0, zone_kid, ct_kid)
 
-        def _member_body(j, state: PackState, exist_cap, add_fit, live, tor):
+        def _member_body(
+            j, state: PackState, exist_cap, capv, percapv, af0, cfills,
+            live, tor,
+        ):
             gi = cs + j
             count = g_count[gi]
             hcap = g_hcap[gi]
@@ -1381,30 +1401,6 @@ def pack_classed(
             D0 = g_dprior[gi] + jnp.where(has_d, state.ddc[jdc], 0)
             reg = g_dreg[gi]
             drank = g_drank[gi]
-
-            # effective offering/per-domain rows: head rows for claims that
-            # existed at class start, select-derived rows for claims opened
-            # or pinned during the class (see pack()'s per-step einsums —
-            # these selects reproduce them exactly for same-class masks)
-            is_new = slots >= n_open0
-            pin_rel = jnp.where(cdk == 0, state.c_dzone, state.c_dct)
-            if has_domains:
-                pinc = jnp.clip(pin_rel, 0, V1 - 1)
-                newpin = (pin_rel >= 0) & (pin_rel != pin0_rel) & ~is_new
-                toff_at_pin = jnp.take_along_axis(
-                    toff_nt0, pinc[:, None, None], axis=2
-                )[..., 0]  # [NMAX, T]
-                grp_at_pin = jnp.take(toff_grp.T, pinc, axis=0)  # [NMAX, T]
-                off_new = jnp.where(
-                    (pin_rel >= 0)[:, None], grp_at_pin, off_grp[None, :]
-                )
-                off_eff = jnp.where(
-                    is_new[:, None],
-                    off_new,
-                    jnp.where(newpin[:, None], toff_at_pin, off0),
-                )
-            else:
-                off_eff = jnp.where(is_new[:, None], off_grp[None, :], off0)
 
             # ---- 1. existing nodes --------------------------------------
             e_cap = jnp.minimum(
@@ -1518,14 +1514,11 @@ def pack_classed(
             exist_cap = exist_cap - exist_fill  # same-req decrement is exact
 
             # ---- 2. open claims -----------------------------------------
+            # capacity comes from the maintained summaries — no [NMAX, T]
+            # tensor is touched per member (see the head comment for the
+            # exact-decrement argument)
             claim_live = state.c_active & live
-            add_fit_m = add_fit
-            tm = state.c_tmask & tor & off_eff & (add_fit_m >= 1)
-            cap_any = jnp.where(
-                claim_live,
-                jnp.max(jnp.where(tm, add_fit_m, 0), axis=-1),
-                0,
-            )
+            cap_any = jnp.where(claim_live, capv, 0)
 
             def _clamp(cap):
                 cap = jnp.minimum(cap, hcap)
@@ -1557,25 +1550,7 @@ def pack_classed(
             if has_domains:
 
                 def _tier2_domains(_):
-                    pin_keep = (pin_rel < 0)[:, None] | jax.nn.one_hot(
-                        jnp.clip(pin_rel, 0, V1 - 1), V1, dtype=bool
-                    )
-                    toff_eff = (
-                        jnp.where(
-                            is_new[:, None, None],
-                            toff_grp[None, :, :],
-                            toff_nt0,
-                        )
-                        & pin_keep[:, None, :]
-                    )
-                    percap = jnp.max(
-                        jnp.where(
-                            tm[:, :, None] & toff_eff,
-                            add_fit_m[:, :, None],
-                            0,
-                        ),
-                        axis=1,
-                    )
+                    percap = jnp.where(claim_live[:, None], percapv, 0)
                     adm = (
                         claim_live[:, None]
                         & (percap >= 1)
@@ -1618,8 +1593,6 @@ def pack_classed(
                 got[:, None], state.c_neg & gneg[None, :], state.c_neg
             )
             merged_mask = state.c_mask & gmask[None, :, :]
-            still_fits = add_fit_m >= claim_fill[:, None]
-            surv = tor & off_eff & still_fits
             if has_domains:
                 tighten = dyn & got & (c_slot < V1)
                 d_oh = jax.nn.one_hot(
@@ -1634,29 +1607,32 @@ def pack_classed(
                     jnp.where(tighten[:, None, None], tight_mask, merged_mask),
                     state.c_mask,
                 )
-                cslotc = jnp.clip(c_slot, 0, V1 - 1)
-                toff_at = jnp.where(
-                    is_new[:, None],
-                    jnp.take(toff_grp.T, cslotc, axis=0),
-                    jnp.take_along_axis(
-                        toff_nt0, cslotc[:, None, None], axis=2
-                    )[..., 0],
-                )
-                surv = surv & jnp.where(tighten[:, None], toff_at, True)
-                pin = cslotc
+                pin = jnp.clip(c_slot, 0, V1 - 1)
                 c_dzone2 = jnp.where(tighten & (cdk == 0), pin, state.c_dzone)
                 c_dct2 = jnp.where(tighten & (cdk == 1), pin, state.c_dct)
+                # summary maintenance: exact decrements, then a pin zeroes
+                # the other domains and collapses capv to the pinned lane
+                percapv = jnp.maximum(percapv - claim_fill[:, None], 0)
+                percapv = jnp.where(
+                    tighten[:, None], percapv * d_oh, percapv
+                )
+                capv = jnp.where(
+                    tighten,
+                    jnp.take_along_axis(percapv, pin[:, None], axis=1)[:, 0],
+                    capv - claim_fill,
+                )
             else:
                 c_mask = jnp.where(
                     got[:, None, None], merged_mask, state.c_mask
                 )
                 c_dzone2, c_dct2 = state.c_dzone, state.c_dct
-            c_tmask = jnp.where(got[:, None], state.c_tmask & surv, state.c_tmask)
-            add_fit = add_fit_m - claim_fill[:, None]
+                capv = capv - claim_fill
+            cfills = cfills + claim_fill
 
             # ---- 3. fresh claims ----------------------------------------
             def body(carry):
-                st, qrem, fills, ddead, add_fit, live, tor = carry
+                (st, qrem, fills, ddead, capv, percapv, af0, cfills,
+                 live, tor) = carry
                 d_sel = jnp.argmax(jnp.where(ddead, -1, qrem))
                 rem_d = qrem[d_sel]
                 is_any = d_sel == ANY
@@ -1679,10 +1655,10 @@ def pack_classed(
                 feas_p = jnp.any(avail, axis=-1)
                 p_star = jnp.argmax(feas_p)
                 any_feasible = jnp.any(feas_p)
-                n_per = jnp.minimum(
-                    jnp.max(jnp.where(avail[p_star], n_fit_row[p_star], 0)),
-                    hcap,
+                n_fit_max = jnp.max(
+                    jnp.where(avail[p_star], n_fit_row[p_star], 0)
                 )
+                n_per = jnp.minimum(n_fit_max, hcap)
                 n_per = jnp.minimum(
                     n_per, jnp.where(has_h & hself, scap_h, _BIGI)
                 )
@@ -1776,10 +1752,32 @@ def pack_classed(
                     overflow=st.overflow
                     | (any_feasible & (n_per > 0) & (k_want > k_slots)),
                 )
-                # maintained-table rows for the slots just opened (later
-                # members read them): fits under the bulk's takes, and the
-                # class-invariant type row of the chosen template
-                add_fit = write(add_fit, n_fit_row[p_star][None, :] - takes[:, None])
+                # maintained rows for the slots just opened (later members
+                # read them): takes <= the best available fit, so the
+                # opened claims' capacity summary is n_fit_max - takes in
+                # closed form (the member-level hcap clamps apply on read,
+                # never in the summary); the per-domain maxes reduce over
+                # the GROUP-mask availability toff_grp — an opened claim's
+                # mask is gmask, exactly what pack()'s next-step einsum
+                # would contract — once per trip
+                capv = jnp.where(in_bulk, n_fit_max - takes, capv)
+                if has_domains:
+                    pmax = jnp.max(
+                        jnp.where(
+                            avail[p_star][:, None] & toff_grp,
+                            n_fit_row[p_star][:, None],
+                            0,
+                        ),
+                        axis=0,
+                    )  # [V1]
+                    prow = jnp.maximum(pmax[None, :] - takes[:, None], 0)
+                    pin_oh_v = jax.nn.one_hot(
+                        jnp.clip(d_pin, 0, V1 - 1), V1, dtype=bool
+                    )
+                    prow = jnp.where(d_pin >= 0, prow * pin_oh_v[None, :], prow)
+                    percapv = jnp.where(in_bulk[:, None], prow, percapv)
+                af0 = write(af0, n_fit_row[p_star][None, :] - takes[:, None])
+                cfills = jnp.where(in_bulk, 0, cfills)
                 live = live | in_bulk
                 tor = write(tor, type_ok_row[p_star][None, :])
                 fills = fills + takes
@@ -1787,11 +1785,10 @@ def pack_classed(
                 ddead = ddead.at[d_sel].set(
                     ddead[d_sel] | (placed == 0) | haff
                 )
-                return st, qrem, fills, ddead, add_fit, live, tor
+                return st, qrem, fills, ddead, capv, percapv, af0, cfills, live, tor
 
             def cond2(carry):
-                st, qrem, fills, ddead, _af, _lv, _tr = carry
-                return jnp.any((qrem > 0) & ~ddead) & ~st.overflow
+                return jnp.any((carry[1] > 0) & ~carry[3]) & ~carry[0].overflow
 
             new_state = state._replace(
                 exist_used=exist_used,
@@ -1800,19 +1797,18 @@ def pack_classed(
                 c_def=c_def,
                 c_neg=c_neg,
                 c_mask=c_mask,
-                c_tmask=c_tmask,
                 c_dzone=c_dzone2,
                 c_dct=c_dct2,
                 ch_cnt=ch_cnt,
                 nhc=nhc,
             )
             ddead0 = jnp.zeros((NSLOT,), bool).at[DEAD].set(True)
-            (new_state, qrem_fin, claim_fill, _dd, add_fit, live, tor) = (
-                jax.lax.while_loop(
-                    cond2,
-                    body,
-                    (new_state, qrem, claim_fill, ddead0, add_fit, live, tor),
-                )
+            (new_state, qrem_fin, claim_fill, _dd, capv, percapv, af0,
+             cfills, live, tor) = jax.lax.while_loop(
+                cond2,
+                body,
+                (new_state, qrem, claim_fill, ddead0, capv, percapv, af0,
+                 cfills, live, tor),
             )
             new_state = new_state._replace(
                 ddc=new_state.ddc.at[jdc].add(
@@ -1865,45 +1861,82 @@ def pack_classed(
                     ddc=new_state.ddc + drow[:, None] * per_slot,
                 )
             unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
-            return new_state, exist_cap, add_fit, live, tor, (
-                exist_fill, claim_fill, unplaced
+            return (
+                new_state, exist_cap, capv, percapv, af0, cfills, live, tor,
+                (exist_fill, claim_fill, unplaced),
             )
 
         def _member(j, carry):
-            state, exist_cap, add_fit, live, tor, ebuf, cbuf, ubuf = carry
+            (state, exist_cap, capv, percapv, af0, cfills, live, tor,
+             ebuf, cbuf, ubuf) = carry
             gi = cs + j
 
             def _run(_):
-                st, ec, af, lv, tr, (ef, cf, up) = _member_body(
-                    j, state, exist_cap, add_fit, live, tor
+                out = _member_body(
+                    j, state, exist_cap, capv, percapv, af0, cfills, live, tor
                 )
-                return st, ec, af, lv, tr, ef, cf, up
+                return out[:8] + out[8]
 
             def _skip(_):
                 return (
-                    state, exist_cap, add_fit, live, tor,
+                    state, exist_cap, capv, percapv, af0, cfills, live, tor,
                     jnp.zeros((N,), jnp.int32),
                     jnp.zeros((nmax,), jnp.int32),
                     jnp.int32(0),
                 )
 
-            st, ec, af, lv, tr, ef, cf, up = jax.lax.cond(
-                g_count[gi] > 0, _run, _skip, None
-            )
-            ebuf = jax.lax.dynamic_update_slice(ebuf, ef[None, :], (j, 0))
-            cbuf = jax.lax.dynamic_update_slice(cbuf, cf[None, :], (j, 0))
-            ubuf = ubuf.at[j].set(up)
-            return st, ec, af, lv, tr, ebuf, cbuf, ubuf
+            out = jax.lax.cond(g_count[gi] > 0, _run, _skip, None)
+            ebuf = jax.lax.dynamic_update_slice(ebuf, out[8][None, :], (j, 0))
+            cbuf = jax.lax.dynamic_update_slice(cbuf, out[9][None, :], (j, 0))
+            ubuf = ubuf.at[j].set(out[10])
+            return out[:8] + (ebuf, cbuf, ubuf)
 
         carry0 = (
-            state, exist_cap0, add_fit0, live0, tor0,
+            state, exist_cap0, capv0, percapv0, add_fit0,
+            jnp.zeros((nmax,), jnp.int32), live0, tor0,
             jnp.zeros((lmax, N), jnp.int32),
             jnp.zeros((lmax, nmax), jnp.int32),
             jnp.zeros((lmax,), jnp.int32),
         )
         out = jax.lax.fori_loop(0, cl, _member, carry0)
-        state = out[0]
-        return state, (out[5], out[6], out[7])
+        (state, _ec, _capv, _pcv, af0_f, cfills_f, live_f, tor_f,
+         ebuf, cbuf, ubuf) = out
+
+        # ---- end-of-class type-mask settlement --------------------------
+        # pack() intersects each touched claim's type mask with
+        # tor ∧ off ∧ still_fits on EVERY fill; tor is class-invariant,
+        # off only changes by pinning (and the pinned row is a subset of
+        # the unpinned one), and the binding still_fits constraint is the
+        # cumulative class fill — so ONE intersection with the final pin
+        # state and the class-total fills is exactly the composition of
+        # the per-member updates.
+        is_new_f = slots >= n_open0
+        pin_rel_f = jnp.where(cdk == 0, state.c_dzone, state.c_dct)
+        if has_domains:
+            pinc_f = jnp.clip(pin_rel_f, 0, V1 - 1)
+            newpin_f = (pin_rel_f >= 0) & (pin_rel_f != pin0_rel) & ~is_new_f
+            toff_at_pin = jnp.take_along_axis(
+                toff_nt0, pinc_f[:, None, None], axis=2
+            )[..., 0]
+            grp_at_pin = jnp.take(toff_grp.T, pinc_f, axis=0)
+            off_new = jnp.where(
+                (pin_rel_f >= 0)[:, None], grp_at_pin, off_grp[None, :]
+            )
+            off_fin = jnp.where(
+                is_new_f[:, None],
+                off_new,
+                jnp.where(newpin_f[:, None], toff_at_pin, off0),
+            )
+        else:
+            off_fin = jnp.where(is_new_f[:, None], off_grp[None, :], off0)
+        touched = cfills_f > 0
+        surv_fin = tor_f & off_fin & (af0_f >= cfills_f[:, None])
+        state = state._replace(
+            c_tmask=jnp.where(
+                touched[:, None], state.c_tmask & surv_fin, state.c_tmask
+            )
+        )
+        return state, (ebuf, cbuf, ubuf)
 
     def class_step(state: PackState, xs):
         cs, cl, cdyn, cdk = xs

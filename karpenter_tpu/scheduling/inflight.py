@@ -270,12 +270,26 @@ class ExistingNode:
     """Add(pod) against a real or in-flight cluster node
     (reference: existingnode.go:31-122)."""
 
+    @staticmethod
+    def build_requirements(state_node) -> Requirements:
+        """The node's label requirements + hostname pin. Read-only after
+        construction (Add() REPLACES self.requirements with a merged copy,
+        never mutates it), so schedulers may cache and share one instance
+        per node across solves — consolidation's binary search rebuilds
+        these for the same snapshot nodes every probe."""
+        reqs = Requirements.from_labels(state_node.labels())
+        reqs.add(
+            Requirement(labels_mod.HOSTNAME, Operator.IN, [state_node.hostname()])
+        )
+        return reqs
+
     def __init__(
         self,
         state_node,
         topology: Topology,
         taints: List[Taint],
         daemon_resources: res.ResourceList,
+        base_requirements: Requirements = None,
     ):
         self.state_node = state_node
         self.topology = topology
@@ -289,9 +303,10 @@ class ExistingNode:
             daemon_resources, state_node.daemonset_request_total()
         )
         self.requests = {k: max(v, 0) for k, v in remaining_daemons.items()}
-        self.requirements = Requirements.from_labels(state_node.labels())
-        self.requirements.add(
-            Requirement(labels_mod.HOSTNAME, Operator.IN, [state_node.hostname()])
+        self.requirements = (
+            base_requirements
+            if base_requirements is not None
+            else self.build_requirements(state_node)
         )
         self.pods: List[Pod] = []
         self.hostport_usage = state_node.hostport_usage.copy()

@@ -83,8 +83,23 @@ class Results:
         consumers — provisioning, disruption replacements, the solver
         sidecar — see validated, launchable option sets. Claims already
         within the cap skip the price sort; minValues (when present) is
-        still validated over the full set."""
+        still validated over the full set.
+
+        The price sort is memoized on the inputs it actually depends on:
+        the options list, the requirement entries over keys any offering
+        defines (zone/capacity-type/reservation), and the presence set of
+        positively-constrained custom keys (the Compatible asymmetry makes
+        every offering incompatible when one is undefined offering-side,
+        types.go:289-293 + requirements.go:178-188). Claims opened from
+        the same group bulk — and across bulks, claims pinned to the same
+        domain — share these, and on shapes like the diverse mix (~1,000
+        one-pod anti-affinity claims) the per-claim Python sort otherwise
+        dwarfs the entire kernel solve."""
+        from ..api import labels as labels_mod
+
         valid = []
+        memo: dict = {}
+        okeys_memo: dict = {}
         for claim in self.new_node_claims:
             options = claim.instance_type_options
             reqs = claim.requirements
@@ -93,8 +108,39 @@ class Results:
                 if reqs.has_min_values():
                     _, err = cp.satisfies_min_values(options, reqs)
                 truncated = options
-            else:
+            elif reqs.has_min_values():
+                # minValues depends on every requirement entry; don't
+                # risk key coarsening on the rare pools that use it
                 truncated, err = cp.truncate(options, reqs, max_types)
+            else:
+                # object identity, not names: distinct per-pool catalogs
+                # may reuse type names with different offerings, and the
+                # InstanceType objects are stable for this call's lifetime
+                names = tuple(map(id, options))
+                okeys = okeys_memo.get(names)
+                if okeys is None:
+                    seen: set = set()
+                    for it in options:
+                        for o in it.offerings:
+                            seen.update(o.requirements.keys())
+                    okeys = okeys_memo[names] = tuple(sorted(seen))
+                custom_pos = tuple(sorted(
+                    r.key
+                    for r in reqs
+                    if r.key not in labels_mod.WELL_KNOWN_LABELS
+                    and r.key not in okeys
+                    and r.operator() in ("In", "Exists", "Gt", "Lt")
+                ))
+                key = (
+                    names,
+                    tuple(repr(reqs.get(k)) for k in okeys),
+                    custom_pos,
+                )
+                hit = memo.get(key)
+                if hit is None:
+                    hit = memo[key] = cp.truncate(options, reqs, max_types)
+                cached, err = hit
+                truncated = list(cached)
             if err is not None:
                 for pod in claim.pods:
                     self.pod_errors[pod.uid] = (
@@ -140,9 +186,15 @@ class Scheduler:
         reserved_capacity_enabled: bool = False,
         clock=None,
         volume_resolver=None,
+        node_model_cache: Optional[dict] = None,
     ):
         self.clock = clock
         self.volume_resolver = volume_resolver
+        # cross-solve cache for the pure per-node model inputs (taints,
+        # daemon remainder, label requirements) — consolidation's binary
+        # search rebuilds a Scheduler per probe over the SAME snapshot
+        # nodes, and this construction dominated per-probe host time
+        self._node_model_cache = node_model_cache
         # tolerate PreferNoSchedule during relaxation if any pool taints with it
         tolerate_pns = any(
             t.effect == taints_mod.PREFER_NO_SCHEDULE
@@ -181,22 +233,57 @@ class Scheduler:
 
     # -- existing nodes (scheduler.go:427-463) ----------------------------
 
+    @staticmethod
+    def _node_identity(sn) -> tuple:
+        """Cache identity of a StateNode's pure model inputs: labels and
+        taints can only change with the backing objects' resource
+        versions."""
+        node_rv = sn.node.metadata.resource_version if sn.node is not None else -1
+        claim_rv = (
+            sn.node_claim.metadata.resource_version
+            if sn.node_claim is not None
+            else -1
+        )
+        return (sn.name, node_rv, claim_rv)
+
     def _calculate_existing_nodes(self, state_nodes, daemonset_pods) -> None:
+        cache = self._node_model_cache
+        daemon_fp = (
+            tuple(
+                (p.uid, p.metadata.resource_version) for p in daemonset_pods
+            )
+            if cache is not None
+            else ()
+        )
         for sn in state_nodes:
-            taints = sn.taints()
-            daemons = []
-            for p in daemonset_pods:
-                if taints_mod.tolerates_pod(taints, p) is not None:
-                    continue
-                if (
-                    Requirements.from_labels(sn.labels()).compatible(pod_requirements(p))
-                    is not None
-                ):
-                    continue
-                daemons.append(p)
-            daemon_requests = res.merge(*(p.spec.requests for p in daemons)) if daemons else {}
+            hit = None
+            if cache is not None:
+                key = self._node_identity(sn) + (daemon_fp,)
+                hit = cache.get(key)
+            if hit is not None:
+                taints, daemon_requests, base_reqs = hit
+            else:
+                taints = sn.taints()
+                daemons = []
+                for p in daemonset_pods:
+                    if taints_mod.tolerates_pod(taints, p) is not None:
+                        continue
+                    if (
+                        Requirements.from_labels(sn.labels()).compatible(pod_requirements(p))
+                        is not None
+                    ):
+                        continue
+                    daemons.append(p)
+                daemon_requests = res.merge(*(p.spec.requests for p in daemons)) if daemons else {}
+                base_reqs = None
+                if cache is not None:
+                    base_reqs = ExistingNode.build_requirements(sn)
+                    cache[key] = (taints, daemon_requests, base_reqs)
             self.existing_nodes.append(
-                ExistingNode(sn, self.topology, taints, daemon_requests)
+                ExistingNode(
+                    sn, self.topology, taints, daemon_requests,
+                    base_requirements=base_reqs,
+                )
             )
             pool = sn.labels().get(labels_mod.NODEPOOL_LABEL_KEY)
             if pool in self.remaining_resources:
@@ -204,6 +291,10 @@ class Scheduler:
                     self.remaining_resources[pool], sn.capacity()
                 )
         self.existing_nodes.sort(key=lambda n: (not n.initialized(), n.name))
+        # resource-version churn retires entries; bound the long-lived
+        # provisioner cache rather than leak one entry per rv bump
+        if cache is not None and len(cache) > max(10_000, 8 * len(self.existing_nodes)):
+            cache.clear()
 
     # -- per-pod placement (scheduler.go:357-425) -------------------------
 

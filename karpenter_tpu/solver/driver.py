@@ -50,6 +50,12 @@ class EncodeCache:
         self._fingerprint = None
         self.vocab = enc.Vocab()
         self.cache: dict = {}
+        # pure per-node scheduler model inputs (taints, daemon remainder,
+        # label requirements) keyed by object resource versions — catalog-
+        # independent, so it survives fingerprint resets. Consolidation
+        # probes and successive provisioning rounds over a stable cluster
+        # hit this instead of rebuilding every ExistingNode model.
+        self.node_models: dict = {}
         # encode mutates the shared vocab/static arrays; concurrent solves
         # (the gRPC sidecar) serialize the host-side encode on this lock
         self.lock = threading.RLock()
@@ -158,6 +164,11 @@ class TpuSolver:
         **scheduler_kwargs,
     ):
         self.config = config or SolverConfig()
+        # encode reuse: with a shared EncodeCache the instance-type/template
+        # side survives across TpuSolver instances (the Provisioner builds
+        # one per solve); standalone, it still de-dups repeat solves on this
+        # instance
+        self._shared_cache = encode_cache or EncodeCache()
         # the oracle scheduler provides template prefiltering, daemon
         # overhead, existing-node models, and the fallback solve loop
         self.oracle = Scheduler(
@@ -166,16 +177,12 @@ class TpuSolver:
             topology,
             state_nodes=state_nodes,
             daemonset_pods=daemonset_pods,
+            node_model_cache=self._shared_cache.node_models,
             **scheduler_kwargs,
         )
         self.pool_limits = {
             np_.name: dict(np_.spec.limits) for np_ in node_pools if np_.spec.limits
         }
-        # encode reuse: with a shared EncodeCache the instance-type/template
-        # side survives across TpuSolver instances (the Provisioner builds
-        # one per solve); standalone, it still de-dups repeat solves on this
-        # instance
-        self._shared_cache = encode_cache or EncodeCache()
 
     # -- solve ------------------------------------------------------------
 
@@ -477,7 +484,13 @@ class TpuSolver:
                 cfg = env == "1"
         if cfg is False or res_cap0.shape[0] != 0:
             return None
-        cs, cl, cdyn, cdk, inv, lmax = enc.class_partition(snap_run)
+        out = enc.class_partition(
+            snap_run,
+            min_mean_size=0.0 if cfg is True else self._CLASSED_MIN_MEAN_SIZE,
+        )
+        if out is None:
+            return None
+        cs, cl, cdyn, cdk, inv, lmax = out
         if cfg is not True:
             n_classes = int((cl > 0).sum())
             if (

@@ -815,11 +815,37 @@ def encode(
         # `en` is a scheduling.inflight.ExistingNode (carries the remaining
         # daemon requests and cached availability)
         existing_names.append(en.name)
-        n_avail[i] = quantize_capacity(en.cached_available, resource_names)
-        n_base[i] = quantize_requests(en.requests, resource_names)
-        n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
-        n_dzone[i] = _node_domain_id(vocab, en, labels_mod.TOPOLOGY_ZONE)
-        n_dct[i] = _node_domain_id(vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY)
+        # per-node rows stash on the StateNode snapshot object:
+        # consolidation's binary search re-encodes the SAME frozen snapshot
+        # nodes once per probe, and these per-node Python/vocab walks
+        # dominated the probe's encode. Safe because cluster.nodes() hands
+        # each solve fresh deep copies (stale stashes die with their
+        # snapshot), node label requirements are positive-only (rows are
+        # stable under vocab growth at fixed K/V1), and the tag pins the
+        # vocab instance, array shapes, and the daemon remainder.
+        sn = getattr(en, "state_node", None)
+        tag = (
+            vocab.serial, K, V1, tuple(resource_names),
+            tuple(sorted(en.requests.items())),
+        )
+        cached = getattr(sn, "_enc_rows", None) if sn is not None else None
+        if cached is not None and cached[0] == tag:
+            (n_avail[i], n_base[i], n_def[i], n_mask[i], n_dzone[i],
+             n_dct[i]) = cached[1]
+        else:
+            n_avail[i] = quantize_capacity(en.cached_available, resource_names)
+            n_base[i] = quantize_requests(en.requests, resource_names)
+            n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
+            n_dzone[i] = _node_domain_id(vocab, en, labels_mod.TOPOLOGY_ZONE)
+            n_dct[i] = _node_domain_id(
+                vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY
+            )
+            if sn is not None:
+                sn._enc_rows = (
+                    tag,
+                    (n_avail[i].copy(), n_base[i].copy(), n_def[i].copy(),
+                     n_mask[i].copy(), n_dzone[i], n_dct[i]),
+                )
         if shared_h_descs:
             hostname = (
                 en.state_node.hostname() if hasattr(en, "state_node") else en.name
@@ -910,9 +936,17 @@ def encode(
     )
 
 
-def class_partition(snap: "EncodedSnapshot"):
+def class_partition(snap: "EncodedSnapshot", min_mean_size: float = 0.0):
     """Partition the (FFD-sorted, possibly padded) group axis into
     contiguous feasibility classes for ops/packing.py:pack_classed.
+
+    With ``min_mean_size`` > 0 (the driver's auto-routing threshold), the
+    partition bails out with None right after the vectorized signature
+    pass when even the signature-run count proves the mean class size
+    below the threshold — dkey splits and padding-class exclusion only
+    INCREASE the class count, so this is a safe upper bound, and the
+    rejected shapes (every group its own class, e.g. consolidation
+    probes) skip the per-run Python walk entirely.
 
     Two adjacent groups share a class when every class-invariant input the
     kernel's head tables derive from is identical: requests (g_req),
@@ -942,6 +976,13 @@ def class_partition(snap: "EncodedSnapshot"):
         if snap.n_tol.size:
             same[1:] &= (snap.n_tol[:, 1:] == snap.n_tol[:, :-1]).all(axis=0)
     sig_starts = np.flatnonzero(~same)
+    if min_mean_size > 0:
+        n_real_groups = len(snap.groups)
+        if (
+            not len(sig_starts)
+            or n_real_groups / len(sig_starts) < min_mean_size
+        ):
+            return None
     dyn_g = np.asarray(snap.g_dmode) > 0
     dk_g = np.where(dyn_g, np.asarray(snap.g_dkey), -1)
     starts: List[int] = []

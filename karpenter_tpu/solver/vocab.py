@@ -14,6 +14,7 @@ snapshot outgrows the previous bucket.
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -29,10 +30,18 @@ def _next_pow2(n: int, floor: int = 4) -> int:
     return v
 
 
+_VOCAB_SERIAL = itertools.count(1)
+
+
 class Vocab:
     """Interned label keys and per-key value vocabularies."""
 
     def __init__(self):
+        # distinguishes vocab INSTANCES in cache-validity tags (id() can
+        # be reused after GC; this never is). itertools.count is atomic
+        # under the GIL — concurrent sidecar solves construct Vocabs
+        # without an instance-level lock in scope.
+        self.serial = next(_VOCAB_SERIAL)
         self.key_ids: Dict[str, int] = {}
         self.keys: List[str] = []
         self.value_ids: List[Dict[str, int]] = []  # per key

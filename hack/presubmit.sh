@@ -30,6 +30,14 @@ PY
 echo "== test suite =="
 python -m pytest tests/ -q
 
+# the race tier re-runs with different hash seeds (dict/set iteration
+# orders) — the deflake analog of the reference's `-race` + `-count`
+# loops (Makefile:78,85-93); the full suite above already ran it once
+echo "== race tier (reseeded) =="
+for seed in 7 23; do
+  PYTHONHASHSEED=$seed python -m pytest tests/test_races.py -q
+done
+
 # mechanical perf-regression gate (benchstat analog): enforced when a
 # previous same-platform grid exists next to the current one
 if [[ -f bench_grid_prev.json && -f bench_grid.json ]]; then

@@ -269,6 +269,11 @@ class MultiNodeConsolidation(ConsolidationBase):
     consolidation_type = "multi"
 
     def compute_command(self, candidates, budgets) -> Command:
+        # per-probe wall times for the bench's probe-count x per-probe
+        # breakdown (multinodeconsolidation.go:112-167 is the shape);
+        # reset BEFORE any early return so a no-probe decision never
+        # reports the previous decision's timings
+        self.last_probe_ms: List[float] = []
         candidates = _budget_filter(
             sorted(candidates, key=lambda c: c.disruption_cost), budgets
         )
@@ -280,9 +285,6 @@ class MultiNodeConsolidation(ConsolidationBase):
         last_valid = Command()
         # one cluster snapshot serves every probe of the binary search
         snapshot = self.ctx.cluster.nodes()
-        # per-probe wall times for the bench's probe-count x per-probe
-        # breakdown (multinodeconsolidation.go:112-167 is the shape)
-        self.last_probe_ms: List[float] = []
         import time as _time
 
         while lo <= hi:

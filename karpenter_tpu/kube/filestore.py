@@ -98,12 +98,17 @@ class FileClient(Client):
 
     def create(self, obj):
         stored = self._copy(obj)
-        super().create(stored)
+        # mutation + disk sync under one lock (RLock, so the base method's
+        # own acquisition nests): without it, two racing writers can
+        # persist the OLDER version last, and a restart would resume a
+        # state no watcher ever saw
+        with self._lock:
+            super().create(stored)
+            self._sync(self._key(stored))
         # the caller's handle gets the server-stamped metadata, like a
         # client receiving the created object back
         obj.metadata.resource_version = stored.metadata.resource_version
         obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
-        self._sync(self._key(stored))
         return obj
 
     def get(self, kind, name: str, namespace: str = "default"):
@@ -120,17 +125,20 @@ class FileClient(Client):
 
     def update(self, obj):
         stored = self._copy(obj)
-        super().update(stored)
+        with self._lock:
+            super().update(stored)
+            self._sync(self._key(stored))
         obj.metadata.resource_version = stored.metadata.resource_version
-        self._sync(self._key(stored))
         return obj
 
     def delete(self, obj, grace_period: Optional[float] = None):
-        stored = super().delete(obj, grace_period)
-        self._sync(self._key(stored))
+        with self._lock:
+            stored = super().delete(obj, grace_period)
+            self._sync(self._key(stored))
         return self._copy(stored)
 
     def remove_finalizer(self, obj, finalizer: str) -> None:
         key = self._key(obj)
-        super().remove_finalizer(obj, finalizer)
-        self._sync(key)
+        with self._lock:
+            super().remove_finalizer(obj, finalizer)
+            self._sync(key)

@@ -37,11 +37,15 @@ def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None):
         )
     devices = np.asarray(devices[:n])
     if data is None:
-        # favor the model axis: type-sharding keeps the big masks local
+        # measured, not assumed (hack/mesh_scaling.py, 50k x 800 over the
+        # virtual mesh): the packing scan is sequential over groups, so
+        # sharding the G axis forces collectives on every scan step —
+        # 8x1 ran 12x slower than single-device while 1x8 stayed within
+        # 1.6x. Pure model (type) sharding is the only factorization that
+        # keeps the sequential scan local; the data axis exists for
+        # embarrassingly-parallel multi-solve workloads, opt-in via
+        # ``data``.
         data = 1
-        for cand in (2, 4, 8):
-            if n % cand == 0 and cand * cand <= n:
-                data = cand
     model = n // data
     return jax.sharding.Mesh(devices.reshape(data, model), ("data", "model"))
 

@@ -95,8 +95,6 @@ class Results:
         domain — share these, and on shapes like the diverse mix (~1,000
         one-pod anti-affinity claims) the per-claim Python sort otherwise
         dwarfs the entire kernel solve."""
-        from ..api import labels as labels_mod
-
         valid = []
         memo: dict = {}
         okeys_memo: dict = {}
@@ -131,9 +129,22 @@ class Results:
                     and r.key not in okeys
                     and r.operator() in ("In", "Exists", "Gt", "Lt")
                 ))
+                # full requirement state, NOT repr: __repr__ is lossy
+                # ('k Exists' for both defined-Exists and undefined; Gt/Lt
+                # bounds drop intersected values), and defined-vs-undefined
+                # changes the Compatible asymmetry's verdict
+                def _req_state(k):
+                    if not reqs.has(k):
+                        return None
+                    r = reqs.get(k)
+                    return (
+                        r.complement, tuple(sorted(r.values)),
+                        r.greater_than, r.less_than,
+                    )
+
                 key = (
                     names,
-                    tuple(repr(reqs.get(k)) for k in okeys),
+                    tuple(_req_state(k) for k in okeys),
                     custom_pos,
                 )
                 hit = memo.get(key)

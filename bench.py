@@ -409,6 +409,17 @@ def main() -> None:
         )
         grid.append(run_config("identical", 500, 10, trials=2, with_oracle=True))
         grid.append(run_config("mixed", 5_000, 400, trials=2, with_oracle=True))
+        # the diverse 5-class mix joined the survival grid in round 5: the
+        # class-batched kernel + truncation memo brought it from 56 s (r3
+        # same-host) to ~1 s, so even the fallback grid can afford the
+        # shape the round's structural work targeted
+        grid.append(
+            run_config("diverse-ref", 5_000, 400, trials=2, with_oracle=False)
+        )
+        try:
+            grid.append(run_consolidation(2_000))
+        except Exception as exc:  # pragma: no cover - bench resilience
+            print(f"bench: consolidation config failed: {exc}", file=sys.stderr)
         headline = run_config(
             "constrained", N_HEADLINE_PODS, N_HEADLINE_TYPES, trials=1,
             with_oracle=False,

@@ -56,6 +56,56 @@ class KwokCloudProvider(CloudProvider):
         self._pending: List[tuple] = []  # (due_time, KwokInstance)
         self._registration_delay = registration_delay
         self._seq = itertools.count(1)
+        self._rehydrate()
+
+    def _rehydrate(self) -> None:
+        """Rebuild the simulated cloud from the store: a real provider's
+        instances outlive the controller process, so a restart over a
+        durable store (kube/filestore.py) must find its fleet intact —
+        otherwise garbage collection reaps every healthy claim as
+        'instance gone'. This is the provider side of the reference's
+        hydration concern (its clouds are genuinely external)."""
+        max_seq = 0
+        for claim in self._client.list(NodeClaim):
+            pid = claim.status.provider_id
+            if not pid or not pid.startswith("kwok://"):
+                continue
+            it = self._by_name.get(
+                claim.metadata.labels.get(labels_mod.INSTANCE_TYPE, "")
+            )
+            if it is None:
+                continue
+            zone = claim.metadata.labels.get(labels_mod.TOPOLOGY_ZONE, "")
+            ct = claim.metadata.labels.get(
+                labels_mod.CAPACITY_TYPE_LABEL_KEY, ""
+            )
+            offering = next(
+                (
+                    o
+                    for o in it.offerings
+                    if o.zone() == zone and o.capacity_type() == ct
+                ),
+                None,
+            ) or (it.offerings[0] if it.offerings else None)
+            if offering is None:
+                continue
+            node = self._client.try_get(Node, claim.name)
+            instance = None
+            if node is None:
+                # crashed between create() and registration: rebuild the
+                # pending registration too, or the Node never appears and
+                # liveness reaps the claim
+                node = self._to_node(claim, it, offering, pid)
+                instance = KwokInstance(pid, node, it, offering)
+                self._pending.append((self._client.clock.now(), instance))
+            self._instances[pid] = instance or KwokInstance(
+                pid, node, it, offering
+            )
+            tail = pid.rsplit("-", 1)[-1]
+            if tail.isdigit():
+                max_seq = max(max_seq, int(tail))
+        if max_seq:
+            self._seq = itertools.count(max_seq + 1)
 
     def name(self) -> str:
         return "kwok"

@@ -91,6 +91,7 @@ class Provisioner:
         batch_idle_duration: float = 1.0,
         batch_max_duration: float = 10.0,
         reserved_capacity_enabled: bool = False,
+        solver_address: Optional[str] = None,
     ):
         self.client = client
         self.cloud_provider = cloud_provider
@@ -98,12 +99,29 @@ class Provisioner:
         self.clock = client.clock
         self.recorder = recorder or Recorder(self.clock)
         self.solver_config = solver_config
+        # gRPC sidecar target (host:port). Set -> solves ship to the
+        # solver process (solver/service.py) instead of running in-process
+        self.solver_address = solver_address
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self._encode_cache = EncodeCache()  # survives across schedule() calls
         self.batcher = Batcher(self.clock, batch_idle_duration, batch_max_duration)
         self.volume_topology = VolumeTopology(client)
         self.volume_resolver = VolumeResolver(client)
         client.watch(self._on_event)
+
+    def _volume_objects(self, pods) -> List:
+        """PVC/PV/StorageClass objects the pending pods reference — the
+        sidecar rebuilds attach-limit/zonal state from these (wire.py)."""
+        from ..api.objects import (
+            PersistentVolume, PersistentVolumeClaim, StorageClass,
+        )
+
+        if not any(p.spec.volumes for p in pods):
+            return []
+        out: List = []
+        for kind in (PersistentVolumeClaim, PersistentVolume, StorageClass):
+            out.extend(self.client.list(kind))
+        return out
 
     # -- triggers (provisioning/controller.go:44-119) ---------------------
 
@@ -204,17 +222,34 @@ class Provisioner:
             self.client, state_nodes, node_pools, instance_types, pods,
             cluster=self.cluster,
         )
-        solver = TpuSolver(
-            node_pools,
-            instance_types,
-            topology,
-            state_nodes=state_nodes,
-            daemonset_pods=daemonset_pods,
-            config=self.solver_config,
-            encode_cache=self._encode_cache,
-            volume_resolver=self.volume_resolver,
-            reserved_capacity_enabled=self.reserved_capacity_enabled,
-        )
+        if self.solver_address:
+            # controller/sidecar split (deploy/docker-compose.yml): the
+            # solve ships over the gRPC seam with the full cluster view —
+            # state nodes, daemonsets, and the volume objects pending pods
+            # reference — so the sidecar packs identically to in-process
+            from ..solver.service import RemoteSolver
+
+            solver = RemoteSolver(
+                self.solver_address,
+                node_pools,
+                instance_types,
+                daemonset_pods=daemonset_pods,
+                state_nodes=state_nodes,
+                volume_objects=self._volume_objects(pods),
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+            )
+        else:
+            solver = TpuSolver(
+                node_pools,
+                instance_types,
+                topology,
+                state_nodes=state_nodes,
+                daemonset_pods=daemonset_pods,
+                config=self.solver_config,
+                encode_cache=self._encode_cache,
+                volume_resolver=self.volume_resolver,
+                reserved_capacity_enabled=self.reserved_capacity_enabled,
+            )
         # the in-flight-solve age gauge ticks on a side thread so the
         # metrics server can observe long solves mid-flight, the way the
         # reference's ticker does (scheduling/metrics.go:34-72)

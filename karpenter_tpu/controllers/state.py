@@ -249,6 +249,31 @@ class Cluster:
         client.watch(self._on_event)
         self._synced_once = False
         self._unsynced_since: Optional[float] = None
+        # informer semantics are LIST + watch, not watch alone: a cluster
+        # built over a pre-populated store (restart onto the file-backed
+        # backend, a late-started replica) replays current objects as
+        # synthetic ADDED events — without this, recovery sees an empty
+        # world and the controllers dismantle a healthy cluster
+        self._initial_list()
+
+    def _initial_list(self) -> None:
+        from ..api.objects import (
+            CSINode, DaemonSet, PersistentVolume, PersistentVolumeClaim,
+            StorageClass,
+        )
+
+        # claims before nodes (node events attach to tracked claims),
+        # nodes before pods (bindings attach to tracked nodes)
+        for kind in (
+            NodeClaim, Node, Pod, DaemonSet, CSINode,
+            PersistentVolumeClaim, PersistentVolume, StorageClass,
+        ):
+            try:
+                objs = self._client.list(kind)
+            except Exception:
+                continue
+            for obj in objs:
+                self._on_event(Event(ADDED, kind.__name__, obj))
 
     # -- sync gate (cluster.go:101-180; gauges state/metrics.go) ----------
 

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
+from contextlib import contextmanager
 from typing import Optional
 
 from .clock import Clock
@@ -40,6 +42,7 @@ class FileClient(Client):
         if root is None:
             raise ValueError("FileClient requires a root directory")
         self._root = root
+        self._tls = threading.local()
         os.makedirs(root, exist_ok=True)
         self._load()
 
@@ -90,19 +93,44 @@ class FileClient(Client):
 
     # -- Client overrides -------------------------------------------------
 
+    @contextmanager
+    def _atomic(self):
+        """Dict mutation + disk sync under one lock, watcher notification
+        AFTER release. Without the lock, two racing writers can persist
+        the OLDER version last (a restart would resume a state no watcher
+        ever saw); but the base Client deliberately notifies OUTSIDE its
+        lock — informer handlers take their own locks and also call back
+        into client reads, so notifying under the store lock is a classic
+        ABBA deadlock. Events buffer (with their under-lock snapshot
+        copies) and emit on exit."""
+        if getattr(self._tls, "pending", None) is not None:
+            yield  # nested: the outermost frame owns emission
+            return
+        buf: list = []
+        self._tls.pending = buf
+        try:
+            with self._lock:
+                yield
+        finally:
+            self._tls.pending = None
+        for ev in buf:
+            for handler in list(self._watchers):
+                # one fresh copy PER handler: watchers must not observe
+                # each other's mutations either
+                handler(Event(ev.type, ev.kind, self._copy(ev.object)))
+
     def _notify(self, event: Event) -> None:
-        # one fresh copy PER handler: watchers must not observe each
-        # other's mutations either (the contract this backend exists for)
+        buf = getattr(self._tls, "pending", None)
+        snapshot = Event(event.type, event.kind, self._copy(event.object))
+        if buf is not None:
+            buf.append(snapshot)
+            return
         for handler in list(self._watchers):
-            handler(Event(event.type, event.kind, self._copy(event.object)))
+            handler(Event(snapshot.type, snapshot.kind, self._copy(snapshot.object)))
 
     def create(self, obj):
         stored = self._copy(obj)
-        # mutation + disk sync under one lock (RLock, so the base method's
-        # own acquisition nests): without it, two racing writers can
-        # persist the OLDER version last, and a restart would resume a
-        # state no watcher ever saw
-        with self._lock:
+        with self._atomic():
             super().create(stored)
             self._sync(self._key(stored))
         # the caller's handle gets the server-stamped metadata, like a
@@ -125,20 +153,20 @@ class FileClient(Client):
 
     def update(self, obj):
         stored = self._copy(obj)
-        with self._lock:
+        with self._atomic():
             super().update(stored)
             self._sync(self._key(stored))
         obj.metadata.resource_version = stored.metadata.resource_version
         return obj
 
     def delete(self, obj, grace_period: Optional[float] = None):
-        with self._lock:
+        with self._atomic():
             stored = super().delete(obj, grace_period)
             self._sync(self._key(stored))
         return self._copy(stored)
 
     def remove_finalizer(self, obj, finalizer: str) -> None:
         key = self._key(obj)
-        with self._lock:
+        with self._atomic():
             super().remove_finalizer(obj, finalizer)
             self._sync(key)

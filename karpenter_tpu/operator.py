@@ -47,6 +47,9 @@ class OperatorOptions:
     node_repair: bool = False  # feature gate
     reserved_capacity: bool = False  # feature gate
     solver_config: Optional[SolverConfig] = None
+    # gRPC solver-sidecar target (deploy/docker-compose.yml's split); ""
+    # keeps solves in-process
+    solver_address: str = ""
     # active/passive HA (operator.go:137-141); in-process default is a
     # single operator, so election is opt-in via the CLI flags
     leader_election: bool = False
@@ -79,6 +82,7 @@ class OperatorOptions:
             or "kube-system",
             enable_profiling=opts.enable_profiling,
             solver_config=solver_config,
+            solver_address=opts.solver_address,
         )
 
 
@@ -105,6 +109,7 @@ class Operator:
             batch_idle_duration=self.options.batch_idle_duration,
             batch_max_duration=self.options.batch_max_duration,
             reserved_capacity_enabled=self.options.reserved_capacity,
+            solver_address=self.options.solver_address or None,
         )
         self.lifecycle = LifecycleController(client, cloud_provider, self.recorder)
         self.termination = TerminationController(client, cloud_provider, self.recorder)

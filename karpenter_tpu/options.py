@@ -139,6 +139,8 @@ class Options:
     # than one is present (SolverConfig.mesh), "" = single device
     solver_backend: str = "tpu"
     solver_mesh: str = ""
+    # gRPC solver-sidecar target (host:port); "" = solve in-process
+    solver_address: str = ""
 
     def validate(self) -> None:
         if self.log_level not in VALID_LOG_LEVELS:
@@ -214,6 +216,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env_str("SOLVER_BACKEND", d.solver_backend))
     p.add_argument("--solver-mesh", dest="solver_mesh",
                    default=_env_str("SOLVER_MESH", d.solver_mesh))
+    p.add_argument("--solver-address", dest="solver_address",
+                   default=_env_str(
+                       "KARPENTER_SOLVER_ADDRESS", d.solver_address))
     return p
 
 
@@ -241,6 +246,7 @@ def parse_options(argv: Optional[List[str]] = None) -> Options:
         instance_types_file_path=ns.instance_types_file_path,
         solver_backend=ns.solver_backend,
         solver_mesh=ns.solver_mesh,
+        solver_address=ns.solver_address,
     )
     opts.validate()
     return opts

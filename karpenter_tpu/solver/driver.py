@@ -17,6 +17,7 @@ paths agree on node count and packing cost (tests/test_solver_parity.py).
 from __future__ import annotations
 
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -31,7 +32,15 @@ from ..scheduling.inflight import RESERVED_OFFERING_MODE_STRICT
 from ..scheduling.scheduler import Results, Scheduler
 from ..scheduling.template import NodeClaimTemplate
 from ..scheduling.topology import Topology
+from ..utils.pretty import ChangeMonitor
 from . import encode as enc
+
+_LOG = logging.getLogger("karpenter_tpu.solver")
+# once per pod (24h TTL), not once per batch walk: long-pending pods are
+# re-partitioned every provisioning round (pretty.ChangeMonitor — the
+# reference gates its scheduling-relegation lines the same way,
+# provisioner.go:80,187-199)
+_ORACLE_ROUTE_CM = ChangeMonitor()
 
 
 class EncodeCache:
@@ -219,6 +228,14 @@ class TpuSolver:
             merge_bootstrap_affinity=not self.oracle.reserved_capacity_enabled,
         )
 
+        if rest and _LOG.isEnabledFor(logging.DEBUG):
+            for p in rest:
+                if _ORACLE_ROUTE_CM.has_changed(p.uid, "oracle-routed"):
+                    _LOG.debug(
+                        "pod %s routed to the host oracle (non-tensorizable"
+                        " constraints)",
+                        p.metadata.name,
+                    )
         tpu_claims: List[DecodedClaim] = []
         tpu_errors: Dict[str, object] = {}
         if groups:

@@ -243,3 +243,32 @@ __all__ = [
     "SOLVE_METHOD", "SolverService", "serve", "RemoteSolver",
     "RemoteExistingNode",
 ]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """Standalone sidecar binary: `python -m karpenter_tpu.solver.service`
+    — the deployable form of the controller/solver process split
+    (deploy/docker-compose.yml runs it next to the controller the way the
+    reference splits controller and cloud-provider concerns)."""
+    import argparse
+    import signal
+    import threading
+
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
+    parser.add_argument(
+        "--listen", default="0.0.0.0:50099",
+        help="host:port for the gRPC solve endpoint",
+    )
+    parser.add_argument("--max-workers", type=int, default=4)
+    args = parser.parse_args(argv)
+    server = serve(address=args.listen, max_workers=args.max_workers)
+    print(f"solver sidecar listening on {args.listen}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=5).wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -179,15 +179,23 @@ class PhaseTimer:
 class Scenario:
     """One operator + store + kwok environment with the simulation loop."""
 
-    def __init__(self, n_types: int = 24, operator_options=None):
+    def __init__(self, n_types: int = 24, operator_options=None,
+                 store_root: str = None):
         from karpenter_tpu.cloudprovider import corpus
         from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
-        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.kube import Client, FileClient, TestClock
         from karpenter_tpu.operator import Operator
         from karpenter_tpu.sim import Binder
 
         self.clock = TestClock()
-        self.client = Client(self.clock)
+        # store_root switches the scenario onto the file-backed store
+        # (kube/filestore.py): every object round-trips serialization and
+        # the run is resumable from disk — the envtest-like tier
+        self.client = (
+            FileClient(self.clock, root=store_root)
+            if store_root
+            else Client(self.clock)
+        )
         self.provider = KwokCloudProvider(self.client, corpus.generate(n_types))
         self.operator = Operator(
             self.client, self.provider, options=operator_options

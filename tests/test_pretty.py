@@ -1,0 +1,43 @@
+"""ChangeMonitor (utils/pretty.py): the reference's log-noise gate
+(pkg/utils/pretty/changemonitor.go)."""
+
+from karpenter_tpu.kube import TestClock
+from karpenter_tpu.utils.pretty import ChangeMonitor
+
+
+class TestChangeMonitor:
+    def test_first_observation_changes(self):
+        cm = ChangeMonitor()
+        assert cm.has_changed("k", "v")
+
+    def test_same_value_suppressed(self):
+        cm = ChangeMonitor()
+        cm.has_changed("k", {"a": 1})
+        assert not cm.has_changed("k", {"a": 1})
+
+    def test_value_change_fires(self):
+        cm = ChangeMonitor()
+        cm.has_changed("k", {"a": 1})
+        assert cm.has_changed("k", {"a": 2})
+        assert not cm.has_changed("k", {"a": 2})
+
+    def test_keys_independent(self):
+        cm = ChangeMonitor()
+        cm.has_changed("k1", "v")
+        assert cm.has_changed("k2", "v")
+
+    def test_dict_order_free(self):
+        cm = ChangeMonitor()
+        cm.has_changed("k", {"a": 1, "b": [1, 2]})
+        assert not cm.has_changed("k", {"b": [1, 2], "a": 1})
+
+    def test_ttl_readmits(self):
+        # the 24h default re-admits a line so restarted log collection
+        # still captures steady-state discoveries (changemonitor.go:28-31)
+        clock = TestClock()
+        cm = ChangeMonitor(ttl=100.0, clock=clock)
+        cm.has_changed("k", "v")
+        clock.step(50)
+        assert not cm.has_changed("k", "v")
+        clock.step(101)
+        assert cm.has_changed("k", "v")

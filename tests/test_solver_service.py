@@ -349,3 +349,51 @@ class TestSolverService:
             zone_req = claim.requirements.get("topology.kubernetes.io/zone")
             assert zone_req.has("test-zone-a")
         remote.close()
+
+
+class TestOperatorSidecarSplit:
+    def test_controller_routes_solves_to_sidecar(self, sidecar, monkeypatch):
+        """The deployable split (deploy/docker-compose.yml): an Operator
+        configured with solver_address must ship its provisioning solves
+        through RemoteSolver to the sidecar — and the pods still land."""
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.operator import Operator, OperatorOptions
+        from karpenter_tpu.sim import Binder
+        from karpenter_tpu.solver.service import RemoteSolver
+
+        calls = []
+        orig = RemoteSolver.solve
+
+        def spy(self, pods):
+            calls.append(len(pods))
+            return orig(self, pods)
+
+        monkeypatch.setattr(RemoteSolver, "solve", spy)
+
+        clock = TestClock()
+        client = Client(clock)
+        provider = KwokCloudProvider(client, corpus.generate(12))
+        op = Operator(
+            client, provider,
+            OperatorOptions(solver_address=sidecar),
+        )
+        binder = Binder(client)
+        client.create(make_nodepool(name="default"))
+        for i in range(8):
+            client.create(make_pod(name=f"split-{i}", cpu="1", memory="1Gi"))
+        for _ in range(6):
+            op.step(force_provision=True)
+            binder.bind_all()
+            clock.step(1)
+        assert calls and sum(calls) >= 8, calls
+        from karpenter_tpu.api.objects import Pod
+
+        unbound = [p for p in client.list(Pod) if not p.spec.node_name]
+        assert not unbound
+
+    def test_options_env_fallback(self, monkeypatch):
+        from karpenter_tpu.options import parse_options
+
+        monkeypatch.setenv("KARPENTER_SOLVER_ADDRESS", "solver:50099")
+        opts = parse_options([])
+        assert opts.solver_address == "solver:50099"

@@ -230,12 +230,16 @@ def run_config(
         entry["cost_delta"] = round(delta, 5)
         entry["oracle_nodes"] = oracle_results.node_count()
         if delta > COST_DELTA_BOUND:
+            # record the violation and keep benching: one config over the
+            # bound must not throw away the whole grid (the run still
+            # exits nonzero at the end). Known case: PARITY.md
+            # "Known cost-gap" — constrained 10k x 400 at ~+10%.
             print(
                 f"bench[{config}]: cost delta {delta:.4f} exceeds"
-                f" {COST_DELTA_BOUND:.2f} bound",
+                f" {COST_DELTA_BOUND:.2f} bound (recorded; bench continues)",
                 file=sys.stderr,
             )
-            sys.exit(1)
+            entry["cost_bound_violated"] = True
     return entry
 
 
@@ -504,6 +508,12 @@ def _emit(plat: str, fell_back: bool, grid: List[Dict], headline: Dict) -> None:
             }
         )
     )
+    violated = [e["config"] for e in grid if e.get("cost_bound_violated")]
+    if violated:
+        print(
+            f"bench: cost bound violated by: {violated}", file=sys.stderr
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -337,10 +337,24 @@ class Topology:
     def update(self, pod: Pod) -> None:
         """(Re)register the pod as owner of its topologies; called again
         after preference relaxation (topology.go:157-189)."""
-        for tg in self._owner_index.pop(pod.uid, ()):
-            tg.remove_owner(pod.uid)
+        spec = pod.spec
+        has_constraints = bool(
+            spec.topology_spread_constraints
+            or spec.pod_affinity
+            or spec.pod_anti_affinity
+            or spec.preferred_pod_affinity
+            or spec.preferred_pod_anti_affinity
+        )
+        if has_constraints or pod.uid in self._owner_index:
+            for tg in self._owner_index.pop(pod.uid, ()):
+                tg.remove_owner(pod.uid)
+        if not has_constraints:
+            # constraint-free pods own no topology groups; this walk runs
+            # once per pod per Topology build (50k times on the headline
+            # batch), so the common case takes one attribute sweep
+            return
 
-        if pod.spec.pod_anti_affinity:
+        if spec.pod_anti_affinity:
             self._update_inverse_anti_affinity(pod, None)
 
         groups = self._new_for_topologies(pod) + self._new_for_affinities(pod)

@@ -33,14 +33,14 @@ from karpenter_tpu.solver.workloads import (
 )
 
 
-def _solve(pods, classed, pools=None, n_types=30, state_nodes=()):
+def _make_solver(pods, classed=None, pools=None, n_types=30, state_nodes=()):
     pools = pools or [example_nodepool()]
     its = corpus.generate(n_types)
     its_by_pool = {p.name: list(its) for p in pools}
     topology = Topology(
         Client(TestClock()), list(state_nodes), pools, its_by_pool, pods
     )
-    solver = TpuSolver(
+    return TpuSolver(
         pools,
         its_by_pool,
         topology,
@@ -48,7 +48,13 @@ def _solve(pods, classed, pools=None, n_types=30, state_nodes=()):
         config=SolverConfig(classed=classed),
         encode_cache=EncodeCache(),
     )
-    return solver.solve(pods)
+
+
+def _solve(pods, classed, pools=None, n_types=30, state_nodes=()):
+    return _make_solver(
+        pods, classed=classed, pools=pools, n_types=n_types,
+        state_nodes=state_nodes,
+    ).solve(pods)
 
 
 def _signature(results):
@@ -146,6 +152,69 @@ class TestClassedEquivalence:
             )
         res = assert_equivalent(pods)
         assert not res.pod_errors
+
+    def test_mixed_domain_axes_split_classes(self):
+        """Zone-keyed AND capacity-type-keyed spread owners sharing one
+        feasibility class: the class partition must SPLIT the run (the
+        head's per-domain tables serve a single axis per class) and stay
+        exact."""
+        pods = []
+        for i in range(20):
+            v = "ab"[i % 2]
+            pods.append(
+                _pod(
+                    f"zs-{i}", 500, 512, labels={"mx": v},
+                    topology_spread_constraints=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=labels_mod.TOPOLOGY_ZONE,
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=LabelSelector(
+                                match_labels={"mx": v}
+                            ),
+                        )
+                    ],
+                )
+            )
+        for i in range(20):
+            v = "cd"[i % 2]
+            pods.append(
+                _pod(
+                    f"cs-{i}", 500, 512, labels={"mx": v},
+                    topology_spread_constraints=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=labels_mod.CAPACITY_TYPE_LABEL_KEY,
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=LabelSelector(
+                                match_labels={"mx": v}
+                            ),
+                        )
+                    ],
+                )
+            )
+        res = assert_equivalent(pods, n_types=30)
+        assert not res.pod_errors
+        # and the partition REALLY split on the axis: one signature run,
+        # two classes, one per domain key
+        from karpenter_tpu.solver import encode as enc
+
+        solver = _make_solver(pods, n_types=30)
+        groups, rest = enc.partition_and_group(
+            pods, topology=solver.oracle.topology
+        )
+        assert not rest
+        templates = solver.oracle.templates
+        snap = enc.encode(
+            groups, templates,
+            {t.node_pool_name: t.instance_type_options for t in templates},
+            daemon_overhead=solver.oracle.daemon_overhead,
+        )
+        _cs, cl, cdyn, cdk, _inv, _lmax = enc.class_partition(snap)
+        real = cl > 0
+        assert int(real.sum()) == 2, (cl, cdk)
+        assert sorted(cdk[real].tolist()) == [0, 1]  # zone axis + ct axis
+        assert cdyn[real].all()
 
     def test_contributors_interleave_owners(self):
         # plain pods whose labels feed spread constraints owned by later

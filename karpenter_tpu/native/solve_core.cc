@@ -523,6 +523,67 @@ int kt_solve(
         for (int n = 0; n < N && d_aff < 0; ++n)
           if (exist_cap[n] >= 1 && nd_slot[n] < V1) d_aff = nd_slot[n];
         if (d_aff < 0) {
+          // claim anchor (mirrors ops/packing.py): the oracle's bootstrap
+          // pod walks open claims least-loaded-first before opening
+          // fresh, so the least-loaded eligible PINNED claim's domain
+          // binds the family
+          int32_t best_load = kBigDom;
+          for (int s = 0; s < NMAX; ++s) {
+            if (!c_active[s]) continue;
+            int32_t pin = (dkey == 0) ? c_dzone[s] : c_dct[s];
+            if (pin < 0) continue;
+            if (c_npods[s] >= best_load) continue;
+            if (hc < 1) continue;
+            if (has_h &&
+                h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]) < 1)
+              continue;
+            const uint8_t* sm = c_mask.data() + static_cast<size_t>(s) * KV;
+            const uint8_t* sd = c_def.data() + static_cast<size_t>(s) * K;
+            const uint8_t* sn = c_neg.data() + static_cast<size_t>(s) * K;
+            bool compat = true;
+            for (int k = 0; k < K && compat; ++k) {
+              bool overlap = false;
+              for (int v = 0; v < V1; ++v)
+                if (sm[k * V1 + v] && gmask[k * V1 + v]) {
+                  overlap = true;
+                  break;
+                }
+              bool exempt = sn[k] && gneg[k];
+              if (!(overlap || exempt || !(sd[k] && gdef[k]))) compat = false;
+              if (gdef[k] && !well_known[k] && !sd[k] && !gneg[k])
+                compat = false;
+            }
+            int pp = c_pool[s];
+            compat = compat && p_tol[pp * G + gi] && compat_pg[pp * G + gi];
+            if (!compat) continue;
+            bool fits1 = false;
+            for (int t = 0; t < T && !fits1; ++t) {
+              if (!c_tmask[static_cast<size_t>(s) * T + t]) continue;
+              if (!type_ok_pgt[(static_cast<size_t>(pp) * G + gi) * T + t])
+                continue;
+              if (fits_count(t_alloc + t * R,
+                             c_used.data() + static_cast<size_t>(s) * R, req,
+                             R) < 1)
+                continue;
+              const uint8_t* azt =
+                  a_for_claim(s) + static_cast<size_t>(t) * V1 * V1;
+              for (int z = 0; z < V1 && !fits1; ++z) {
+                if (!(sm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z]))
+                  continue;
+                for (int c = 0; c < V1; ++c)
+                  if (azt[z * V1 + c] && sm[ct_kid * V1 + c] &&
+                      gmask[ct_kid * V1 + c]) {
+                    fits1 = true;
+                    break;
+                  }
+              }
+            }
+            if (!fits1) continue;
+            best_load = c_npods[s];
+            d_aff = pin;
+          }
+        }
+        if (d_aff < 0) {
           int32_t best_rank = kBigDom;
           for (int d = 0; d < V1; ++d)
             if (fresh_ok[d] && reg[d] && drank[d] < best_rank) {

@@ -453,6 +453,65 @@ def pack(
 
             toff_nt, toff_pt = jax.lax.cond(dyn, _domain_avail, _no_domain, None)
 
+        # ---- claim-side feasibility (shared by the affinity bootstrap's
+        # claim anchor, tier 2, and the survival update) ------------------
+        # claim-level compatibility with the group
+        overlap = jnp.any(state.c_mask & gmask[None, :, :], axis=-1)  # [NMAX,K]
+        exempt = state.c_neg & gneg[None, :]
+        key_ok = overlap | exempt | ~(state.c_def & gdef[None, :])
+        custom_ok = jnp.all(
+            ~gdef[None, :] | well_known[None, :] | state.c_def | gneg[None, :], axis=-1
+        )
+        claim_compat = jnp.all(key_ok, axis=-1) & custom_ok
+        claim_compat &= p_tol[state.c_pool, gi] & compat_row[state.c_pool]
+        claim_live = state.c_active & claim_compat
+
+        # per-type feasibility on each claim: current options ∧ (template ∪
+        # group) table ∧ fits under current load ∧ offering under merged masks
+        merged_mask = state.c_mask & gmask[None, :, :]
+        tm = state.c_tmask & type_ok_row[state.c_pool]
+        add_fit = fits_count(
+            t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
+        )  # [NMAX, T]
+        # joint zone×ct offering admissibility, one einsum (identical to
+        # any-domain of toff_nt, but computed for every step — toff_nt is
+        # zeros on non-dynamic steps)
+        off = (
+            jnp.einsum(
+                "nz,tzc,nc->nt",
+                cz.astype(jnp.float32), a_step_f, cc.astype(jnp.float32),
+            )
+            > 0
+        )
+        if NRES:
+            off_held = (
+                jnp.einsum(
+                    "nz,tzc,nc->nt",
+                    cz.astype(jnp.float32), a_held_f, cc.astype(jnp.float32),
+                )
+                > 0
+            )
+            off = jnp.where(state.c_resv[:, None], off_held, off)
+        tm = tm & off & (add_fit >= 1)
+
+        cap_any = jnp.where(claim_live, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0)
+
+        if has_domains:
+            # per-claim per-domain capacity, computed ONCE for dynamic
+            # groups and shared by the bootstrap anchor and tier 2 (the
+            # O(NMAX·T·V1) contraction is runtime-skipped otherwise)
+            percap_nt = jax.lax.cond(
+                dyn,
+                lambda _: jnp.max(
+                    jnp.where(
+                        tm[:, :, None] & toff_nt, add_fit[:, :, None], 0
+                    ),
+                    axis=1,
+                ),
+                lambda _: jnp.zeros((nmax, V1), jnp.int32),
+                None,
+            )  # [NMAX, V1]
+
         # ---- 1. existing nodes, fixed priority order ----
         exist_cap = jnp.where(
             cap_row > 0,
@@ -519,10 +578,17 @@ def pack(
                 jnp.where(reg, D0, _BIGI), scap, count, iters=wf_iters
             )  # [V1]
 
-            # AFFINITY bootstrap: all pods pin to ONE viable domain — the
-            # first fitting existing node's domain (the oracle walks nodes
-            # in priority order), else the lowest-rank (sorted-first)
-            # fresh-feasible domain (topologygroup.go:277-324).
+            # AFFINITY bootstrap: all pods pin to ONE viable domain. The
+            # oracle's bootstrap pod walks the normal FFD order — existing
+            # nodes in priority order, then open claims least-loaded
+            # first, then a fresh claim (topologygroup.go:277-324 +
+            # scheduler.go:357-425) — so the kernel anchors, in that
+            # order, to the first fitting node's domain, the least-loaded
+            # eligible PINNED claim's domain, and only then the
+            # lowest-rank fresh-feasible domain. Without the claim anchor
+            # every family bootstraps to the same lowest-rank zone
+            # (measured: 60% of the diverse mix's pods piled into one
+            # zone at ~3x the launch price).
             if N:
                 n_elig = (exist_cap >= 1) & (nd_slot < V1)
                 has_exist = jnp.any(n_elig)
@@ -531,6 +597,14 @@ def pack(
             else:
                 has_exist = jnp.bool_(False)
                 d_exist = jnp.int32(0)
+            # claim anchor, from the shared claim-side feasibility tensors
+            ccap_a = jnp.minimum(jnp.max(percap_nt, axis=1), hcap)
+            ccap_a = jnp.minimum(ccap_a, _h_allow(state.ch_cnt[:, jhc]))
+            pin_axis = jnp.where(dkey == 0, state.c_dzone, state.c_dct)
+            elig_c = claim_live & (pin_axis >= 0) & (ccap_a >= 1)
+            has_claim = jnp.any(elig_c)
+            nstar_c = jnp.argmin(jnp.where(elig_c, state.c_npods, _BIGI))
+            d_claim = jnp.clip(pin_axis[nstar_c], 0, V1 - 1)
             fresh_feas = fresh_ok_d & reg
             d_fresh = jnp.argmin(jnp.where(fresh_feas, drank, _BIGI))
             # shared affinity: once a sharing group has placed pods, the
@@ -539,9 +613,17 @@ def pack(
             nonempty = (D0 > 0) & reg
             d_follow = jnp.argmin(jnp.where(nonempty, drank, _BIGI))
             follow = jnp.any(nonempty)
-            aff_feasible = follow | has_exist | jnp.any(fresh_feas)
+            aff_feasible = (
+                follow | has_exist | has_claim | jnp.any(fresh_feas)
+            )
             d_aff = jnp.where(
-                follow, d_follow, jnp.where(has_exist, d_exist, d_fresh)
+                follow,
+                d_follow,
+                jnp.where(
+                    has_exist,
+                    d_exist,
+                    jnp.where(has_claim, d_claim, d_fresh),
+                ),
             )
             q_aff = jnp.where(
                 aff_feasible,
@@ -605,48 +687,8 @@ def pack(
         exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
         nhc = state.nhc + exist_fill[:, None] * jh_oh[None, :]
 
-        # ---- 2. open claims, least-loaded first ----
-        # claim-level compatibility with the group
-        overlap = jnp.any(state.c_mask & gmask[None, :, :], axis=-1)  # [NMAX,K]
-        exempt = state.c_neg & gneg[None, :]
-        key_ok = overlap | exempt | ~(state.c_def & gdef[None, :])
-        custom_ok = jnp.all(
-            ~gdef[None, :] | well_known[None, :] | state.c_def | gneg[None, :], axis=-1
-        )
-        claim_compat = jnp.all(key_ok, axis=-1) & custom_ok
-        claim_compat &= p_tol[state.c_pool, gi] & compat_row[state.c_pool]
-        claim_live = state.c_active & claim_compat
-
-        # per-type feasibility on each claim: current options ∧ (template ∪
-        # group) table ∧ fits under current load ∧ offering under merged masks
-        merged_mask = state.c_mask & gmask[None, :, :]
-        tm = state.c_tmask & type_ok_row[state.c_pool]
-        add_fit = fits_count(
-            t_alloc[None, :, :], state.c_used[:, None, :], req[None, None, :]
-        )  # [NMAX, T]
-        # joint zone×ct offering admissibility, one einsum (identical to
-        # any-domain of toff_nt, but computed for every step — toff_nt is
-        # zeros on non-dynamic steps)
-        off = (
-            jnp.einsum(
-                "nz,tzc,nc->nt",
-                cz.astype(jnp.float32), a_step_f, cc.astype(jnp.float32),
-            )
-            > 0
-        )
-        if NRES:
-            off_held = (
-                jnp.einsum(
-                    "nz,tzc,nc->nt",
-                    cz.astype(jnp.float32), a_held_f, cc.astype(jnp.float32),
-                )
-                > 0
-            )
-            off = jnp.where(state.c_resv[:, None], off_held, off)
-        tm = tm & off & (add_fit >= 1)
-
-        cap_any = jnp.where(claim_live, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0)
-
+        # ---- 2. open claims, least-loaded first (feasibility tensors
+        # computed above, shared with the bootstrap anchor) ----
         def _clamp(cap):
             cap = jnp.minimum(cap, hcap)  # open claims carry no prior
             cap = jnp.minimum(cap, count)  # keeps int32 waterfill sums safe
@@ -679,10 +721,7 @@ def pack(
             # per claim (the admissible domain with the largest remaining
             # quota); runtime-skipped for non-dynamic groups
             def _tier2_domains(_):
-                percap = jnp.max(
-                    jnp.where(tm[:, :, None] & toff_nt, add_fit[:, :, None], 0),
-                    axis=1,
-                )  # [NMAX, V1]
+                percap = percap_nt  # shared with the bootstrap anchor
                 adm = (
                     claim_live[:, None]
                     & (percap >= 1)
@@ -1455,14 +1494,40 @@ def pack_classed(
                 else:
                     has_exist = jnp.bool_(False)
                     d_exist = jnp.int32(0)
+                # claim anchor (see pack()'s bootstrap): the least-loaded
+                # eligible pinned claim binds the family before any fresh
+                # domain does; percapv IS pack()'s percap here
+                ccap_a = jnp.minimum(jnp.max(percapv, axis=1), hcap)
+                ccap_a = jnp.minimum(
+                    ccap_a, _h_allow(state.ch_cnt[:, jhc])
+                )
+                pin_axis = jnp.where(
+                    cdk == 0, state.c_dzone, state.c_dct
+                )
+                elig_c = (
+                    state.c_active & live & (pin_axis >= 0) & (ccap_a >= 1)
+                )
+                has_claim = jnp.any(elig_c)
+                nstar_c = jnp.argmin(
+                    jnp.where(elig_c, state.c_npods, _BIGI)
+                )
+                d_claim = jnp.clip(pin_axis[nstar_c], 0, V1 - 1)
                 fresh_feas = fresh_ok_d0 & reg
                 d_fresh = jnp.argmin(jnp.where(fresh_feas, drank, _BIGI))
                 nonempty = (D0 > 0) & reg
                 d_follow = jnp.argmin(jnp.where(nonempty, drank, _BIGI))
                 follow = jnp.any(nonempty)
-                aff_feasible = follow | has_exist | jnp.any(fresh_feas)
+                aff_feasible = (
+                    follow | has_exist | has_claim | jnp.any(fresh_feas)
+                )
                 d_aff = jnp.where(
-                    follow, d_follow, jnp.where(has_exist, d_exist, d_fresh)
+                    follow,
+                    d_follow,
+                    jnp.where(
+                        has_exist,
+                        d_exist,
+                        jnp.where(has_claim, d_claim, d_fresh),
+                    ),
                 )
                 q_aff = jnp.where(
                     aff_feasible,

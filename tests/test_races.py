@@ -123,6 +123,75 @@ class TestStoreAndClusterRaces:
             for p in sn.pods:
                 assert p.spec.node_name == sn.name
 
+    def test_file_store_churn_no_deadlock(self, tmp_path):
+        """The file backend persists under the store lock but must notify
+        watchers OUTSIDE it: the cluster cache takes its own lock in
+        handlers and calls back into client reads (the ABBA pair). Churn
+        + a synced()-polling reader would deadlock in seconds if
+        notification ever moved back under the lock."""
+        from karpenter_tpu.kube import FileClient
+
+        clock = TestClock()
+        client = FileClient(clock, root=str(tmp_path / "store"))
+        cluster = Cluster(client)
+        errors: list = []
+        stop = threading.Event()
+        barrier = threading.Barrier(4)
+
+        def churn(tid: int):
+            try:
+                barrier.wait()
+                for i in range(60):
+                    ident = tid * 1000 + i
+                    node = _node(ident)
+                    client.create(node)
+                    node.status.ready = i % 2 == 0
+                    client.update(node)
+                    if i % 3 == 0:
+                        client.delete(node)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def reader():
+            try:
+                barrier.wait()
+                while not stop.is_set():
+                    cluster.synced()  # cluster lock -> client.list
+                    for sn in cluster.nodes():
+                        sn.available()
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        # daemon threads: if the deadlock this test hunts regresses, the
+        # assertion below reports it and the interpreter can still exit
+        # (non-daemon wedged threads would hang pytest shutdown instead)
+        threads = [
+            threading.Thread(target=churn, args=(t,), daemon=True)
+            for t in range(3)
+        ]
+        rd = threading.Thread(target=reader, daemon=True)
+        for t in threads:
+            t.start()
+        rd.start()
+        for t in threads:
+            t.join(60)
+        alive = [t for t in threads if t.is_alive()]
+        stop.set()
+        rd.join(30)
+        assert not alive, "deadlock: churn threads never finished"
+        assert not errors, errors
+        # a fresh client over the directory resumes the EXACT final state
+        # — versions included: the lost-update hazard _atomic prevents
+        # keeps the name set intact but resurrects older resource versions
+        client2 = FileClient(clock, root=str(tmp_path / "store"))
+        assert {
+            (n.name, n.metadata.resource_version)
+            for n in client2.list(Node)
+        } == {
+            (n.name, n.metadata.resource_version)
+            for n in client.list(Node)
+        }
+
     def test_provisioner_disruption_orchestration_triangle(self):
         """The triangle VERDICT r4 #8 names: provisioning solves,
         disruption decisions (which mutate the orchestration queue), and

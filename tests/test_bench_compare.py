@@ -57,6 +57,40 @@ class TestCompareGrids:
         ]))
         assert compare_grids(old, new) == 1
 
+    def test_sub_noise_floor_not_enforced(self, tmp_path):
+        # a 23 -> 29 ms swing is scheduler jitter, not a regression; the
+        # floor keeps the gate meaningful for the configs that matter
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 23.0),
+            _entry("constrained", 50000, 800, 420.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 29.0),  # +26% but sub-floor
+            _entry("constrained", 50000, 800, 410.0),
+        ]))
+        assert compare_grids(old, new) == 0
+
+    def test_noise_floor_crossing_enforced(self, tmp_path):
+        # a config that grows THROUGH the floor is a real regression
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 90.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 150.0),
+        ]))
+        assert compare_grids(old, new) == 1
+
+    def test_big_swing_under_floor_enforced(self, tmp_path):
+        # a multi-x slowdown is enforced even when both sides sit under
+        # the floor: the jitter exemption also bounds the absolute swing
+        old = _write(tmp_path, "old.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 20.0),
+        ]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [
+            _entry("mixed", 5000, 400, 95.0),
+        ]))
+        assert compare_grids(old, new) == 1
+
     def test_platform_mismatch_not_enforced(self, tmp_path):
         old = _write(tmp_path, "old.json", _grid("cpu", [
             _entry("mixed", 5000, 400, 100.0),

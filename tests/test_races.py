@@ -179,6 +179,7 @@ class TestStoreAndClusterRaces:
         stop.set()
         rd.join(30)
         assert not alive, "deadlock: churn threads never finished"
+        assert not rd.is_alive(), "reader wedged"
         assert not errors, errors
         # a fresh client over the directory resumes the EXACT final state
         # — versions included: the lost-update hazard _atomic prevents

@@ -28,6 +28,15 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+# silence XLA's ~2 KB host-feature-mismatch warning ("This could lead to
+# execution errors such as SIGILL"): it fires when the persistent
+# compilation cache replays an executable compiled on a different host and
+# floods the captured BENCH_*.json stderr tail with CPU feature flags.
+# Must be set before the first jax import in this process AND is inherited
+# by the TPU-probe subprocess. Level 2 filters INFO+WARNING; real errors
+# still surface.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
 N_HEADLINE_PODS = 50_000
 N_HEADLINE_TYPES = 800
 BASELINE_PODS_PER_SEC = 100.0  # reference floor, scheduling_benchmark_test.go:51

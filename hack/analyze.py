@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Wrapper for the static-analysis tier: ``hack/analyze.py [args...]``.
+
+Equivalent to ``python -m karpenter_tpu.analysis`` run from the repo root;
+exists so presubmit and editors have a stable path that works from any cwd.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    from karpenter_tpu.analysis.cli import main
+
+    argv = sys.argv[1:]
+    if "--root" not in argv:
+        argv = ["--root", REPO_ROOT] + argv
+    raise SystemExit(main(argv))

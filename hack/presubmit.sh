@@ -9,6 +9,22 @@ cd "$(dirname "$0")/.."
 echo "== compileall =="
 python -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
 
+# the `go vet` analog: AST passes for tracer-safety in the kernels, lock
+# ordering / callback-under-lock in the store layer, blocking calls in
+# reconcile paths, and schema<->CRD drift (karpenter_tpu/analysis/)
+echo "== static analysis =="
+python -m karpenter_tpu.analysis
+
+# style tier: pycodestyle/pyflakes subset via ruff ([tool.ruff] in
+# pyproject.toml). Gated: the container doesn't bake ruff in, and the
+# analyzer above carries the correctness-critical checks either way.
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  ruff check .
+else
+  echo "== ruff == (not installed; skipping style tier)"
+fi
+
 echo "== native build =="
 python -c "from karpenter_tpu import native; native.build(force=True); print('ok')"
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import signal
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
@@ -114,9 +113,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
 
+    # tick through the injected clock (a RealClock here, but the same seam
+    # tests drive with a TestClock — and the blocking-call lint enforces)
     while not stop.is_set():
         operator.step()
-        time.sleep(1.0)
+        operator.clock.sleep(1.0)
 
     metrics_server.shutdown()
     health_server.shutdown()

@@ -1,0 +1,25 @@
+"""Static-analysis tier: AST passes for the hazards the runtime cannot see.
+
+The reference's presubmit leans on ``go vet`` + the race detector; this
+package is the Python/JAX analog, purpose-built for this codebase's two
+dangerous seams:
+
+- the batched XLA kernels (ops/, solver/), where host Python control flow
+  on traced values silently recompiles or miscomputes (tracer.py);
+- the threaded store/state layer, where lock-order inversions and
+  callbacks invoked under a lock are the deadlock class tests/test_races.py
+  can only catch dynamically (locks.py).
+
+Plus two cheaper contract checks: blocking calls in reconcile paths that
+must go through the injectable kube/clock.py (blocking.py), and structural
+drift between api/schema.py and the checked-in CRD YAML (schema_drift.py).
+
+Run ``python -m karpenter_tpu.analysis`` (or hack/analyze.py); it exits
+nonzero on any new finding. Suppress with an inline
+``# analysis: ignore[RULE] reason`` on the flagged line (or the line
+above), or a baseline entry in hack/analysis_baseline.txt.
+"""
+
+from .findings import Finding, Severity, load_baseline, filter_suppressed
+
+__all__ = ["Finding", "Severity", "load_baseline", "filter_suppressed"]

@@ -1,0 +1,164 @@
+"""CLI for the static-analysis tier: ``python -m karpenter_tpu.analysis``.
+
+Default targets mirror the hazards each pass exists for:
+
+- tracer:   karpenter_tpu/ops, karpenter_tpu/solver
+- locks:    kube/store.py, kube/filestore.py, controllers/state.py,
+            solver/driver.py, metrics/registry.py
+- blocking: karpenter_tpu/controllers, karpenter_tpu/__main__.py
+- schema:   api/schema.py vs api/crds/
+
+Positional paths (with ``--pass``) override a pass's default targets so
+fixture suites can point a single pass at seeded-bad files. Exit status is
+the number of unsuppressed findings capped at 1 — suitable for presubmit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+from . import blocking, locks, schema_drift, tracer
+from .findings import (
+    Finding,
+    Severity,
+    SourceFile,
+    filter_suppressed,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join("hack", "analysis_baseline.txt")
+
+PASS_TARGETS = {
+    "tracer": ["karpenter_tpu/ops", "karpenter_tpu/solver"],
+    "locks": [
+        "karpenter_tpu/kube/store.py",
+        "karpenter_tpu/kube/filestore.py",
+        "karpenter_tpu/controllers/state.py",
+        "karpenter_tpu/solver/driver.py",
+        "karpenter_tpu/metrics/registry.py",
+    ],
+    "blocking": ["karpenter_tpu/controllers", "karpenter_tpu/__main__.py"],
+    "schema": ["karpenter_tpu/api/schema.py", "karpenter_tpu/api/crds"],
+}
+
+
+def _run_pass(name: str, targets: List[str]):
+    if name == "tracer":
+        return tracer.check_paths(targets)
+    if name == "locks":
+        return locks.check_paths(targets)
+    if name == "blocking":
+        return blocking.check_paths(targets)
+    if name == "schema":
+        schema_py = targets[0]
+        crd_dir = targets[1] if len(targets) > 1 else os.path.join(
+            os.path.dirname(targets[0]), "crds"
+        )
+        return schema_drift.check_schema(schema_py, crd_dir)
+    raise ValueError(f"unknown pass {name!r}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.analysis",
+        description="AST static analysis: tracer-safety, lock ordering, "
+        "blocking calls, schema drift",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="override the selected pass's default targets "
+        "(requires exactly one --pass)",
+    )
+    parser.add_argument(
+        "--pass", dest="passes", action="append",
+        choices=sorted(PASS_TARGETS),
+        help="run only the named pass(es); default: all",
+    )
+    parser.add_argument(
+        "--root", default=".",
+        help="repo root the default targets are relative to",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help=f"baseline file of tolerated findings (default: "
+        f"{DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline file (report everything)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    selected = args.passes or sorted(PASS_TARGETS)
+    if args.paths and len(selected) != 1:
+        parser.error("explicit paths require exactly one --pass")
+
+    root = os.path.abspath(args.root)
+    all_findings: List[Finding] = []
+    all_sources: Dict[str, SourceFile] = {}
+    for name in selected:
+        if args.paths:
+            targets = args.paths
+        else:
+            targets = [os.path.join(root, t) for t in PASS_TARGETS[name]]
+            targets = [t for t in targets if os.path.exists(t)]
+            if not targets:
+                continue
+        findings, sources = _run_pass(name, targets)
+        all_findings.extend(findings)
+        all_sources.update(sources)
+
+    # repo-relative paths in output and baseline keys
+    def relativize(f: Finding) -> Finding:
+        rel = os.path.relpath(f.path, root)
+        if rel.startswith(".."):
+            rel = f.path
+        return Finding(f.rule, f.severity, rel, f.line, f.message)
+
+    rel_sources = {}
+    for path, src in all_sources.items():
+        rel = os.path.relpath(path, root)
+        rel_sources[rel if not rel.startswith("..") else path] = src
+    all_findings = [relativize(f) for f in all_findings]
+
+    baseline_path = (
+        args.baseline
+        if os.path.isabs(args.baseline)
+        else os.path.join(root, args.baseline)
+    )
+    baseline = None if args.no_baseline else load_baseline(baseline_path)
+    remaining = filter_suppressed(all_findings, rel_sources, baseline)
+
+    if args.write_baseline:
+        # regenerate from the inline-filtered set only: filtering through
+        # the existing baseline would drop still-needed grandfathered
+        # entries from the rewritten file
+        grandfather = filter_suppressed(all_findings, rel_sources, None)
+        write_baseline(baseline_path, grandfather)
+        print(
+            f"analysis: wrote {len(grandfather)} finding(s) to "
+            f"{os.path.relpath(baseline_path, root)}"
+        )
+        return 0
+
+    for f in sorted(remaining, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    suppressed = len(all_findings) - len(remaining)
+    errors = [f for f in remaining if f.severity == Severity.ERROR]
+    summary = f"analysis: {len(remaining)} finding(s)"
+    if len(remaining) != len(errors):
+        summary += f" ({len(remaining) - len(errors)} warning-only)"
+    if suppressed:
+        summary += f" ({suppressed} suppressed)"
+    print(summary, file=sys.stderr)
+    # warnings (e.g. "pass skipped: PyYAML unavailable") inform but don't
+    # fail presubmit; only error-severity findings gate
+    return 1 if errors else 0

@@ -1,0 +1,121 @@
+"""Finding type, severities, and the two suppression channels.
+
+A finding is suppressed either by an inline marker::
+
+    risky_call()  # analysis: ignore[LCK202] informer handlers are our own
+
+on the flagged line or the line directly above it, or by a baseline entry
+(hack/analysis_baseline.txt): tab-separated ``RULE<TAB>path<TAB>message``,
+matched line-number-insensitively so unrelated edits don't churn the file.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # e.g. TRC101
+    severity: str  # Severity.*
+    path: str  # repo-relative when produced by the CLI
+    line: int  # 1-based; 0 when the finding has no single line
+    message: str
+
+    def render(self) -> str:
+        return f"{self.severity}[{self.rule}] {self.path}:{self.line}: {self.message}"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+
+_IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+
+def inline_suppressions(source_lines: Sequence[str]) -> dict:
+    """{line_number: {rules}} for every inline ignore marker. A marker
+    suppresses its own line and the line below (so block statements like
+    ``with`` can carry the marker above the flagged call)."""
+    out: dict = {}
+    for i, text in enumerate(source_lines, start=1):
+        m = _IGNORE_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def load_baseline(path: str) -> Set[Tuple[str, str, str]]:
+    entries: Set[Tuple[str, str, str]] = set()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for raw in fh:
+                line = raw.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split("\t", 2)
+                if len(parts) == 3:
+                    entries.add((parts[0], parts[1], parts[2]))
+    except OSError:
+        pass
+    return entries
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    keys = sorted({f.baseline_key() for f in findings})
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(
+            "# Static-analysis baseline: known findings tolerated by\n"
+            "# `python -m karpenter_tpu.analysis`. One per line,\n"
+            "# RULE<TAB>path<TAB>message. Regenerate with --write-baseline;\n"
+            "# prefer inline `# analysis: ignore[RULE] reason` for findings\n"
+            "# that are intentionally safe.\n"
+        )
+        for rule, fpath, message in keys:
+            fh.write(f"{rule}\t{fpath}\t{message}\n")
+
+
+@dataclass
+class SourceFile:
+    """Parsed-source handle shared by the passes (one read per file)."""
+
+    path: str
+    text: str
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.text.splitlines()
+        self._suppressions = inline_suppressions(self.lines)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        return rule in self._suppressions.get(line, ())
+
+
+def filter_suppressed(
+    findings: Iterable[Finding],
+    sources: Optional[dict] = None,
+    baseline: Optional[Set[Tuple[str, str, str]]] = None,
+) -> List[Finding]:
+    """Drop findings covered by inline markers or the baseline.
+
+    ``sources`` maps finding.path -> SourceFile (for inline markers).
+    """
+    out: List[Finding] = []
+    for f in findings:
+        if baseline and f.baseline_key() in baseline:
+            continue
+        src = (sources or {}).get(f.path)
+        if src is not None and src.suppressed(f.line, f.rule):
+            continue
+        out.append(f)
+    return out

@@ -1,0 +1,440 @@
+"""Pass 1: tracer-safety for the JAX kernels (ops/, solver/).
+
+Inside a jit region every array is a tracer: Python ``if``/``while`` on a
+traced value raises (or silently specializes), host materialization
+(``float()``, ``.item()``, ``.tolist()``) forces a device sync per call,
+and ``numpy``/``random``/``time`` execute at trace time with stale values.
+The kernels avoid all of this by construction — branching only on static
+Python scalars (shape components, ``static_argnames``) — and this pass
+pins that convention.
+
+Traced-function discovery:
+- decorated with ``jax.jit`` (directly or via ``partial(jax.jit, ...)``);
+- named ``solve_core*`` (the kernel entry naming convention);
+- wrapped at module level (``solve_all = jax.jit(solve_core, ...)``);
+- referenced from the body of any traced function (covers helpers passed
+  as arguments, e.g. the ``packer`` callables), transitively across the
+  scanned file set.
+
+Value classification inside a traced function: unannotated positional
+parameters are traced arrays; parameters with scalar annotations
+(``int``/``bool``/``float``/``str``) or keyword-only parameters are trace-time
+statics, as are ``.shape``/``.ndim``/``.size``/``.dtype``/``len()`` projections.
+Locals inherit from their right-hand sides.
+
+Rules:
+- TRC101: ``if``/``while``/ternary on a traced value
+- TRC102: host materialization of a traced value
+- TRC103: ``numpy``/``random``/``time`` use inside a jit region
+- TRC104: Python loop over a traced value (data-dependent trip count)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import (
+    FunctionIndex,
+    call_name,
+    dotted_name,
+    import_aliases,
+    iter_py_files,
+    parse_file,
+)
+from .findings import Finding, Severity, SourceFile
+
+TRACED = 2
+STATIC = 0
+
+_STATIC_ANNOTATIONS = {"int", "bool", "float", "str"}
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+_STATIC_BUILTINS = {"len", "isinstance", "issubclass", "getattr", "hasattr",
+                    "type", "repr", "str", "print"}
+_PROPAGATING_BUILTINS = {"range", "min", "max", "sum", "abs", "enumerate",
+                         "zip", "sorted", "reversed", "tuple", "list", "map",
+                         "filter"}
+_MATERIALIZERS = {"float", "int", "bool", "complex"}
+_MATERIALIZER_METHODS = {"item", "tolist"}
+_TRACED_ORIGINS = ("jax.numpy", "jax.lax", "jax.nn", "jax.scipy")
+_HOST_ORIGINS = ("numpy", "random", "time")
+
+
+class _Env:
+    def __init__(self, parent: Optional["_Env"] = None):
+        self.parent = parent
+        self.kinds: Dict[str, int] = {}
+
+    def get(self, name: str) -> Optional[int]:
+        env: Optional[_Env] = self
+        while env is not None:
+            if name in env.kinds:
+                return env.kinds[name]
+            env = env.parent
+        return None
+
+    def set(self, name: str, kind: int) -> None:
+        self.kinds[name] = kind
+
+
+class _Module:
+    def __init__(self, path: str, src: SourceFile, tree: ast.Module):
+        self.path = path
+        self.src = src
+        self.tree = tree
+        self.aliases = import_aliases(tree)
+        self.index = FunctionIndex(tree)
+        self.static_names: Set[str] = _collect_static_argnames(tree)
+
+
+def _collect_static_argnames(tree: ast.Module) -> Set[str]:
+    """Names listed in any static_argnames=(...) in the module: they are
+    trace-time statics wherever they appear as parameters."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.keyword) and node.arg == "static_argnames":
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    out.add(sub.value)
+    return out
+
+
+def _is_jit_decorator(dec: ast.AST, aliases: Dict[str, str]) -> bool:
+    name = dotted_name(dec)
+    if name is None and isinstance(dec, ast.Call):
+        cname = call_name(dec, aliases)
+        if cname in ("functools.partial", "partial") and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner is not None:
+                return _canonical(inner, aliases) in ("jax.jit", "jit")
+        return cname in ("jax.jit", "jit")
+    if name is None:
+        return False
+    return _canonical(name, aliases) in ("jax.jit", "jit")
+
+
+def _canonical(name: str, aliases: Dict[str, str]) -> str:
+    head, _, rest = name.partition(".")
+    origin = aliases.get(head, head)
+    return origin + ("." + rest if rest else "")
+
+
+def _resolve_function(
+    mod: _Module, name: str, modules: Dict[str, _Module]
+) -> Optional[Tuple[_Module, ast.FunctionDef]]:
+    """Resolve a bare name used in ``mod`` to a function def in the scanned
+    set — locally, or through a ``from .x import name`` alias."""
+    if name in mod.index.functions:
+        return mod, mod.index.functions[name]
+    origin = mod.aliases.get(name)
+    if not origin or "." not in origin:
+        return None
+    mod_part, _, fn_name = origin.rpartition(".")
+    base = mod_part.lstrip(".") or ""
+    tail = base.rpartition(".")[2] if base else ""
+    for other in modules.values():
+        stem = os.path.splitext(os.path.basename(other.path))[0]
+        if stem == tail and fn_name in other.index.functions:
+            return other, other.index.functions[fn_name]
+    return None
+
+
+def _traced_functions(modules: Dict[str, _Module]) -> Set[Tuple[str, str]]:
+    """Fixpoint of (module_path, function_name) trace roots + references."""
+    traced: Set[Tuple[str, str]] = set()
+    for mod in modules.values():
+        for fname, fn in mod.index.functions.items():
+            if fname.startswith("solve_core"):
+                traced.add((mod.path, fname))
+            if any(_is_jit_decorator(d, mod.aliases) for d in fn.decorator_list):
+                traced.add((mod.path, fname))
+        # module-level jax.jit(fn, ...) wrappers
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                if call_name(node, mod.aliases) in ("jax.jit", "jit") and node.args:
+                    inner = dotted_name(node.args[0])
+                    if inner and inner in mod.index.functions:
+                        traced.add((mod.path, inner))
+    # propagate through references from traced bodies
+    changed = True
+    while changed:
+        changed = False
+        for mod in modules.values():
+            for fname, fn in mod.index.functions.items():
+                if (mod.path, fname) not in traced:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                        hit = _resolve_function(mod, node.id, modules)
+                        if hit is not None:
+                            key = (hit[0].path, hit[1].name)
+                            if key not in traced:
+                                traced.add(key)
+                                changed = True
+    return traced
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Sequentially walks one traced function, tracking value kinds."""
+
+    def __init__(self, mod: _Module, findings: List[Finding], env: _Env):
+        self.mod = mod
+        self.findings = findings
+        self.env = env
+        self._flagged_lines: Set[Tuple[int, str]] = set()
+
+    # -- reporting --------------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if (line, rule) in self._flagged_lines:
+            return
+        self._flagged_lines.add((line, rule))
+        self.findings.append(
+            Finding(rule, Severity.ERROR, self.mod.path, line, message)
+        )
+
+    # -- classification ---------------------------------------------------
+
+    def kind(self, node: ast.AST) -> int:
+        if isinstance(node, ast.Constant):
+            return STATIC
+        if isinstance(node, ast.Name):
+            known = self.env.get(node.id)
+            return known if known is not None else STATIC
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return STATIC
+            return self.kind(node.value)
+        if isinstance(node, ast.Subscript):
+            return max(self.kind(node.value), self.kind(node.slice))
+        if isinstance(node, ast.Call):
+            return self._call_kind(node)
+        if isinstance(node, (ast.BinOp,)):
+            return max(self.kind(node.left), self.kind(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.kind(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return max((self.kind(v) for v in node.values), default=STATIC)
+        if isinstance(node, ast.Compare):
+            # `is None` / `is not None` inspect the python value, not data
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return STATIC
+            return max(
+                self.kind(node.left),
+                max((self.kind(c) for c in node.comparators), default=STATIC),
+            )
+        if isinstance(node, ast.IfExp):
+            return max(self.kind(node.body), self.kind(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return max((self.kind(e) for e in node.elts), default=STATIC)
+        if isinstance(node, ast.Starred):
+            return self.kind(node.value)
+        if isinstance(node, ast.Slice):
+            parts = [p for p in (node.lower, node.upper, node.step) if p]
+            return max((self.kind(p) for p in parts), default=STATIC)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return max(
+                (self.kind(g.iter) for g in node.generators), default=STATIC
+            )
+        return STATIC
+
+    def _call_kind(self, node: ast.Call) -> int:
+        cname = call_name(node, self.mod.aliases)
+        arg_kind = max(
+            (self.kind(a) for a in list(node.args) +
+             [kw.value for kw in node.keywords]),
+            default=STATIC,
+        )
+        if cname:
+            if any(cname == o or cname.startswith(o + ".") for o in _TRACED_ORIGINS):
+                return TRACED
+            if cname == "jax.jit":
+                return STATIC
+            if cname.startswith("jax."):
+                return TRACED
+            if cname in _STATIC_BUILTINS:
+                return STATIC
+            if cname in _PROPAGATING_BUILTINS or cname in _MATERIALIZERS:
+                return arg_kind
+        if isinstance(node.func, ast.Attribute):
+            # method on a traced value yields a traced value
+            if self.kind(node.func.value) == TRACED:
+                return TRACED
+        return arg_kind
+
+    def _traced_names(self, node: ast.AST) -> List[str]:
+        out = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and self.env.get(sub.id) == TRACED:
+                if sub.id not in out:
+                    out.append(sub.id)
+        return out
+
+    # -- bindings ---------------------------------------------------------
+
+    def _bind_target(self, target: ast.AST, kind: int) -> None:
+        if isinstance(target, ast.Name):
+            self.env.set(target.id, kind)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(elt, kind)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, kind)
+
+    # -- statement visitors ----------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        kind = self.kind(node.value)
+        for target in node.targets:
+            self._bind_target(target, kind)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None:
+            self._bind_target(node.target, self.kind(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if isinstance(node.target, ast.Name):
+            prior = self.env.get(node.target.id) or STATIC
+            self.env.set(node.target.id, max(prior, self.kind(node.value)))
+
+    def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
+        self.generic_visit(node)
+        self._bind_target(node.target, self.kind(node.value))
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node.test, "conditional expression")
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, what: str) -> None:
+        if self.kind(test) == TRACED:
+            names = ", ".join(self._traced_names(test)) or "a traced value"
+            self._flag(
+                "TRC101", test,
+                f"python {what} branches on traced value(s) ({names}); "
+                "use jnp.where/lax.cond or hoist to a static argument",
+            )
+
+    def visit_For(self, node: ast.For) -> None:
+        iter_kind = self.kind(node.iter)
+        if iter_kind == TRACED:
+            names = ", ".join(self._traced_names(node.iter)) or "a traced value"
+            self._flag(
+                "TRC104", node,
+                f"python loop over traced value(s) ({names}) unrolls with a "
+                "data-dependent trip count; use lax.scan/fori_loop",
+            )
+        self._bind_target(node.target, iter_kind)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        cname = call_name(node, self.mod.aliases)
+        if cname in _MATERIALIZERS and node.args:
+            if self.kind(node.args[0]) == TRACED:
+                self._flag(
+                    "TRC102", node,
+                    f"{cname}() materializes a traced value on host "
+                    "(forces a device sync per call inside jit)",
+                )
+        if isinstance(node.func, ast.Attribute):
+            if (
+                node.func.attr in _MATERIALIZER_METHODS
+                and self.kind(node.func.value) == TRACED
+            ):
+                self._flag(
+                    "TRC102", node,
+                    f".{node.func.attr}() materializes a traced value on "
+                    "host (forces a device sync per call inside jit)",
+                )
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        origin = self.mod.aliases.get(node.id, "")
+        if origin in _HOST_ORIGINS and isinstance(node.ctx, ast.Load):
+            self._flag(
+                "TRC103", node,
+                f"host module '{origin}' used inside a jit region: it runs "
+                "at trace time, not per execution",
+            )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested function (scan/while bodies): params are traced carries
+        check_function(self.mod, node, self.findings, parent_env=self.env)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        env = _Env(parent=self.env)
+        for arg in node.args.args + node.args.kwonlyargs:
+            env.set(arg.arg, TRACED)
+        sub = _FunctionChecker(self.mod, self.findings, env)
+        sub.visit(node.body)
+
+
+def _param_env(
+    mod: _Module, fn: ast.FunctionDef, parent_env: Optional[_Env]
+) -> _Env:
+    env = _Env(parent=parent_env)
+    for arg in fn.args.posonlyargs + fn.args.args:
+        ann = dotted_name(arg.annotation) if arg.annotation is not None else None
+        static = (
+            (ann in _STATIC_ANNOTATIONS)
+            or arg.arg in mod.static_names
+            or arg.arg == "self"
+        )
+        env.set(arg.arg, STATIC if static else TRACED)
+    for arg in fn.args.kwonlyargs:
+        env.set(arg.arg, STATIC)  # statics ride keyword-only by convention
+    if fn.args.vararg is not None:
+        env.set(fn.args.vararg.arg, TRACED)
+    if fn.args.kwarg is not None:
+        env.set(fn.args.kwarg.arg, STATIC)
+    return env
+
+
+def check_function(
+    mod: _Module,
+    fn: ast.FunctionDef,
+    findings: List[Finding],
+    parent_env: Optional[_Env] = None,
+) -> None:
+    env = _param_env(mod, fn, parent_env)
+    checker = _FunctionChecker(mod, findings, env)
+    for stmt in fn.body:
+        checker.visit(stmt)
+
+
+def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
+    """Run the tracer-safety pass; returns (findings, sources-by-path)."""
+    modules: Dict[str, _Module] = {}
+    sources: Dict[str, SourceFile] = {}
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            src, tree = parse_file(path)
+        except (OSError, SyntaxError) as exc:
+            findings.append(
+                Finding("TRC100", Severity.ERROR, path, 0, f"unparsable: {exc}")
+            )
+            continue
+        modules[path] = _Module(path, src, tree)
+        sources[path] = src
+
+    traced = _traced_functions(modules)
+    for mod in modules.values():
+        for fname, fn in mod.index.functions.items():
+            if (mod.path, fname) in traced:
+                check_function(mod, fn, findings)
+    return findings, sources

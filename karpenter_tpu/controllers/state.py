@@ -427,8 +427,12 @@ class Cluster:
             "StorageClass": self._handle_volume_object,
         }.get(event.kind)
         if handler is not None:
+            # safe under the lock: these are Cluster's OWN informer methods
+            # (the dict above binds self._handle_*), not external callbacks.
+            # They only read back into the Client — the documented
+            # cluster -> store order — and never re-enter watcher code.
             with self._lock:
-                handler(event)
+                handler(event)  # analysis: ignore[LCK202] dispatch table of our own bound methods, not external callbacks
             self.mark_unconsolidated(self._client.clock.now())
 
     def _handle_node(self, event: Event) -> None:

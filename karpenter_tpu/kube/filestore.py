@@ -125,7 +125,13 @@ class FileClient(Client):
         if buf is not None:
             buf.append(snapshot)
             return
+        # safe despite running under the base class's lock on paths like
+        # Client.create -> _notify: every FileClient CRUD enters through
+        # _atomic, which installs the TLS pending buffer BEFORE taking the
+        # lock, so under the lock this fallback is unreachable (the branch
+        # above buffers). It only fires for lock-free notify paths.
         for handler in list(self._watchers):
+            # analysis: ignore[LCK202] TLS pending buffer set before lock acquisition makes this branch lock-free
             handler(Event(snapshot.type, snapshot.kind, self._copy(snapshot.object)))
 
     def create(self, obj):

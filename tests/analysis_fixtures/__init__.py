@@ -1,0 +1,3 @@
+# Fixture corpus for tests/test_analysis.py: each bad_* file seeds exactly
+# the violations its pass must flag; each good_* file is a clean twin that
+# must NOT be flagged. These modules are parsed, never imported.

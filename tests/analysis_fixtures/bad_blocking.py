@@ -1,0 +1,12 @@
+"""Seeded blocking-call violations in a reconcile path."""
+
+import subprocess
+import time
+
+
+class SlowController:
+    def reconcile(self):
+        time.sleep(0.5)  # BLK301: wall-clock sleep in a reconcile path
+        started = time.time()  # BLK302: direct wall-clock read
+        subprocess.run(["sync"])  # BLK303: blocking process call
+        return started

@@ -1,0 +1,48 @@
+"""Seeded lock-order violations: an ABBA cycle, a callback under a lock,
+and a non-reentrant re-acquisition."""
+
+import threading
+
+
+class Store:
+    """Acquires store -> index."""
+
+    def __init__(self, index: "Index" = None):
+        self._lock = threading.RLock()
+        self._index = index
+        self._watchers = []
+
+    def put(self, key, value):
+        with self._lock:
+            self._index.add(key)  # LCK201 half: store -> index
+
+    def publish(self, event):
+        with self._lock:
+            for handler in list(self._watchers):
+                handler(event)  # LCK202: callback invoked under the lock
+
+
+class Index:
+    """Acquires index -> store: closes the cycle."""
+
+    def __init__(self, store: Store = None):
+        self._lock = threading.RLock()
+        self._store = store
+
+    def add(self, key):
+        with self._lock:
+            return key
+
+    def rebuild(self):
+        with self._lock:
+            self._store.put("k", "v")  # LCK201 half: index -> store
+
+
+class Plain:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nested(self):
+        with self._lock:
+            with self._lock:  # LCK203: non-reentrant re-acquire deadlocks
+                pass

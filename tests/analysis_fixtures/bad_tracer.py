@@ -1,0 +1,42 @@
+"""Seeded tracer-safety violations: every TRC rule fires in this module."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branches_on_traced(x, y):
+    if x > 0:  # TRC101: python branch on a traced value
+        y = y + 1
+    while jnp.sum(y) > 0:  # TRC101 again (traced while-condition)
+        y = y - 1
+    return y
+
+
+@jax.jit
+def materializes_host(x):
+    total = jnp.sum(x)
+    as_float = float(total)  # TRC102: host materialization
+    as_list = total.tolist()  # TRC102: host materialization
+    return as_float, as_list
+
+
+@jax.jit
+def host_modules(x):
+    t0 = time.time()  # TRC103: host module inside jit
+    arr = np.asarray(x)  # TRC103: numpy runs at trace time
+    return arr, t0
+
+
+def solve_core_loops(counts, acc):
+    # solve_core* naming marks this as a kernel entry even without @jit
+    limit = int(jnp.max(counts))  # TRC102: int() on a traced value
+    for _ in range(limit):  # TRC104: data-dependent trip count
+        acc = acc + 1
+    for c in counts:  # TRC104: python loop over a traced array
+        acc = acc + c
+    return acc

@@ -1,0 +1,30 @@
+"""Miniature schema module whose CRD artifacts (drift_crds/) have drifted:
+the YAML is missing 'weight', carries a stale 'bogus' property, and has a
+truncated consolidationPolicy enum."""
+
+_POLICIES = ["WhenEmpty", "WhenEmptyOrUnderutilized"]
+
+
+def nodepool_schema():
+    return {
+        "kind": "NodePoolSchema",
+        "spec": {
+            "type": "object",
+            "required": ["template"],
+            "properties": {
+                "weight": {"type": "integer"},
+                "consolidationPolicy": {"type": "string", "enum": _POLICIES},
+                "template": {"type": "object"},
+            },
+        },
+    }
+
+
+def nodeclaim_schema():
+    return {
+        "kind": "NodeClaimSchema",
+        "spec": {
+            "type": "object",
+            "properties": {"nodePoolName": {"type": "string"}},
+        },
+    }

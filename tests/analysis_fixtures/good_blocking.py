@@ -1,0 +1,11 @@
+"""Clean blocking twin: all timing through the injectable clock seam."""
+
+
+class PatientController:
+    def __init__(self, clock):
+        self.clock = clock
+
+    def reconcile(self):
+        started = self.clock.now()
+        self.clock.sleep(0.5)
+        return self.clock.since(started)

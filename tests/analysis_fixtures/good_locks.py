@@ -1,0 +1,34 @@
+"""Clean lock-order twin: single global order, callbacks outside locks."""
+
+import threading
+
+
+class Store:
+    def __init__(self, index: "Index" = None):
+        self._lock = threading.RLock()
+        self._index = index
+        self._watchers = []
+
+    def put(self, key, value):
+        with self._lock:
+            self._index.add(key)  # store -> index, the only direction
+
+    def publish(self, event):
+        with self._lock:
+            snapshot = list(self._watchers)
+        for handler in snapshot:  # callbacks run after release
+            handler(event)
+
+
+class Index:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries = {}
+
+    def add(self, key):
+        with self._lock:
+            self._entries[key] = True
+
+    def size(self):
+        with self._lock:
+            return len(self._entries)

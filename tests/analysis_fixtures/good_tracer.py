@@ -1,0 +1,32 @@
+"""Clean tracer-safety twin: static branching and lax control flow only."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def static_branching(x, y, use_bias: bool = True):
+    n = x.shape[0]  # shape components are trace-time statics
+    if use_bias:  # scalar-annotated parameter: static
+        y = y + 1.0
+    if n > 4:  # static shape branch specializes per compile, by design
+        y = y * 2.0
+    return jnp.where(x > 0, y, -y)  # data-dependent select stays on device
+
+
+@jax.jit
+def device_control_flow(x):
+    def body(i, acc):
+        return acc + x[i % x.shape[0]]
+
+    total = lax.fori_loop(0, 8, body, jnp.zeros(()))
+    # traced predicate handed TO lax.cond — the legal form of the branch
+    # that bad_tracer.py writes in python
+    return lax.cond(total > 0, lambda t: t, lambda t: -t, total)
+
+
+def solve_core_clean(counts, acc, nmax: int):
+    for _ in range(nmax):  # static trip count: unrolls identically per shape
+        acc = acc + jnp.sum(counts)
+    return acc

@@ -6,6 +6,9 @@ the packing outputs over a range of snapshot shapes, then drive the full
 TpuSolver with backend='native' and compare end-to-end Results.
 """
 
+import os
+import subprocess
+
 import numpy as np
 import pytest
 
@@ -78,7 +81,8 @@ class TestDriverBackend:
 def _topo_snapshot_args(pods):
     """Kernel args for a topology-carrying pod batch (zonal/hostname
     constraints active)."""
-    import sys, os
+    import os
+    import sys
     sys.path.insert(0, os.path.dirname(__file__))
     from helpers import snapshot_args
 
@@ -93,7 +97,8 @@ class TestTopologyParity:
     (round-2 gap: the native g_hcap path shipped untested)."""
 
     def _pods_zonal_mix(self):
-        import sys, os
+        import os
+        import sys
         sys.path.insert(0, os.path.dirname(__file__))
         from helpers import make_pods, spread_constraint, affinity_term
         from karpenter_tpu.api import labels
@@ -148,7 +153,8 @@ class TestTopologyParity:
         from karpenter_tpu.solver import TpuSolver
         from karpenter_tpu.solver.driver import SolverConfig
 
-        import sys, os
+        import os
+        import sys
         sys.path.insert(0, os.path.dirname(__file__))
         from helpers import make_nodepool
 
@@ -177,3 +183,94 @@ class TestTopologyParity:
             return out
 
         assert zone_dist(r_n) == zone_dist(r_t)
+
+
+class TestBuildLifecycle:
+    """build()/available() behavior around a missing, stale, or unbuildable
+    shared library — and the pure-Python (JAX) path staying serviceable
+    when the native toolchain is gone. No real compiler is invoked: the
+    g++ subprocess is replaced with a recorder."""
+
+    class _Recorder:
+        def __init__(self, returncode=0, stderr=""):
+            self.calls = []
+            self.returncode = returncode
+            self.stderr = stderr
+
+        def __call__(self, cmd, capture_output=True, text=True):
+            self.calls.append(cmd)
+            if self.returncode == 0:
+                # the -o argument is the library path build() expects
+                out = cmd[cmd.index("-o") + 1]
+                with open(out, "wb") as fh:
+                    fh.write(b"\x7fELF fake")
+            return subprocess.CompletedProcess(
+                cmd, self.returncode, stdout="", stderr=self.stderr
+            )
+
+    @pytest.fixture
+    def sandbox(self, tmp_path, monkeypatch):
+        """Redirect the module's source/library paths into tmp and reset
+        the cached ctypes handle."""
+        src = tmp_path / "solve_core.cc"
+        src.write_text("// stand-in source\n")
+        lib = tmp_path / "libkt_solver.so"
+        monkeypatch.setattr(native, "_SRC", str(src))
+        monkeypatch.setattr(native, "_LIB", str(lib))
+        monkeypatch.setattr(native, "_lib", None)
+        return src, lib
+
+    def test_missing_library_triggers_build(self, sandbox, monkeypatch):
+        src, lib = sandbox
+        recorder = self._Recorder()
+        monkeypatch.setattr(native.subprocess, "run", recorder)
+        assert not lib.exists()
+        path = native.build()
+        assert path == str(lib) and lib.exists()
+        assert len(recorder.calls) == 1
+        assert recorder.calls[0][0] == "g++"
+
+    def test_stale_library_rebuilt(self, sandbox, monkeypatch):
+        src, lib = sandbox
+        lib.write_bytes(b"old")
+        stale = os.path.getmtime(str(src)) - 60
+        os.utime(str(lib), (stale, stale))
+        recorder = self._Recorder()
+        monkeypatch.setattr(native.subprocess, "run", recorder)
+        native.build()
+        assert len(recorder.calls) == 1, "stale .so must be recompiled"
+
+    def test_fresh_library_not_rebuilt(self, sandbox, monkeypatch):
+        src, lib = sandbox
+        lib.write_bytes(b"fresh")
+        fresh = os.path.getmtime(str(src)) + 60
+        os.utime(str(lib), (fresh, fresh))
+        recorder = self._Recorder()
+        monkeypatch.setattr(native.subprocess, "run", recorder)
+        assert native.build() == str(lib)
+        assert recorder.calls == [], "fresh .so must be reused"
+        native.build(force=True)
+        assert len(recorder.calls) == 1, "force=True bypasses the mtime check"
+
+    def test_failed_build_raises_and_available_is_false(
+        self, sandbox, monkeypatch
+    ):
+        recorder = self._Recorder(returncode=1, stderr="fatal: no compiler")
+        monkeypatch.setattr(native.subprocess, "run", recorder)
+        with pytest.raises(native.NativeBuildError, match="no compiler"):
+            native.build()
+        assert native.available() is False
+        assert native._lib is None, "failed build must not cache a handle"
+
+    def test_pure_python_path_survives_missing_toolchain(
+        self, sandbox, monkeypatch
+    ):
+        """With the native core unbuildable, the default (JAX) backend still
+        schedules: native is an accelerator for the host path, not a
+        dependency of it."""
+        recorder = self._Recorder(returncode=1, stderr="g++: not found")
+        monkeypatch.setattr(native.subprocess, "run", recorder)
+        assert native.available() is False
+        solver, pods = example_solver(16, 4, 1)
+        results = solver.solve(pods)
+        assert results.all_pods_scheduled()

@@ -58,7 +58,15 @@ def import_aliases(tree: ast.Module) -> Dict[str, str]:
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for alias in node.names:
-                out[alias.asname or alias.name.split(".")[0]] = alias.name
+                if alias.asname is not None:
+                    out[alias.asname] = alias.name
+                else:
+                    # `import a.b` binds the name `a` to module `a`; mapping
+                    # it to 'a.b' would make use-site resolution re-append
+                    # the submodule ('a.b.b.urlopen') and silently miss
+                    # every rule keyed on the dotted origin
+                    head = alias.name.split(".")[0]
+                    out[head] = head
         elif isinstance(node, ast.ImportFrom):
             prefix = ("." * node.level) + (node.module or "")
             for alias in node.names:

@@ -70,6 +70,10 @@ class DisruptionContext:
     # the same instance-type/template catalog, so the vocab + static arrays
     # encode once per catalog change instead of once per probe
     encode_cache: object = None
+    # scenario-batched consolidation probes (methods.py): None = on unless
+    # KTPU_SCENARIO_BATCH=0; True/False force. The sequential per-probe
+    # loop remains the fallback and the semantic reference either way.
+    scenario_batch: object = None
 
     def __post_init__(self):
         if self.encode_cache is None:

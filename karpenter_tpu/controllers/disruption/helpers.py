@@ -26,7 +26,7 @@ from ...scheduling.scheduler import Results
 from ...scheduling.topology import Topology
 from ...scheduling.volumetopology import VolumeTopology
 from ...scheduling.volumeusage import VolumeResolver
-from ...solver.driver import TpuSolver
+from ...solver.driver import Scenario, TpuSolver
 from ...utils import pod as pod_utils
 from ...utils.pdb import Limits
 from ..state import Cluster, StateNode
@@ -148,6 +148,21 @@ def simulate_scheduling(
     for p in pods:
         if p.spec.volumes:
             volume_topology.inject(p)
+    solver = _build_simulation_solver(
+        client, cluster, cloud_provider, state_nodes, pods,
+        solver_config=solver_config, encode_cache=encode_cache,
+    )
+    return solver.solve(pods)
+
+
+def _build_simulation_solver(
+    client, cluster, cloud_provider, state_nodes, pods,
+    solver_config=None, encode_cache=None,
+) -> TpuSolver:
+    """The one construction recipe for a disruption-simulation solver —
+    shared by the per-subset simulate_scheduling and the scenario-batched
+    ScenarioSimulator so the two paths can never drift apart (the
+    batched == sequential equivalence depends on identical solvers)."""
     node_pools = sorted(
         client.list(NodePool), key=lambda p: (-p.spec.weight, p.name)
     )
@@ -157,7 +172,7 @@ def simulate_scheduling(
     topology = Topology(
         client, state_nodes, node_pools, instance_types, pods, cluster=cluster
     )
-    solver = TpuSolver(
+    return TpuSolver(
         node_pools,
         instance_types,
         topology,
@@ -166,7 +181,99 @@ def simulate_scheduling(
         encode_cache=encode_cache,
         volume_resolver=VolumeResolver(client),
     )
-    return solver.solve(pods)
+
+
+class ScenarioSimulator:
+    """Scenario-batched simulate_scheduling over one cluster snapshot.
+
+    The snapshot is encoded ONCE with every node present — one Topology,
+    one TpuSolver/Scheduler (per-node models shared) for the whole search —
+    and each solve() call expresses its candidate subsets as scenarios:
+    the subset's nodes masked out, their reschedulable pods (plus the
+    shared pending set) back in the workload. All of a call's subsets run
+    in a single vmapped kernel dispatch (TpuSolver.solve_scenarios), so a
+    binary search's probe set costs dispatches, not solves.
+
+    ``available`` turns False when the batched path cannot represent this
+    cluster/workload (topology constraints whose priors depend on which
+    nodes remain, pods with volumes, non-tensorizable pods, reservations,
+    minValues, non-TPU backends) — callers fall back to the sequential
+    per-subset simulate_scheduling, the semantic reference."""
+
+    def __init__(
+        self,
+        client,
+        cluster: Cluster,
+        cloud_provider,
+        universe: Sequence[Candidate],
+        solver_config=None,
+        encode_cache=None,
+        state_snapshot=None,
+    ):
+        self.available = True
+        self.dispatches = 0
+        if solver_config is not None and (
+            solver_config.force_oracle or solver_config.backend != "tpu"
+        ):
+            # unconditionally unrepresentable: don't pay the Topology +
+            # solver construction just for solve_scenarios to decline
+            # (mesh configs are left to solve_scenarios — "auto" on a
+            # single device still rides the batch)
+            self.available = False
+            return
+        state_nodes = [
+            sn
+            for sn in (
+                state_snapshot
+                if state_snapshot is not None
+                else cluster.nodes()
+            )
+            if not (sn.mark_for_deletion or sn.deleting())
+        ]
+        self._pending = [
+            p for p in client.list(Pod) if pod_utils.is_provisionable(p)
+        ]
+        union_pods: List[Pod] = []
+        seen_ids: set = set()
+        for c in universe:
+            if c.provider_id not in seen_ids:
+                seen_ids.add(c.provider_id)
+                union_pods.extend(c.reschedulable_pods)
+        if any(p.spec.volumes for p in union_pods + self._pending):
+            # zonal-volume injection deep-copies pods per simulation; the
+            # shared encoding cannot carry per-scenario copies
+            self.available = False
+            return
+        self._solver = _build_simulation_solver(
+            client, cluster, cloud_provider, state_nodes,
+            union_pods + self._pending,
+            solver_config=solver_config, encode_cache=encode_cache,
+        )
+
+    def solve(
+        self, subsets: Sequence[Sequence[Candidate]]
+    ) -> Optional[List[Results]]:
+        """Per-subset Results from one batched dispatch, aligned with
+        ``subsets`` — or None (and available=False) when the batch cannot
+        be represented; nothing has been solved in that case."""
+        if not self.available:
+            return None
+        scenarios = [
+            Scenario(
+                pods=[p for c in subset for p in c.reschedulable_pods]
+                + self._pending,
+                excluded_provider_ids=frozenset(
+                    c.provider_id for c in subset
+                ),
+            )
+            for subset in subsets
+        ]
+        results = self._solver.solve_scenarios(scenarios)
+        if results is None:
+            self.available = False
+            return None
+        self.dispatches += self._solver.last_scenario_dispatches
+        return results
 
 
 # -- budgets (nodepool.go:296-367, helpers.go:201-249) ---------------------

@@ -11,7 +11,9 @@ binary-search probe is one batched Solve.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import os
+import time as _time
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ...api import labels as labels_mod
 from ...api.objects import (
@@ -22,13 +24,55 @@ from ...api.objects import (
 )
 from ...api.requirements import Operator, Requirement
 from ...cloudprovider import types as cp
-from .helpers import simulate_scheduling
+from .helpers import ScenarioSimulator, simulate_scheduling
 from .types import Candidate, Command
 
 MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:36
 SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0  # singlenodeconsolidation.go:34
 MAX_MULTI_NODE_CANDIDATES = 100  # multinodeconsolidation.go:80-82
 MIN_SPOT_TO_SPOT_TYPES = 15  # consolidation.go:48-49
+
+# scenario-batched probe evaluation (ops/solve.py scenario axis):
+# - the multi-node binary search primes this many midpoint-tree probes in
+#   its first dispatch (levels 0-3 of the search tree over <= 100
+#   candidates); the refinement dispatch covers the surviving interval
+# - the single-node sweep evaluates candidates in chunks of this size
+_SCENARIO_PRIME_BUDGET = 15
+_SINGLE_NODE_BATCH = 16
+
+
+def _scenario_batching_enabled(ctx) -> bool:
+    """Scenario batching is on by default; a DisruptionContext attribute
+    (tests, operator config) or KTPU_SCENARIO_BATCH=0/1 overrides."""
+    flag = getattr(ctx, "scenario_batch", None)
+    if flag is not None:
+        return bool(flag)
+    env = os.environ.get("KTPU_SCENARIO_BATCH")
+    if env is not None:
+        return env != "0"
+    return True
+
+
+def _bsearch_tree_mids(n: int, budget: int) -> List[int]:
+    """The first midpoints a binary search over [1, n] can ever visit:
+    breadth-first levels of its fixed midpoint tree, whole levels only,
+    up to ``budget`` nodes. Every actual search path walks root-to-leaf
+    through this tree, so priming these answers the search's first
+    ceil(log2(level_count)) probes whatever the outcomes are."""
+    out: List[int] = []
+    level = [(1, n)]
+    while level:
+        mids = [(lo + hi) // 2 for lo, hi in level if lo <= hi]
+        if not mids or len(out) + len(mids) > budget:
+            break
+        out.extend(mids)
+        level = [
+            iv
+            for lo, hi in level
+            if lo <= hi
+            for iv in ((lo, (lo + hi) // 2 - 1), ((lo + hi) // 2 + 1, hi))
+        ]
+    return out
 
 
 class Method:
@@ -161,6 +205,14 @@ class ConsolidationBase(Method):
             state_snapshot=state_snapshot,
             solver_config=self.ctx.solver_config,
         )
+        return self._decision_from_results(candidates, results)
+
+    def _decision_from_results(
+        self, candidates: List[Candidate], results
+    ) -> Command:
+        """The pricing/spot decision rules over one simulation's Results —
+        shared by the per-probe simulate above and the scenario-batched
+        search, whose Results arrive en masse from one kernel dispatch."""
         if results.pod_errors:
             return Command()
         if not results.new_node_claims:
@@ -264,16 +316,27 @@ def _remove_types_priced_at_or_above(replacement, max_price: float) -> bool:
 
 class MultiNodeConsolidation(ConsolidationBase):
     """Binary search for the largest disruptable candidate prefix whose pods
-    fit into <= 1 replacement (multinodeconsolidation.go:112-167)."""
+    fit into <= 1 replacement (multinodeconsolidation.go:112-167).
+
+    The search itself is a replay over precomputed probe answers: the
+    scenario-batched solver evaluates the first levels of the search's
+    midpoint tree in ONE kernel dispatch, the replay walks the standard
+    lo/hi updates against those answers, and a second dispatch covers
+    whatever interval survives — every probe point of the search in <= 2
+    dispatches, with decisions identical to the sequential probe loop
+    (tests/test_scenario_batch.py pins the equivalence). When the batch
+    cannot be represented (see TpuSolver.solve_scenarios), the same replay
+    runs over a per-probe sequential evaluator."""
 
     consolidation_type = "multi"
 
     def compute_command(self, candidates, budgets) -> Command:
-        # per-probe wall times for the bench's probe-count x per-probe
-        # breakdown (multinodeconsolidation.go:112-167 is the shape);
+        # probe/dispatch telemetry for the bench's consolidation entry;
         # reset BEFORE any early return so a no-probe decision never
         # reports the previous decision's timings
         self.last_probe_ms: List[float] = []
+        self.last_probes = 0
+        self.last_dispatches = 0
         candidates = _budget_filter(
             sorted(candidates, key=lambda c: c.disruption_cost), budgets
         )
@@ -281,38 +344,120 @@ class MultiNodeConsolidation(ConsolidationBase):
         if len(candidates) < 2:
             return Command()
         deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
-        lo, hi = 1, len(candidates)
-        last_valid = Command()
         # one cluster snapshot serves every probe of the binary search
         snapshot = self.ctx.cluster.nodes()
-        import time as _time
+        evaluator = None
+        if _scenario_batching_enabled(self.ctx):
+            evaluator = self._batched_evaluator(candidates, snapshot)
+        if evaluator is None:
+            evaluator = self._sequential_evaluator(candidates, snapshot)
 
+        lo, hi = 1, len(candidates)
+        last_valid = Command()
         while lo <= hi:
             if self.ctx.clock.now() >= deadline:
                 break
             mid = (lo + hi) // 2
-            subset = candidates[:mid]
-            # wall-clock on purpose: probe latency diagnostics measure the
-            # real solver, not simulated time (the reconcile DEADLINE above
-            # does go through the injected clock)
-            _t0 = _time.perf_counter()  # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
-            cmd = self.compute_consolidation(subset, state_snapshot=snapshot)
-            self.last_probe_ms.append(
-                # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
-                round((_time.perf_counter() - _t0) * 1000, 1)
-            )
-            # don't replace nodes with the same type we're deleting
-            # (filterOutSameType, multinodeconsolidation.go:185-222)
-            if cmd.decision == "replace":
-                self._filter_out_same_type(cmd, subset)
-                if not cmd.replacements[0].instance_type_options:
-                    cmd = Command()
+            cmd = evaluator(mid, lo, hi)
+            if cmd is None:
+                # batched path became unrepresentable mid-search (cluster
+                # state is fixed for the snapshot, so this is defensive):
+                # finish sequentially
+                evaluator = self._sequential_evaluator(candidates, snapshot)
+                cmd = evaluator(mid, lo, hi)
             if cmd.decision != "no-op":
                 last_valid = cmd
                 lo = mid + 1
             else:
                 hi = mid - 1
         return last_valid
+
+    def _probe_command(self, subset, results) -> Command:
+        """One probe's decision from its simulation Results, including the
+        don't-replace-with-what-we-delete rule (filterOutSameType,
+        multinodeconsolidation.go:185-222)."""
+        cmd = self._decision_from_results(subset, results)
+        if cmd.decision == "replace":
+            self._filter_out_same_type(cmd, subset)
+            if not cmd.replacements[0].instance_type_options:
+                cmd = Command()
+        return cmd
+
+    def _sequential_evaluator(
+        self, candidates, snapshot
+    ) -> Callable[[int, int, int], Command]:
+        def evaluate(mid: int, lo: int, hi: int) -> Command:
+            subset = candidates[:mid]
+            # wall-clock on purpose: probe latency diagnostics measure the
+            # real solver, not simulated time (the reconcile DEADLINE in
+            # compute_command does go through the injected clock)
+            _t0 = _time.perf_counter()  # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+            results = simulate_scheduling(
+                self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider,
+                subset,
+                encode_cache=self.ctx.encode_cache,
+                state_snapshot=snapshot,
+                solver_config=self.ctx.solver_config,
+            )
+            self.last_probe_ms.append(
+                # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+                round((_time.perf_counter() - _t0) * 1000, 1)
+            )
+            self.last_probes += 1
+            self.last_dispatches += 1
+            return self._probe_command(subset, results)
+
+        return evaluate
+
+    def _batched_evaluator(
+        self, candidates, snapshot
+    ) -> Optional[Callable[[int, int, int], Optional[Command]]]:
+        """Probe evaluator over the scenario-batched solver: primes the
+        midpoint-tree probes eagerly (dispatch 1), answers the refinement
+        interval lazily when the replay first steps outside the primed set
+        (dispatch 2). Returns None when the cluster/workload cannot ride
+        the batch at all."""
+        probe_cache: Dict[int, Command] = {}
+        n = len(candidates)
+        sim = ScenarioSimulator(
+            self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider,
+            candidates,
+            encode_cache=self.ctx.encode_cache,
+            state_snapshot=snapshot,
+            solver_config=self.ctx.solver_config,
+        )
+
+        def evaluate_mids(mids: List[int]) -> bool:
+            # wall-clock on purpose, as in the sequential evaluator
+            _t0 = _time.perf_counter()  # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+            before = sim.dispatches
+            results = sim.solve([candidates[:m] for m in mids])
+            if results is None:
+                return False
+            self.last_probe_ms.append(
+                # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+                round((_time.perf_counter() - _t0) * 1000, 1)
+            )
+            self.last_probes += len(mids)
+            self.last_dispatches += sim.dispatches - before
+            for m, res in zip(mids, results):
+                probe_cache[m] = self._probe_command(candidates[:m], res)
+            return True
+
+        if not evaluate_mids(_bsearch_tree_mids(n, _SCENARIO_PRIME_BUDGET)):
+            return None
+
+        def evaluate(mid: int, lo: int, hi: int) -> Optional[Command]:
+            if mid not in probe_cache:
+                # every remaining probe of the search lies inside [lo, hi]
+                remaining = [
+                    m for m in range(lo, hi + 1) if m not in probe_cache
+                ]
+                if not evaluate_mids(remaining):
+                    return None
+            return probe_cache[mid]
+
+        return evaluate
 
     def _filter_out_same_type(self, cmd: Command, candidates) -> None:
         replacement = cmd.replacements[0]
@@ -358,6 +503,9 @@ class SingleNodeConsolidation(ConsolidationBase):
 
     def compute_command(self, candidates, budgets) -> Command:
         self.suppress_memoization = False
+        self.last_probe_ms: List[float] = []
+        self.last_probes = 0
+        self.last_dispatches = 0
         ordered = self.sort_candidates(candidates)
         budgeted = _budget_filter(ordered, budgets)
         constrained_by_budgets = len(budgeted) < len(ordered)
@@ -368,12 +516,13 @@ class SingleNodeConsolidation(ConsolidationBase):
         # one cluster snapshot serves the whole per-candidate sweep; taken
         # lazily so budget-exhausted reconciles don't pay the deep copy
         snapshot = self.ctx.cluster.nodes() if budgeted else []
-        for c in budgeted:
+        evaluator = self._sweep_evaluator(budgeted, snapshot)
+        for i, c in enumerate(budgeted):
             if self.ctx.clock.now() >= deadline:
                 timed_out = True
                 break
             seen_pools.add(c.node_pool.name)
-            cmd = self.compute_consolidation([c], state_snapshot=snapshot)
+            cmd = evaluator(i)
             if cmd.decision != "no-op":
                 # early success: unseen-pool bookkeeping keeps its prior
                 # value, like the reference's early return
@@ -385,3 +534,51 @@ class SingleNodeConsolidation(ConsolidationBase):
             # consolidated": work was skipped, not absent
             self.suppress_memoization = True
         return Command()
+
+    def _sweep_evaluator(self, budgeted, snapshot) -> Callable[[int], Command]:
+        """Per-candidate decision evaluator. Scenario batching evaluates
+        _SINGLE_NODE_BATCH candidates per kernel dispatch (chunked so an
+        early success doesn't pay for the whole sweep); decisions are
+        identical to the sequential per-candidate simulate, and the sweep
+        loop's order/timeout semantics are unchanged either way."""
+        cache: Dict[int, Command] = {}
+        sim: Optional[ScenarioSimulator] = None
+        if _scenario_batching_enabled(self.ctx) and budgeted:
+            sim = ScenarioSimulator(
+                self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider,
+                budgeted,
+                encode_cache=self.ctx.encode_cache,
+                state_snapshot=snapshot,
+                solver_config=self.ctx.solver_config,
+            )
+
+        def evaluate(i: int) -> Command:
+            if sim is not None and sim.available and i not in cache:
+                chunk = budgeted[i : i + _SINGLE_NODE_BATCH]
+                _t0 = _time.perf_counter()  # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+                before = sim.dispatches
+                results = sim.solve([[c] for c in chunk])
+                if results is not None:
+                    self.last_probe_ms.append(
+                        # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+                        round((_time.perf_counter() - _t0) * 1000, 1)
+                    )
+                    self.last_probes += len(chunk)
+                    self.last_dispatches += sim.dispatches - before
+                    for j, (c, res) in enumerate(zip(chunk, results)):
+                        cache[i + j] = self._decision_from_results([c], res)
+            if i in cache:
+                return cache[i]
+            _t0 = _time.perf_counter()  # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+            cmd = self.compute_consolidation(
+                [budgeted[i]], state_snapshot=snapshot
+            )
+            self.last_probe_ms.append(
+                # analysis: ignore[BLK302] probe latency diagnostic, not reconcile timing
+                round((_time.perf_counter() - _t0) * 1000, 1)
+            )
+            self.last_probes += 1
+            self.last_dispatches += 1
+            return cmd
+
+        return evaluate

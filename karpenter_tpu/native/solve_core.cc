@@ -345,6 +345,14 @@ int kt_solve(
   std::vector<int32_t> qd(NSLOT), qrem(NSLOT);
   std::vector<int32_t> wf_npods(NMAX), wf_cap(NMAX), wf_fill(NMAX);
   std::vector<uint8_t> other_row(V1);
+  // batch-level domain presence (the JAX kernels' has_domains static):
+  // gates the tier-3 balanced-bulk-birth rule below
+  bool has_domains = false;
+  for (int g = 0; g < G; ++g)
+    if (g_dmode[g] > 0) {
+      has_domains = true;
+      break;
+    }
 
   for (int gi = 0; gi < G; ++gi) {
     int32_t count = g_count[gi];
@@ -623,6 +631,7 @@ int kt_solve(
     // ---- 2. open claims, least-loaded first ----
     std::vector<uint8_t> got(NMAX, 0);
     std::vector<int32_t> percap_d(dyn ? static_cast<size_t>(NMAX) * V1 : 0, 0);
+    std::vector<uint8_t> adm_any(dyn ? NMAX : 0, 0);
     for (int s = 0; s < NMAX; ++s) {
       claim_cap[s] = 0;
       claim_fill[s] = 0;
@@ -686,27 +695,82 @@ int kt_solve(
         }
       }
       if (dyn) {
-        // assign the claim to the admissible domain with the largest
-        // remaining quota (argmax, ties by lowest slot index)
-        int32_t best_q = -1, d_star = DEAD;
+        // domain assignment is deferred to the quota-proportional pass
+        // below (it needs the eligible-claim count first)
         for (int d = 0; d < V1; ++d) {
-          if (percap_d[static_cast<size_t>(s) * V1 + d] < 1) continue;
-          if (qrem[d] < 1) continue;
-          if (qrem[d] > best_q) {
-            best_q = qrem[d];
-            d_star = d;
+          if (percap_d[static_cast<size_t>(s) * V1 + d] >= 1 &&
+              qrem[d] >= 1) {
+            adm_any[s] = 1;
+            break;
+          }
+        }
+        continue;
+      }
+      claim_cap[s] = best;
+      claim_cap[s] = std::min(claim_cap[s], hc);  // open claims carry no prior
+      if (has_h)
+        claim_cap[s] = std::min(
+            claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
+    }
+    if (dyn) {
+      // quota-proportional claim spread (mirrors ops/packing.py tier-2):
+      // eligible claims are ranked in slot order and cut by cumulative
+      // quota; a claim whose proportional domain is inadmissible falls
+      // back to the largest-remaining-quota pick (ties by lowest d).
+      int32_t total_q = 0;
+      int n_elig = 0;
+      for (int d = 0; d < V1; ++d) total_q += std::max(qrem[d], 0);
+      for (int s = 0; s < NMAX; ++s) n_elig += adm_any[s] ? 1 : 0;
+      std::vector<float> cumf(V1, 0.0f);
+      {
+        int32_t acc = 0;
+        const float denom = static_cast<float>(std::max(total_q, 1));
+        for (int d = 0; d < V1; ++d) {
+          acc += std::max(qrem[d], 0);
+          cumf[d] = static_cast<float>(acc) / denom;
+        }
+      }
+      int rank = 0;
+      for (int s = 0; s < NMAX; ++s) {
+        if (!adm_any[s]) continue;
+        const float x = (static_cast<float>(rank) + 0.5f) /
+                        static_cast<float>(std::max(n_elig, 1));
+        ++rank;
+        int d_prop = V1 - 1;
+        for (int d = 0; d < V1; ++d)
+          if (cumf[d] >= x) {
+            d_prop = d;
+            break;
+          }
+        int d_star;
+        // proportional spread applies to self-selecting spread only
+        // (mode == DMODE_SPREAD); gate/affinity modes keep the greedy
+        // pick — identical to ops/packing.py's `prop_ok & (mode ==
+        // DMODE_SPREAD)` gate
+        if (mode == 1 &&
+            percap_d[static_cast<size_t>(s) * V1 + d_prop] >= 1 &&
+            qrem[d_prop] >= 1) {
+          d_star = d_prop;
+        } else {
+          int32_t best_q = -1;
+          d_star = DEAD;
+          for (int d = 0; d < V1; ++d) {
+            if (percap_d[static_cast<size_t>(s) * V1 + d] < 1) continue;
+            if (qrem[d] < 1) continue;
+            if (qrem[d] > best_q) {
+              best_q = qrem[d];
+              d_star = d;
+            }
           }
         }
         c_slot[s] = d_star;
         claim_cap[s] =
             (d_star < V1) ? percap_d[static_cast<size_t>(s) * V1 + d_star] : 0;
-      } else {
-        claim_cap[s] = best;
+        claim_cap[s] = std::min(claim_cap[s], hc);
+        if (has_h)
+          claim_cap[s] = std::min(
+              claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
       }
-      claim_cap[s] = std::min(claim_cap[s], hc);  // open claims carry no prior
-      if (has_h)
-        claim_cap[s] = std::min(
-            claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
     }
     // hostname-affinity: restrict tier 2 to the least-loaded eligible open
     // claim (the oracle's in-flight order) — one entity only
@@ -954,9 +1018,21 @@ int kt_solve(
         continue;
       }
       int32_t placed = 0;
+      // bulk births mirror ops/packing.py tier-3: domain-pinned bulks —
+      // and ALL bulks of a domain-constrained batch — split rem_d evenly
+      // (base + 1-pod remainders); ANY bulks of domain-free batches keep
+      // the concentrating full-then-partial fill
+      const bool even_bulk = has_domains || !is_any;
+      const int32_t served =
+          static_cast<int32_t>(std::min<int64_t>(rem_d, k * n_per));
+      const int32_t base_take = static_cast<int32_t>(served / k);
+      const int32_t extra_take = static_cast<int32_t>(served - base_take * k);
       for (int64_t i = 0; i < k; ++i) {
         int32_t n_take =
-            std::min<int32_t>(rem_d - static_cast<int32_t>(i) * n_per, n_per);
+            even_bulk
+                ? base_take + (i < extra_take ? 1 : 0)
+                : std::min<int32_t>(rem_d - static_cast<int32_t>(i) * n_per,
+                                    n_per);
         int slot = n_open++;
         c_active[slot] = 1;
         c_pool[slot] = p_star;

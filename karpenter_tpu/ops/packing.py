@@ -150,6 +150,66 @@ def waterfill(npods, cap, n, iters: int = 32):
     return fills
 
 
+def spread_domain_choice(adm, qrem_v, mode, V1, DEAD):
+    """Tier-2 domain assignment for dynamic groups, shared by pack and
+    pack_classed (and mirrored in native/solve_core.cc — the three must
+    stay bit-exact).
+
+    Greedy default: each admissible claim goes to the admissible domain
+    with the largest remaining quota (ties by lowest index). For
+    self-selecting spread (DMODE_SPREAD) the assignment is
+    quota-PROPORTIONAL instead: the oracle's per-pod min-count selection
+    pins open claims round-robin across domains, so claims-per-domain
+    tracks the quota split — a bare argmax pins EVERY eligible claim to
+    one domain and starves the rest, whose pods then pile onto few claims
+    that outgrow the cheap types' fit (PARITY.md "Known cost-gap").
+    Eligible claims rank in slot order and cut the rank axis by
+    cumulative quota; inadmissible proportional picks (and gate/affinity
+    modes, where proportional spread measurably hurt the diverse mix)
+    fall back to the greedy rule.
+
+    Returns (c_slot [NMAX], any_adm [NMAX])."""
+    any_adm = jnp.any(adm, axis=1)
+    d_greedy = jnp.argmax(jnp.where(adm, qrem_v[None, :], -1), axis=1)
+    qv = jnp.maximum(qrem_v, 0)
+    total_q = jnp.sum(qv)
+    rank = jnp.cumsum(any_adm.astype(jnp.int32)) - 1
+    x = (rank.astype(jnp.float32) + 0.5) / jnp.maximum(jnp.sum(any_adm), 1)
+    cum = jnp.cumsum(qv).astype(jnp.float32) / jnp.maximum(total_q, 1)
+    d_prop = jnp.clip(jnp.searchsorted(cum, x), 0, V1 - 1)
+    prop_ok = jnp.take_along_axis(adm, d_prop[:, None], axis=1)[:, 0]
+    d_star = jnp.where(
+        prop_ok & (mode == DMODE_SPREAD), d_prop, d_greedy
+    )
+    return jnp.where(any_adm, d_star, DEAD), any_adm
+
+
+def bulk_takes(rem_d, k, n_per, slots, slot, is_any, has_domains: bool):
+    """Tier-3 per-slot takes for a fresh-claim bulk, shared by pack and
+    pack_classed (mirrored in native/solve_core.cc).
+
+    Domain-pinned bulks — and ALL bulks of a domain-constrained batch —
+    split rem_d EVENLY (base + 1-pod remainders): balanced births keep
+    every claim of the bulk within the cheapest fitting type's capacity
+    instead of concentrating the overflow on the last claim (claim count
+    is identical: k was sized by n_per). ANY bulks of domain-free batches
+    keep the full-n_per-then-partial fill: their value is CONCENTRATION —
+    full claims don't accept later accelerator groups, which is what
+    keeps CPU-only claims cheap on mixed batches (PARITY.md "per-pod type
+    poisoning")."""
+    in_bulk = (slots >= slot) & (slots < slot + k)
+    served = jnp.minimum(rem_d, k * n_per)
+    base = jnp.where(k > 0, served // jnp.maximum(k, 1), 0)
+    extra = served - base * jnp.maximum(k, 1)
+    takes_even = base + ((slots - slot) < extra).astype(jnp.int32)
+    if has_domains:
+        takes = takes_even
+    else:
+        takes_full = jnp.clip(rem_d - (slots - slot) * n_per, 0, n_per)
+        takes = jnp.where(is_any, takes_full, takes_even)
+    return jnp.where(in_bulk, takes, 0), in_bulk
+
+
 class PackState(NamedTuple):
     exist_used: jnp.ndarray  # [N, R]
     c_used: jnp.ndarray  # [NMAX, R]
@@ -727,13 +787,12 @@ def pack(
                     & (percap >= 1)
                     & (qrem[:V1] > 0)[None, :]
                 )
-                d_star = jnp.argmax(
-                    jnp.where(adm, qrem[:V1][None, :], -1), axis=1
-                )
-                c_slot = jnp.where(jnp.any(adm, axis=1), d_star, DEAD)  # [NMAX]
-                cap_dom = jnp.take_along_axis(percap, d_star[:, None], axis=1)[
-                    :, 0
-                ]
+                c_slot, _ = spread_domain_choice(
+                    adm, qrem[:V1], mode, V1, DEAD
+                )  # [NMAX]
+                cap_dom = jnp.take_along_axis(
+                    percap, jnp.clip(c_slot, 0, V1 - 1)[:, None], axis=1
+                )[:, 0]
                 claim_cap = _clamp(jnp.where(c_slot < V1, cap_dom, 0))
 
                 def wf_slot(slot_idx, slot_budget):
@@ -928,11 +987,10 @@ def pack(
             ok = any_feasible & (k > 0) & (n_per > 0)
             k = jnp.where(ok, k, 0)
 
-            # per-slot takes: full n_per runs, last claim partial
             slots = jnp.arange(nmax, dtype=jnp.int32)
-            in_bulk = (slots >= slot) & (slots < slot + k)
-            takes = jnp.clip(rem_d - (slots - slot) * n_per, 0, n_per)
-            takes = jnp.where(in_bulk, takes, 0)  # [NMAX]
+            takes, in_bulk = bulk_takes(
+                rem_d, k, n_per, slots, slot, is_any, has_domains
+            )  # [NMAX]
             placed = jnp.sum(takes)
 
             tmask_new = avail[p_star] & (n_fit_row[p_star] >= takes[:, None])
@@ -1621,12 +1679,11 @@ def pack_classed(
                         & (percap >= 1)
                         & (qrem[:V1] > 0)[None, :]
                     )
-                    d_star = jnp.argmax(
-                        jnp.where(adm, qrem[:V1][None, :], -1), axis=1
+                    c_slot, _ = spread_domain_choice(
+                        adm, qrem[:V1], mode, V1, DEAD
                     )
-                    c_slot = jnp.where(jnp.any(adm, axis=1), d_star, DEAD)
                     cap_dom = jnp.take_along_axis(
-                        percap, d_star[:, None], axis=1
+                        percap, jnp.clip(c_slot, 0, V1 - 1)[:, None], axis=1
                     )[:, 0]
                     claim_cap = _clamp(jnp.where(c_slot < V1, cap_dom, 0))
 
@@ -1759,9 +1816,9 @@ def pack_classed(
                 ok = any_feasible & (k > 0) & (n_per > 0)
                 k = jnp.where(ok, k, 0)
 
-                in_bulk = (slots >= slot) & (slots < slot + k)
-                takes = jnp.clip(rem_d - (slots - slot) * n_per, 0, n_per)
-                takes = jnp.where(in_bulk, takes, 0)
+                takes, in_bulk = bulk_takes(
+                    rem_d, k, n_per, slots, slot, is_any, has_domains
+                )
                 placed = jnp.sum(takes)
 
                 tmask_new = avail[p_star] & (

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from .feasibility import existing_node_feasibility, fresh_claim_feasibility
 from .packing import pack, pack_classed
+from ..solver.encode import SOLVE_ARG_NAMES
 
 
 def _feasibility_tables(
@@ -244,6 +245,53 @@ solve_all_classed_packed = jax.jit(
     solve_core_classed_packed,
     static_argnames=(
         "nmax", "lmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
+        "tile_feasibility", "wf_iters", "fills_dtype",
+    ),
+)
+
+
+# -- scenario axis ----------------------------------------------------------
+#
+# Consolidation's replacement search solves the SAME cluster snapshot many
+# times, varying only which candidate nodes are gone and which of their pods
+# are back in the workload. A scenario is expressed entirely through two
+# inputs of the shared encoding:
+#
+#   g_count [S, G]    per-scenario group counts (a candidate's reschedulable
+#                     pods count only in scenarios that remove it)
+#   n_tol   [S, N, G] per-scenario node tolerance, with removed nodes' rows
+#                     zeroed — a node no group tolerates receives no fills
+#                     (existing_node_feasibility gates cap on n_tol), which
+#                     is exactly "the node is not there"
+#
+# Everything else — feasibility tables, offering availability, templates,
+# types — is encoded once and shared across the scenario axis, so the whole
+# probe set of a binary search runs as ONE vmapped jit dispatch instead of a
+# host loop of solves.
+
+SCENARIO_BATCHED_ARGS = ("g_count", "n_tol")
+_SCENARIO_IN_AXES = tuple(
+    0 if name in SCENARIO_BATCHED_ARGS else None for name in SOLVE_ARG_NAMES
+)
+
+
+def solve_scenarios_core_packed(*args, fills_dtype=jnp.int32, **statics):
+    """solve_core_packed vmapped over a leading scenario axis on
+    (g_count, n_tol); every other arg is shared. Outputs gain a leading
+    [S] axis and stay wire-packed per scenario."""
+
+    def one(*scenario_args):
+        return solve_core_packed(
+            *scenario_args, fills_dtype=fills_dtype, **statics
+        )
+
+    return jax.vmap(one, in_axes=_SCENARIO_IN_AXES)(*args)
+
+
+solve_all_scenarios_packed = jax.jit(
+    solve_scenarios_core_packed,
+    static_argnames=(
+        "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
         "tile_feasibility", "wf_iters", "fills_dtype",
     ),
 )

@@ -272,11 +272,12 @@ class ExistingNode:
 
     @staticmethod
     def build_requirements(state_node) -> Requirements:
-        """The node's label requirements + hostname pin. Read-only after
-        construction (Add() REPLACES self.requirements with a merged copy,
-        never mutates it), so schedulers may cache and share one instance
-        per node across solves — consolidation's binary search rebuilds
-        these for the same snapshot nodes every probe."""
+        """The node's label requirements + hostname pin. Add() REPLACES
+        self.requirements with a merged copy, but the TPU decode's
+        existing-node fill commit mutates the container in place — so
+        schedulers caching across solves must cache the (immutable)
+        Requirement ENTRIES and hand each solve a fresh container
+        (Scheduler._calculate_existing_nodes does exactly that)."""
         reqs = Requirements.from_labels(state_node.labels())
         reqs.add(
             Requirement(labels_mod.HOSTNAME, Operator.IN, [state_node.hostname()])

@@ -21,6 +21,8 @@ from ..api import resources as res
 from ..api import taints as taints_mod
 from ..api.objects import Node, NodePool, Pod
 from ..api.requirements import (
+    Operator,
+    Requirement,
     Requirements,
     has_preferred_node_affinity,
     pod_requirements,
@@ -266,13 +268,26 @@ class Scheduler:
             if cache is not None
             else ()
         )
+        # content-shared label requirements: fleets are homogeneous, so the
+        # non-hostname label shape repeats across thousands of nodes. The
+        # shared base is built once per distinct shape; each node's
+        # requirements are a fresh container over the SHARED Requirement
+        # entries plus its own hostname pin (safe: Requirements.add never
+        # mutates stored entries, it replaces them with intersections).
+        shared_base: dict = {}
         for sn in state_nodes:
             hit = None
             if cache is not None:
                 key = self._node_identity(sn) + (daemon_fp,)
                 hit = cache.get(key)
             if hit is not None:
-                taints, daemon_requests, base_reqs = hit
+                taints, daemon_requests, base_entries = hit
+                # a FRESH container per solve over the shared (immutable)
+                # Requirement entries: the container itself is mutated by
+                # decode's existing-node fill commit, so handing out a
+                # cached container would leak one solve's fills into the
+                # next solve's node model
+                base_reqs = Requirements(*base_entries)
             else:
                 taints = sn.taints()
                 daemons = []
@@ -288,8 +303,37 @@ class Scheduler:
                 daemon_requests = res.merge(*(p.spec.requests for p in daemons)) if daemons else {}
                 base_reqs = None
                 if cache is not None:
-                    base_reqs = ExistingNode.build_requirements(sn)
-                    cache[key] = (taints, daemon_requests, base_reqs)
+                    labels = sn.labels()
+                    ckey = tuple(
+                        sorted(
+                            (k, v)
+                            for k, v in labels.items()
+                            if k != labels_mod.HOSTNAME
+                        )
+                    )
+                    shared = shared_base.get(ckey)
+                    if shared is None:
+                        shared = shared_base[ckey] = Requirements.from_labels(
+                            {
+                                k: v
+                                for k, v in labels.items()
+                                if k != labels_mod.HOSTNAME
+                            }
+                        ).values()
+                    # the hostname pin subsumes the hostname label (its
+                    # value IS the label's, statenode hostname fallback
+                    # included), so base+pin == build_requirements(sn)
+                    base_reqs = Requirements(*shared)
+                    base_reqs.add(
+                        Requirement(
+                            labels_mod.HOSTNAME, Operator.IN, [sn.hostname()]
+                        )
+                    )
+                    # cache the ENTRIES, not the container (see the hit
+                    # path above)
+                    cache[key] = (
+                        taints, daemon_requests, tuple(base_reqs.values())
+                    )
             self.existing_nodes.append(
                 ExistingNode(
                     sn, self.topology, taints, daemon_requests,

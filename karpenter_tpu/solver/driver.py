@@ -142,6 +142,30 @@ class SolverConfig:
     classed: Optional[bool] = None
 
 
+def _clone_existing_node(en):
+    """A fill-isolated copy of an ExistingNode model: decode mutates pods/
+    requests/requirements, and scenario fan-out must not leak one scenario's
+    placements into another's (or into the shared oracle models)."""
+    import copy
+
+    c = copy.copy(en)
+    c.pods = list(en.pods)
+    c.requests = dict(en.requests)
+    c.requirements = Requirements(*en.requirements.values())
+    return c
+
+
+@dataclass
+class Scenario:
+    """One cluster what-if for TpuSolver.solve_scenarios: ``pods`` is the
+    scenario's workload (a subset of the union the solver encodes) and
+    ``excluded_provider_ids`` names the existing nodes absent from the
+    cluster in this scenario (consolidation candidates being removed)."""
+
+    pods: List[Pod]
+    excluded_provider_ids: frozenset = frozenset()
+
+
 @dataclass
 class DecodedClaim:
     """A claim produced by the TPU path; duck-types InFlightNodeClaim for
@@ -192,6 +216,9 @@ class TpuSolver:
         self.pool_limits = {
             np_.name: dict(np_.spec.limits) for np_ in node_pools if np_.spec.limits
         }
+        # kernel dispatch count of the last solve_scenarios call (bench
+        # telemetry: the whole probe set should cost <= 2 dispatches)
+        self.last_scenario_dispatches = 0
 
     # -- solve ------------------------------------------------------------
 
@@ -285,6 +312,192 @@ class TpuSolver:
                 return True
         return False
 
+    # -- scenario axis ----------------------------------------------------
+
+    # scenario-count buckets: pad S to a power of two so repeat searches
+    # (and both dispatches of one search) reuse compiled programs
+    _SCENARIO_FLOOR = 8
+
+    def solve_scenarios(
+        self, scenarios: Sequence[Scenario]
+    ) -> Optional[List[Results]]:
+        """Solve every scenario of one cluster snapshot in a single vmapped
+        kernel dispatch (ops/solve.py:solve_all_scenarios_packed).
+
+        The solver must have been constructed with the FULL node set (no
+        candidates pre-removed); each scenario masks its removed nodes and
+        activates its workload subset over one shared encoding. Returns
+        per-scenario Results aligned with ``scenarios``, or None when the
+        batch cannot be represented scenario-batched — any workload or
+        solver state whose encoding would differ per scenario (topology
+        constraints change priors, reservations and minValues serialize,
+        oracle-routed pods need the host loop) — in which case the caller
+        falls back to per-scenario solve()s. ``last_scenario_dispatches``
+        records the kernel dispatch count of the last successful call."""
+        self.last_scenario_dispatches = 0
+        if not scenarios:
+            return []
+        if self.config.force_oracle or self.config.backend != "tpu":
+            return None
+        if self._resolve_mesh() is not None:
+            return None
+        if self.oracle.reserved_capacity_enabled:
+            # the reservation ledger's holdings would have to merge back
+            # into ONE oracle ReservationManager across scenarios
+            return None
+        # union workload across scenarios, deduped by pod identity
+        union: List[Pod] = []
+        seen: set = set()
+        for sc in scenarios:
+            for p in sc.pods:
+                if p.uid not in seen:
+                    seen.add(p.uid)
+                    union.append(p)
+        mv_templates = [
+            nct
+            for nct in self.oracle.templates
+            if nct.requirements.has_min_values()
+        ]
+        if mv_templates and self._min_values_reachable(mv_templates, union):
+            return None
+        topo = self.oracle.topology
+        if topo.topology_groups or topo.inverse_topology_groups:
+            # topology priors (domain counts, per-node selected-pod counts)
+            # are computed from the nodes present — they would differ per
+            # scenario, and the shared encoding cannot mask them
+            return None
+        if not self.oracle.templates:
+            return None
+        groups, rest = enc.partition_and_group(union, topology=topo)
+        if rest or any(g.topo is not None for g in groups):
+            return None
+        if not groups:
+            return [
+                Results(
+                    new_node_claims=[],
+                    existing_nodes=self.oracle.existing_nodes,
+                    pod_errors={},
+                )
+                for _ in scenarios
+            ]
+
+        snap, avail, nmax_hint, lease_cache = self._encode_batch(groups)
+        a_tzc, res_cap0, a_res = avail
+        if res_cap0.shape[0]:
+            return None
+        fit = self._fit_matrix(snap)
+        nmax = self._select_nmax(snap, fit, nmax_hint)
+        # no G floor here, unlike _solve_fast: under vmap the empty-step
+        # skip (lax.cond) lowers to select, so every padded step runs at
+        # full cost for every scenario — pad only to the next power of two
+        G = enc._next_pow2(len(snap.groups), floor=1)
+        N = (
+            enc._next_pow2(len(snap.existing_names), floor=1)
+            if snap.existing_names
+            else 0
+        )
+        statics = self._kernel_statics(snap, G)
+        snap_run = snap.padded(G, N)
+        args = list(snap_run.solve_args(a_tzc, res_cap0, a_res))
+
+        # per-scenario arrays over the shared encoding
+        uid_to_group: Dict[str, int] = {}
+        for gi, g in enumerate(snap.groups):
+            for p in g.pods:
+                uid_to_group[p.uid] = gi
+        pid_to_node: Dict[str, int] = {}
+        for ni, en in enumerate(self.oracle.existing_nodes):
+            pid = getattr(en.state_node, "provider_id", None)
+            if pid is not None:
+                pid_to_node[pid] = ni
+        S_real = len(scenarios)
+        S = enc._next_pow2(S_real, floor=self._SCENARIO_FLOOR)
+        Gb, Nb = len(snap_run.g_count), snap_run.n_tol.shape[0]
+        g_count_s = np.zeros((S, Gb), np.int32)
+        n_tol_s = np.zeros((S, Nb, max(Gb, 1)), bool)
+        scen_group_pods: List[List[List[Pod]]] = []
+        for si, sc in enumerate(scenarios):
+            per_group: List[List[Pod]] = [[] for _ in snap.groups]
+            for p in sc.pods:
+                per_group[uid_to_group[p.uid]].append(p)
+            scen_group_pods.append(per_group)
+            for gi, pl in enumerate(per_group):
+                g_count_s[si, gi] = len(pl)
+            ntol = snap_run.n_tol
+            if sc.excluded_provider_ids:
+                ntol = ntol.copy()
+                for pid in sc.excluded_provider_ids:
+                    ni = pid_to_node.get(pid)
+                    if ni is not None:
+                        # a node no group tolerates receives no fills: the
+                        # kernel-visible form of "this node is gone"
+                        ntol[ni, :] = False
+            n_tol_s[si] = ntol
+        idx_g_count = enc.SOLVE_ARG_NAMES.index("g_count")
+        idx_n_tol = enc.SOLVE_ARG_NAMES.index("n_tol")
+        args[idx_g_count] = g_count_s
+        args[idx_n_tol] = n_tol_s
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.solve import solve_all_scenarios_packed
+
+        fills_dtype = (
+            jnp.int16 if self._fill_bound(snap, fit) < 2**15 else jnp.int32
+        )
+        dispatches = 0
+        while True:
+            out = solve_all_scenarios_packed(
+                *args, nmax=nmax, fills_dtype=fills_dtype, **statics
+            )
+            (c_pool, packed, n_open, overflow,
+             exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+             c_resv) = [np.asarray(x) for x in jax.device_get(out)]
+            dispatches += 1
+            if not overflow.any():
+                break
+            nmax *= 2
+        self.last_scenario_dispatches = dispatches
+        if self.config.max_claims is None and S_real:
+            with self._shared_cache.lock:
+                lease_cache["nmax_hint"] = max(
+                    lease_cache.get("nmax_hint", 0),
+                    int(n_open[:S_real].max()),
+                )
+
+        results: List[Results] = []
+        for si in range(S_real):
+            # fills commit onto per-scenario node clones so scenarios never
+            # observe each other's placements (only touched nodes clone;
+            # the rest share the untouched oracle models)
+            nodes = list(self.oracle.existing_nodes)
+            for ni in np.nonzero(exist_fills[si].any(axis=0))[0]:
+                if ni < len(nodes):
+                    nodes[ni] = _clone_existing_node(nodes[ni])
+            claims, errors = self._decode(
+                snap,
+                c_pool[si].astype(np.int32),
+                packed[si],
+                int(n_open[si]),
+                exist_fills[si].astype(np.int32),
+                claim_fills[si].astype(np.int32),
+                unplaced[si],
+                c_dzone[si].astype(np.int32),
+                c_dct[si].astype(np.int32),
+                c_resv[si].astype(bool),
+                group_pods=scen_group_pods[si],
+                existing_nodes=nodes,
+            )
+            results.append(
+                Results(
+                    new_node_claims=claims,
+                    existing_nodes=nodes,
+                    pod_errors=errors,
+                ).truncate_instance_types()
+            )
+        return results
+
     # -- fast path --------------------------------------------------------
 
     def _solve_fast(
@@ -297,72 +510,23 @@ class TpuSolver:
                 for g in groups
                 for p in g.pods
             }
-        its_by_pool = {
-            nct.node_pool_name: nct.instance_type_options for nct in templates
-        }
-        with self._shared_cache.lock:
-            vocab, cache = self._shared_cache.lease(
-                templates, its_by_pool, self.oracle.daemon_overhead,
-                self.pool_limits,
-            )
-            snap = enc.encode(
-                groups,
-                templates,
-                its_by_pool,
-                existing_nodes=self.oracle.existing_nodes,
-                daemon_overhead=self.oracle.daemon_overhead,
-                pool_limits=self.pool_limits,
-                vocab=vocab,
-                cache=cache,
-            )
-            reserved_enabled = self.oracle.reserved_capacity_enabled
-            avail_key = ("a_tzc", reserved_enabled) + snap.vocab.padded_shape()
-            avail = cache.get(avail_key)
-            if avail is None:
-                avail = cache[avail_key] = self._offering_availability(
-                    snap, reserved_enabled
-                )
-            nmax_hint = cache.get("nmax_hint")
+        snap, avail, nmax_hint, lease_cache = self._encode_batch(groups)
         a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
-        nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
-        if self.config.max_claims is None:
-            # adaptive sizing: the a-priori estimate sums per-group worst
-            # cases and overshoots shared packing by 2-4x; once a solve of
-            # this catalog has run, size off the observed claim count
-            # instead (x1.5 headroom, floored at the hard pods-capacity
-            # bound). Every [NMAX, T] op in the scan scales with this.
-            # Undershoot is caught by the overflow-doubling retry below.
-            hint = nmax_hint
-            if hint:
-                adaptive = max(
-                    enc._next_pow2(int(hint * 1.5) + 8, floor=8),
-                    enc._next_pow2(self._nmax_floor(snap, fit), floor=8),
-                )
-                nmax = min(nmax, adaptive)
+        # adaptive sizing inside _select_nmax: the a-priori estimate sums
+        # per-group worst cases and overshoots shared packing by 2-4x; once
+        # a solve of this catalog has run, size off the observed claim count
+        # instead (x1.5 headroom, floored at the hard pods-capacity bound).
+        # Every [NMAX, T] op in the scan scales with this. Undershoot is
+        # caught by the overflow-doubling retry below.
+        nmax = self._select_nmax(snap, fit, nmax_hint)
         P = len(snap.templates)
         T = len(snap.instance_types)
         # bucketed axis sizes: the kernel runs on the padded snapshot, so
         # every shape-derived decision below must use these
         G = enc._next_pow2(len(snap.groups), floor=8)
         N = enc._next_pow2(len(snap.existing_names), floor=1) if snap.existing_names else 0
-        statics = dict(
-            zone_kid=snap.zone_kid,
-            ct_kid=snap.ct_kid,
-            # static gate: topology-free batches trace out the per-domain
-            # offering tensors and quota machinery entirely
-            has_domains=bool((snap.g_dmode > 0).any()),
-            # static gate: contributor counting (cross-group shared
-            # constraints) traced out unless some group feeds a carry
-            has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
-            # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
-            # feasibility tables, the scan computes per-group rows instead
-            tile_feasibility=P * G * T * 5 > (3 << 29),
-            # waterfill bisection budget: every trip is a serial reduction
-            # on the scan-step critical path, so prove the tightest level
-            # bound the snapshot allows (see _wf_iters)
-            wf_iters=self._wf_iters(snap),
-        )
+        statics = self._kernel_statics(snap, G)
         # bucket the G/N axes to powers of two: repeat solves of nearby
         # shapes (consolidation's binary-search probes, incremental
         # provisioning rounds) reuse one compiled program instead of paying
@@ -471,12 +635,85 @@ class TpuSolver:
             nmax *= 2
         if self.config.max_claims is None:
             with self._shared_cache.lock:
-                cache["nmax_hint"] = max(
-                    cache.get("nmax_hint", 0), int(n_open)
+                lease_cache["nmax_hint"] = max(
+                    lease_cache.get("nmax_hint", 0), int(n_open)
                 )
         return self._decode(
             snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills,
             unplaced, c_dzone, c_dct, c_resv,
+        )
+
+    def _encode_batch(self, groups: List[enc.PodGroup]):
+        """Encode ``groups`` against the shared cache. Returns
+        (snap, (a_tzc, res_cap0, a_res), nmax_hint, cache) — ``cache`` is
+        the LEASED dict this encode ran against; post-solve hint writes
+        must target it (not a re-fetched self._shared_cache.cache, which a
+        concurrent lease under a changed catalog may have replaced — a
+        stale hint written into a fresh catalog's dict would mis-size that
+        catalog's first NMAX)."""
+        templates = self.oracle.templates
+        its_by_pool = {
+            nct.node_pool_name: nct.instance_type_options for nct in templates
+        }
+        with self._shared_cache.lock:
+            vocab, cache = self._shared_cache.lease(
+                templates, its_by_pool, self.oracle.daemon_overhead,
+                self.pool_limits,
+            )
+            snap = enc.encode(
+                groups,
+                templates,
+                its_by_pool,
+                existing_nodes=self.oracle.existing_nodes,
+                daemon_overhead=self.oracle.daemon_overhead,
+                pool_limits=self.pool_limits,
+                vocab=vocab,
+                cache=cache,
+            )
+            reserved_enabled = self.oracle.reserved_capacity_enabled
+            avail_key = ("a_tzc", reserved_enabled) + snap.vocab.padded_shape()
+            avail = cache.get(avail_key)
+            if avail is None:
+                avail = cache[avail_key] = self._offering_availability(
+                    snap, reserved_enabled
+                )
+            nmax_hint = cache.get("nmax_hint")
+        return snap, avail, nmax_hint, cache
+
+    def _select_nmax(self, snap: enc.EncodedSnapshot, fit, nmax_hint) -> int:
+        """NMAX for this snapshot: config override, else the a-priori
+        estimate, tightened by the observed-claim-count hint when one has
+        been recorded for this catalog."""
+        nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
+        if self.config.max_claims is None and nmax_hint:
+            adaptive = max(
+                enc._next_pow2(int(nmax_hint * 1.5) + 8, floor=8),
+                enc._next_pow2(self._nmax_floor(snap, fit), floor=8),
+            )
+            nmax = min(nmax, adaptive)
+        return nmax
+
+    def _kernel_statics(self, snap: enc.EncodedSnapshot, G: int) -> dict:
+        """The static (compile-time) kernel arguments for this snapshot;
+        ``G`` is the bucketed group-axis size the kernel will run at."""
+        P = len(snap.templates)
+        T = len(snap.instance_types)
+        return dict(
+            zone_kid=snap.zone_kid,
+            ct_kid=snap.ct_kid,
+            # static gate: topology-free batches trace out the per-domain
+            # offering tensors and quota machinery entirely
+            has_domains=bool((snap.g_dmode > 0).any()),
+            # static gate: contributor counting (cross-group shared
+            # constraints) traced out unless some group feeds a carry
+            has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
+            # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
+            # feasibility tables, the scan computes per-group rows instead
+            tile_feasibility=P * G * T * 5 > (3 << 29),
+            # waterfill bisection budget: every trip is a serial reduction
+            # on the scan-step critical path, so prove the tightest level
+            # bound the snapshot allows (see _wf_iters)
+            wf_iters=self._wf_iters(snap),
         )
 
     # below this mean (real groups per feasibility class), per-class head
@@ -754,8 +991,25 @@ class TpuSolver:
         c_dzone: Optional[np.ndarray] = None,  # [NMAX] pinned zone value ids
         c_dct: Optional[np.ndarray] = None,  # [NMAX] pinned capacity-type ids
         c_resv: Optional[np.ndarray] = None,  # [NMAX] claim holds reservations
+        group_pods: Optional[List[List[Pod]]] = None,
+        existing_nodes: Optional[List] = None,
     ) -> Tuple[List[DecodedClaim], Dict[str, object]]:
+        """``group_pods``/``existing_nodes`` override the decode targets for
+        scenario fan-out: scenario s places only its ACTIVE subset of each
+        group's pods (group members are equivalent, so any k of them decode
+        a fill of k), and commits fills onto per-scenario node clones so
+        scenarios never see each other's placements."""
         self._cursors = {}
+        existing = (
+            existing_nodes if existing_nodes is not None
+            else self.oracle.existing_nodes
+        )
+
+        def pods_of(gi: int) -> List[Pod]:
+            return (
+                group_pods[gi] if group_pods is not None
+                else snap.groups[gi].pods
+            )
 
         # existing-node fills: commit pods + requests onto the oracle's
         # ExistingNode models so a subsequent oracle pass sees them.
@@ -763,9 +1017,9 @@ class TpuSolver:
         # advance deterministically per group.
         for gi, ni in zip(*np.nonzero(exist_fills)):
             g = snap.groups[gi]
-            en = self.oracle.existing_nodes[ni]
+            en = existing[ni]
             k = int(exist_fills[gi, ni])
-            pods = g.pods[self._g_cursor(gi) : self._g_cursor(gi) + k]
+            pods = pods_of(gi)[self._g_cursor(gi) : self._g_cursor(gi) + k]
             self._advance(gi, k)
             en.pods.extend(pods)
             en.requests = res.merge(en.requests, *(p.spec.requests for p in pods))
@@ -854,7 +1108,9 @@ class TpuSolver:
             if claim is None:
                 continue
             k = int(claim_fills[gi, slot])
-            claim.pods.extend(g.pods[self._g_cursor(gi) : self._g_cursor(gi) + k])
+            claim.pods.extend(
+                pods_of(gi)[self._g_cursor(gi) : self._g_cursor(gi) + k]
+            )
             self._advance(gi, k)
             claim.requirements.add(*g.requirements.values())
 
@@ -862,7 +1118,9 @@ class TpuSolver:
         for gi, g in enumerate(snap.groups):
             n_err = int(unplaced[gi])
             if n_err:
-                for p in g.pods[self._g_cursor(gi) : self._g_cursor(gi) + n_err]:
+                for p in pods_of(gi)[
+                    self._g_cursor(gi) : self._g_cursor(gi) + n_err
+                ]:
                     errors[p.uid] = "no feasible instance type/template for pod group"
         return claims, errors
 

@@ -521,8 +521,9 @@ class EncodedSnapshot:
         a_res: Optional[np.ndarray] = None,
     ) -> tuple:
         """The positional argument tuple for ops/solve.py:solve_core — the
-        single authority on that ordering (driver, examples, and the
-        multi-chip padding all build from this)."""
+        single authority on that ordering (driver, examples, the multi-chip
+        padding, and the scenario axis all build from this; SOLVE_ARG_NAMES
+        below names each position for axis selection)."""
         if res_cap0 is None:
             res_cap0 = np.zeros((0,), np.int32)
         if a_res is None:
@@ -545,6 +546,30 @@ class EncodedSnapshot:
             self.nh_cnt0, self.dd0, self.dtg_key,
             self.well_known,
         )
+
+
+# Position names for EncodedSnapshot.solve_args' tuple, in order. The
+# scenario-batched dispatch (ops/solve.py:solve_all_scenarios_packed) maps
+# batched axes by name through this tuple, so it must track solve_args
+# exactly (tests/test_scenario_batch.py pins the correspondence).
+SOLVE_ARG_NAMES = (
+    "g_count", "g_req", "g_def", "g_neg", "g_mask",
+    "g_hcap", "g_haff",
+    "g_dmode", "g_dkey", "g_dskew", "g_dmin0",
+    "g_dprior", "g_dreg", "g_drank",
+    "g_hstg", "g_hscap", "g_dtg",
+    "g_hself", "g_hcontrib", "g_dcontrib",
+    "p_def", "p_neg", "p_mask", "p_daemon",
+    "p_limit", "p_has_limit", "p_tol", "p_titype_ok",
+    "t_def", "t_mask", "t_alloc", "t_cap",
+    "o_avail", "o_zone", "o_ct",
+    "a_tzc", "res_cap0", "a_res",
+    "n_def", "n_mask", "n_avail", "n_base", "n_tol",
+    "n_hcnt",
+    "n_dzone", "n_dct",
+    "nh_cnt0", "dd0", "dtg_key",
+    "well_known",
+)
 
 
 def encode(
@@ -811,6 +836,28 @@ def encode(
     n_dct = np.full((N,), -1, np.int32)
     nh_cnt0 = np.zeros((N, JH), np.int32)
     existing_names = []
+    # content-shared node rows: fleets are homogeneous (a 2k-node cluster
+    # snapshot typically carries a handful of distinct label shapes), so
+    # the mask rows are computed once per distinct requirement content and
+    # copied per node. The hostname requirement is excluded from the key:
+    # hostname values are provider-side and encode to the OVERFLOW slot,
+    # identical across nodes — UNLESS some hostname value has been interned
+    # (a pod node-selector naming a node), which disables sharing for this
+    # encode. Caches are per-call: the vocab is stable here (all
+    # observation happened above), and cross-call reuse is the _enc_rows
+    # stash's job.
+    hn_kid = vocab.key_ids.get(labels_mod.HOSTNAME)
+    hn_interned = bool(vocab.values[hn_kid]) if hn_kid is not None else False
+    row_cache: Dict[tuple, tuple] = {}
+    tol_cache: Dict[tuple, np.ndarray] = {}
+    # groups with hostname-topology priors, walked per node; everything
+    # else in the per-node group loop is the tolerance row (cached by
+    # taint content below)
+    topo_gis = [
+        gi
+        for gi, g in enumerate(groups)
+        if g.topo is not None and (g.topo.host_counts or g.topo.haff_prior)
+    ]
     for i, en in enumerate(existing_nodes):
         # `en` is a scheduling.inflight.ExistingNode (carries the remaining
         # daemon requests and cached availability)
@@ -835,11 +882,35 @@ def encode(
         else:
             n_avail[i] = quantize_capacity(en.cached_available, resource_names)
             n_base[i] = quantize_requests(en.requests, resource_names)
-            n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
-            n_dzone[i] = _node_domain_id(vocab, en, labels_mod.TOPOLOGY_ZONE)
-            n_dct[i] = _node_domain_id(
-                vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY
-            )
+            ck = None
+            rows = None
+            if not hn_interned:
+                ck = tuple(
+                    sorted(
+                        (
+                            r.key, r.complement, tuple(sorted(r.values)),
+                            r.greater_than, r.less_than,
+                        )
+                        for r in en.requirements
+                        if r.key != labels_mod.HOSTNAME
+                    )
+                ) + (en.requirements.has(labels_mod.HOSTNAME),)
+                rows = row_cache.get(ck)
+            if rows is None:
+                n_def[i], _, n_mask[i] = vocab.encode(en.requirements, K, V1)
+                n_dzone[i] = _node_domain_id(
+                    vocab, en, labels_mod.TOPOLOGY_ZONE
+                )
+                n_dct[i] = _node_domain_id(
+                    vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY
+                )
+                if ck is not None:
+                    row_cache[ck] = (
+                        n_def[i].copy(), n_mask[i].copy(),
+                        n_dzone[i], n_dct[i],
+                    )
+            else:
+                n_def[i], n_mask[i], n_dzone[i], n_dct[i] = rows
             if sn is not None:
                 sn._enc_rows = (
                     tag,
@@ -852,28 +923,41 @@ def encode(
             )
             for j, desc in enumerate(shared_h_descs):
                 nh_cnt0[i, j] = desc.counts.get(hostname, 0)
-        for gi, g in enumerate(groups):
-            n_tol[i, gi] = (
-                taints_mod.tolerates(en.cached_taints, g.pods[0].spec.tolerations)
-                is None
+        if G:
+            tkey = tuple(
+                (t.key, t.value, t.effect) for t in en.cached_taints
             )
-            if g.topo is not None and (
-                g.topo.host_counts or g.topo.haff_prior
-            ):
-                # hostname domains are the node's hostname label (node name
-                # as fallback), mirroring Topology._count_domains. For haff
-                # groups the row holds the affinity matching-pod priors
-                # (the cap/affinity combo is demoted, so no overlap).
-                domain = (
-                    en.state_node.hostname()
-                    if hasattr(en, "state_node")
-                    else en.name
+            trow = tol_cache.get(tkey)
+            if trow is None:
+                trow = np.fromiter(
+                    (
+                        taints_mod.tolerates(
+                            en.cached_taints, g.pods[0].spec.tolerations
+                        )
+                        is None
+                        for g in groups
+                    ),
+                    bool,
+                    G,
                 )
-                n_hcnt[i, gi] = (
-                    g.topo.haff_prior.get(domain, 0)
-                    if g.topo.haff
-                    else g.topo.host_counts.get(domain, 0)
-                )
+                tol_cache[tkey] = trow
+            n_tol[i, :G] = trow
+        for gi in topo_gis:
+            g = groups[gi]
+            # hostname domains are the node's hostname label (node name
+            # as fallback), mirroring Topology._count_domains. For haff
+            # groups the row holds the affinity matching-pod priors
+            # (the cap/affinity combo is demoted, so no overlap).
+            domain = (
+                en.state_node.hostname()
+                if hasattr(en, "state_node")
+                else en.name
+            )
+            n_hcnt[i, gi] = (
+                g.topo.haff_prior.get(domain, 0)
+                if g.topo.haff
+                else g.topo.host_counts.get(domain, 0)
+            )
 
     return EncodedSnapshot(
         vocab=vocab,

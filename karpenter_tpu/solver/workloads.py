@@ -243,6 +243,18 @@ def spot_od_pools():
     ]
 
 
+def build_single_consolidation_env(n_nodes: int) -> Tuple:
+    """A single-node-consolidation variant of the consolidation env: same
+    underutilized cluster, method = SingleNodeConsolidation (the
+    per-candidate sweep the scenario batch evaluates in chunks). Returns
+    (ctx, SingleNodeConsolidation, candidates, budgets)."""
+    from ..controllers.disruption.methods import SingleNodeConsolidation
+
+    ctx, _multi, candidates, budgets = build_consolidation_env(n_nodes)
+    method = SingleNodeConsolidation(ctx)
+    return ctx, method, candidates, budgets
+
+
 def build_consolidation_env(n_nodes: int) -> Tuple:
     """BASELINE config[3]: an underutilized cluster of ``n_nodes`` ready for
     multi-node consolidation.

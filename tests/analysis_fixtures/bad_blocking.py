@@ -2,6 +2,7 @@
 
 import subprocess
 import time
+import urllib.request
 
 
 class SlowController:
@@ -9,4 +10,8 @@ class SlowController:
         time.sleep(0.5)  # BLK301: wall-clock sleep in a reconcile path
         started = time.time()  # BLK302: direct wall-clock read
         subprocess.run(["sync"])  # BLK303: blocking process call
+        # BLK303 via a dotted import (`import urllib.request` binds
+        # `urllib`, not `urllib.request` — the resolver must not
+        # double-append the submodule)
+        urllib.request.urlopen("http://example.invalid")
         return started

@@ -66,6 +66,30 @@ class TestTracerPass:
         findings, _ = tracer.check_paths([str(p)])
         assert rules_of(findings) == {"TRC101"}
 
+    def test_vmap_scenario_wrapper_is_traced(self, tmp_path):
+        """The scenario axis (ops/solve.py) wraps the kernel in a vmapped
+        closure jit-wrapped at module level; a traced branch inside the
+        wrapper OR its closure must still be flagged (this pinned the
+        coverage check done when the scenario axis landed)."""
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def scenarios_core(*args, **statics):\n"
+            "    if args[0].sum() > 0:  # traced branch in the wrapper\n"
+            "        pass\n"
+            "    def one(*a):\n"
+            "        if a[0] > 0:  # traced branch in the vmapped closure\n"
+            "            return a[0]\n"
+            "        return -a[0]\n"
+            "    return jax.vmap(one, in_axes=(0,))(*args)\n"
+            "wrapped = jax.jit(scenarios_core, static_argnames=())\n"
+        )
+        p = tmp_path / "scenario_wrapper.py"
+        p.write_text(src)
+        findings, _ = tracer.check_paths([str(p)])
+        assert rules_of(findings) == {"TRC101"}
+        assert len(findings) >= 2
+
     def test_untraced_host_code_not_flagged(self, tmp_path):
         src = (
             "import time\n"
@@ -130,6 +154,10 @@ class TestBlockingPass:
     def test_bad_fixture_flags_every_rule(self):
         findings, _ = blocking.check_paths([fixture("bad_blocking.py")])
         assert rules_of(findings) == {"BLK301", "BLK302", "BLK303"}
+        # the dotted-import urlopen site must be among the BLK303 hits
+        # (import_aliases: `import a.b` binds `a` -> `a`, not `a` -> `a.b`)
+        blk303_lines = {f.line for f in findings if f.rule == "BLK303"}
+        assert len(blk303_lines) == 2
 
     def test_clean_fixture_silent(self):
         findings, _ = blocking.check_paths([fixture("good_blocking.py")])

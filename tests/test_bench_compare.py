@@ -109,6 +109,31 @@ class TestCompareGrids:
         ]))
         assert compare_grids(old, new) == 0
 
+    def test_consolidation_rows_enforced(self, tmp_path):
+        # the consolidation configs (keyed by nodes, not pods x types) are
+        # first-class floor rows: a regression in the scenario-batched
+        # search must trip the gate exactly like a solve-config regression
+        def centry(config, nodes, best_ms):
+            return {
+                "config": config, "nodes": nodes, "best_ms": best_ms,
+                "pods_per_sec": None, "probes": 21, "dispatches": 2,
+            }
+
+        old = _write(tmp_path, "old.json", _grid("cpu", [
+            centry("consolidation", 2000, 300.0),
+            centry("consolidation-single", 2000, 150.0),
+        ]))
+        new_ok = _write(tmp_path, "new_ok.json", _grid("cpu", [
+            centry("consolidation", 2000, 310.0),
+            centry("consolidation-single", 2000, 160.0),
+        ]))
+        assert compare_grids(old, new_ok) == 0
+        new_bad = _write(tmp_path, "new_bad.json", _grid("cpu", [
+            centry("consolidation", 2000, 450.0),  # +50% > 20% bound
+            centry("consolidation-single", 2000, 150.0),
+        ]))
+        assert compare_grids(old, new_bad) == 1
+
     def test_cli_entrypoint(self, tmp_path):
         old = _write(tmp_path, "old.json", _grid("tpu", [
             _entry("mixed", 5000, 400, 100.0),

@@ -1,0 +1,513 @@
+"""Scenario-batched consolidation: batched == sequential equivalence.
+
+The scenario axis (ops/solve.py:solve_all_scenarios_packed, driver
+solve_scenarios, helpers.ScenarioSimulator) must produce EXACTLY the
+Command the sequential per-probe loop produces — decision, disrupted set,
+replacement instance-type options — across seeded clusters, including the
+filterOutSameType and timeout paths. The sequential loop stays the
+semantic reference (it is the reference's multinodeconsolidation.go
+shape); these suites pin the batched path to it.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as labels_mod
+from karpenter_tpu.api import resources as res
+from karpenter_tpu.api.objects import (
+    COND_CONSOLIDATABLE,
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    Node,
+    NodeClaim,
+    NodeClaimSpec,
+    NodePool,
+    NodePoolSpec,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from karpenter_tpu.api.objects import NodeClaimTemplate as NodeClaimTemplateSpec
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.disruption.controller import DisruptionContext
+from karpenter_tpu.controllers.disruption.helpers import (
+    ScenarioSimulator,
+    build_budget_mapping,
+    get_candidates,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.disruption import methods as methods_mod
+from karpenter_tpu.controllers.disruption.methods import (
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+    _bsearch_tree_mids,
+)
+from karpenter_tpu.controllers.state import Cluster
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.kube import Client, TestClock
+
+_MI = 2**20 * res.MILLI
+
+
+def _pod(name, cpu_m, mem_mi, node_name="", phase="Pending"):
+    p = Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(requests={res.CPU: cpu_m, res.MEMORY: mem_mi * _MI}),
+    )
+    if node_name:
+        p.spec.node_name = node_name
+        p.status.phase = phase
+    return p
+
+
+def build_env(
+    n_nodes: int,
+    seed: int = 0,
+    n_types: int = 40,
+    pending_pods: int = 0,
+    pods_per_node=(1, 2),
+    pod_cpus=(250, 500, 750, 1200),
+    pod_mems=(256, 512, 1024),
+):
+    """A seeded consolidatable cluster: ``n_nodes`` nodes of a mid-priced
+    type, each loaded with a random set of small pods, plus optional
+    pending pods — underutilized enough that delete/replace decisions vary
+    with the seed."""
+    rng = random.Random(seed)
+    clock = TestClock()
+    clock.step(3600.0)
+    client = Client(clock)
+    its = corpus.generate(n_types)
+    provider = KwokCloudProvider(client, its)
+    cluster = Cluster(client)
+
+    pool = NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(template=NodeClaimTemplateSpec(spec=NodeClaimSpec())),
+    )
+    pool.spec.disruption.consolidate_after = 10.0
+    client.create(pool)
+
+    sized = sorted(
+        (
+            it
+            for it in its
+            if it.capacity.get(res.CPU, 0) >= 4000
+            and it.capacity.get(res.MEMORY, 0) >= 8 * 1024 * _MI
+        ),
+        key=lambda it: min(
+            (o.price for o in it.offerings if o.available), default=1e9
+        ),
+    )
+    it = sized[len(sized) // 2]
+    offering = min(
+        (o for o in it.offerings if o.available), key=lambda o: o.price
+    )
+
+    for i in range(n_nodes):
+        name = f"n-{i}"
+        pid = f"test://{i}"
+        node_labels = {
+            labels_mod.HOSTNAME: name,
+            labels_mod.INSTANCE_TYPE: it.name,
+            labels_mod.TOPOLOGY_ZONE: offering.zone(),
+            labels_mod.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type(),
+            labels_mod.NODEPOOL_LABEL_KEY: pool.name,
+        }
+        claim = NodeClaim(
+            metadata=ObjectMeta(name=name, labels=dict(node_labels)),
+            spec=NodeClaimSpec(),
+        )
+        claim.status.provider_id = pid
+        claim.status.capacity = dict(it.capacity)
+        claim.status.allocatable = dict(it.allocatable())
+        now = clock.now()
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED,
+                     COND_CONSOLIDATABLE):
+            claim.conds().set(cond, "True", now=now)
+        node = Node(
+            metadata=ObjectMeta(name=name, labels=node_labels),
+            provider_id=pid,
+        )
+        node.status.capacity = dict(it.capacity)
+        node.status.allocatable = dict(it.allocatable())
+        node.status.ready = True
+        client.create(claim)
+        client.create(node)
+        for j in range(rng.choice(pods_per_node)):
+            client.create(
+                _pod(
+                    f"fill-{i}-{j}",
+                    rng.choice(pod_cpus),
+                    rng.choice(pod_mems),
+                    node_name=name,
+                    phase="Running",
+                )
+            )
+    for j in range(pending_pods):
+        client.create(
+            _pod(f"pend-{j}", rng.choice(pod_cpus), rng.choice(pod_mems))
+        )
+
+    ctx = DisruptionContext(
+        client=client,
+        cluster=cluster,
+        cloud_provider=provider,
+        clock=clock,
+        recorder=Recorder(clock),
+        spot_to_spot_enabled=True,
+    )
+    return ctx
+
+
+def _candidates_and_budgets(ctx, method):
+    candidates = [
+        c
+        for c in get_candidates(
+            ctx.client, ctx.cluster, ctx.cloud_provider, ctx.clock
+        )
+        if method.should_disrupt(c)
+    ]
+    budgets = build_budget_mapping(
+        ctx.client, ctx.cluster, method.reason, ctx.clock.now()
+    )
+    return candidates, budgets
+
+
+def _command_signature(cmd):
+    return (
+        cmd.decision,
+        sorted(c.name for c in cmd.candidates),
+        [
+            [it.name for it in rep.instance_type_options]
+            for rep in cmd.replacements
+        ],
+    )
+
+
+def _run_multi(env_args, batched: bool):
+    ctx = build_env(**env_args)
+    ctx.scenario_batch = batched
+    method = MultiNodeConsolidation(ctx)
+    candidates, budgets = _candidates_and_budgets(ctx, method)
+    cmd = method.compute_command(candidates, budgets)
+    return cmd, method
+
+
+class TestMidpointTree:
+    def test_levels_cover_search_prefix(self):
+        # every actual binary-search path's first probes are tree nodes
+        for n in (2, 3, 7, 13, 50, 100):
+            mids = _bsearch_tree_mids(n, budget=15)
+            assert mids[0] == (1 + n) // 2
+            assert len(set(mids)) == len(mids)
+            assert all(1 <= m <= n for m in mids)
+
+    def test_small_n_fully_enumerated(self):
+        assert sorted(_bsearch_tree_mids(7, budget=15)) == [1, 2, 3, 4, 5, 6, 7]
+
+
+class TestMultiNodeEquivalence:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_seeded_clusters(self, seed):
+        env_args = dict(
+            n_nodes=6 + (seed * 5) % 19,
+            seed=seed,
+            pending_pods=(seed % 3),
+        )
+        cmd_b, method_b = _run_multi(env_args, batched=True)
+        cmd_s, method_s = _run_multi(env_args, batched=False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        if method_b.last_probes:
+            # the whole probe set rode the batch, in at most 2 dispatches
+            assert method_b.last_dispatches <= 2
+
+    def test_filter_out_same_type_path(self):
+        # every candidate is the same instance type; a replacement's options
+        # must exclude it (filterOutSameType), in both paths identically
+        env_args = dict(n_nodes=12, seed=3)
+        cmd_b, _ = _run_multi(env_args, batched=True)
+        cmd_s, _ = _run_multi(env_args, batched=False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        ctx = build_env(**env_args)
+        deleted_types = {
+            c.instance_type.name
+            for c in _candidates_and_budgets(ctx, MultiNodeConsolidation(ctx))[0]
+        }
+        for cmd in (cmd_b, cmd_s):
+            for rep in cmd.replacements:
+                assert not deleted_types & {
+                    it.name for it in rep.instance_type_options
+                }
+
+    def test_immediate_timeout(self, monkeypatch):
+        monkeypatch.setattr(
+            methods_mod, "MULTI_NODE_CONSOLIDATION_TIMEOUT", -1.0
+        )
+        cmd_b, _ = _run_multi(dict(n_nodes=10, seed=1), batched=True)
+        cmd_s, _ = _run_multi(dict(n_nodes=10, seed=1), batched=False)
+        assert cmd_b.decision == "no-op"
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+
+    @pytest.mark.parametrize("probes_before_timeout", [1, 2, 3])
+    def test_mid_search_timeout(self, monkeypatch, probes_before_timeout):
+        """The replay consults the injected clock once per probe, exactly
+        like the sequential loop — an auto-advancing clock times out after
+        the same number of probes either way."""
+        monkeypatch.setattr(
+            methods_mod,
+            "MULTI_NODE_CONSOLIDATION_TIMEOUT",
+            probes_before_timeout * 10.0 + 5.0,
+        )
+
+        class AdvancingClock(TestClock):
+            def now(self):
+                t = super().now()
+                self.step(10.0)
+                return t
+
+        def run(batched):
+            ctx = build_env(n_nodes=14, seed=2)
+            adv = AdvancingClock()
+            adv.step(ctx.clock.now())
+            ctx.clock = adv
+            ctx.scenario_batch = batched
+            method = MultiNodeConsolidation(ctx)
+            candidates, budgets = _candidates_and_budgets(ctx, method)
+            return method.compute_command(candidates, budgets)
+
+        assert _command_signature(run(True)) == _command_signature(run(False))
+
+
+class TestSingleNodeEquivalence:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_seeded_clusters(self, seed):
+        def run(batched):
+            ctx = build_env(
+                n_nodes=5 + (seed * 7) % 14, seed=seed,
+                pods_per_node=(1,), pod_cpus=(250, 400),
+            )
+            ctx.scenario_batch = batched
+            method = SingleNodeConsolidation(ctx)
+            candidates, budgets = _candidates_and_budgets(ctx, method)
+            return method.compute_command(candidates, budgets)
+
+        assert _command_signature(run(True)) == _command_signature(run(False))
+
+    def test_chunked_sweep_no_success(self):
+        # fully-loaded nodes: no candidate consolidates; the batched sweep
+        # must walk every chunk and reach the same no-op + bookkeeping
+        def run(batched):
+            ctx = build_env(
+                n_nodes=8, seed=5, pods_per_node=(3,),
+                pod_cpus=(1200,), pod_mems=(2048,),
+            )
+            ctx.scenario_batch = batched
+            method = SingleNodeConsolidation(ctx)
+            candidates, budgets = _candidates_and_budgets(ctx, method)
+            cmd = method.compute_command(candidates, budgets)
+            return cmd, method.suppress_memoization
+
+        (cmd_b, sup_b) = run(True)
+        (cmd_s, sup_s) = run(False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        assert sup_b == sup_s
+
+
+class TestScenarioSimulatorFallback:
+    def test_volume_pods_fall_back(self):
+        ctx = build_env(n_nodes=6, seed=0)
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        assert candidates
+        # inject a pending pod with a volume: the shared encoding cannot
+        # carry per-scenario deep copies, so the simulator must decline
+        from karpenter_tpu.api.objects import PersistentVolumeClaimRef
+
+        vol_pod = _pod("vol-pod", 100, 128)
+        vol_pod.spec.volumes = [PersistentVolumeClaimRef(claim_name="pvc-1")]
+        ctx.client.create(vol_pod)
+        sim = ScenarioSimulator(
+            ctx.client, ctx.cluster, ctx.cloud_provider, candidates,
+            encode_cache=ctx.encode_cache,
+        )
+        assert not sim.available
+        assert sim.solve([[candidates[0]]]) is None
+
+    def test_fallback_still_decides(self):
+        # with the batched path declined, compute_command must still return
+        # the sequential decision
+        ctx = build_env(n_nodes=10, seed=1)
+        from karpenter_tpu.api.objects import PersistentVolumeClaimRef
+
+        vol_pod = _pod("vol-pod", 100, 128)
+        vol_pod.spec.volumes = [PersistentVolumeClaimRef(claim_name="pvc-1")]
+        ctx.client.create(vol_pod)
+        ctx.scenario_batch = True
+        method = MultiNodeConsolidation(ctx)
+        candidates, budgets = _candidates_and_budgets(ctx, method)
+        cmd_b = method.compute_command(candidates, budgets)
+
+        ctx2 = build_env(n_nodes=10, seed=1)
+        vol_pod2 = _pod("vol-pod", 100, 128)
+        vol_pod2.spec.volumes = [PersistentVolumeClaimRef(claim_name="pvc-1")]
+        ctx2.client.create(vol_pod2)
+        ctx2.scenario_batch = False
+        method2 = MultiNodeConsolidation(ctx2)
+        candidates2, budgets2 = _candidates_and_budgets(ctx2, method2)
+        cmd_s = method2.compute_command(candidates2, budgets2)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+
+
+class TestSimulatorResultsEquivalence:
+    def test_results_match_sequential_simulate(self):
+        """Per-subset Results from one batched dispatch must match the
+        sequential simulate_scheduling claim-for-claim."""
+        ctx = build_env(n_nodes=14, seed=4, pending_pods=2)
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        assert len(candidates) >= 4
+        snapshot = ctx.cluster.nodes()
+        subsets = [candidates[:1], candidates[:2], candidates[:4]]
+        sim = ScenarioSimulator(
+            ctx.client, ctx.cluster, ctx.cloud_provider, candidates,
+            encode_cache=ctx.encode_cache, state_snapshot=snapshot,
+        )
+        batched = sim.solve(subsets)
+        assert batched is not None
+        for subset, br in zip(subsets, batched):
+            sr = simulate_scheduling(
+                ctx.client, ctx.cluster, ctx.cloud_provider, subset,
+                encode_cache=ctx.encode_cache, state_snapshot=snapshot,
+            )
+            assert set(br.pod_errors) == set(sr.pod_errors)
+            a = sorted(
+                (
+                    len(c.pods),
+                    tuple(it.name for it in c.instance_type_options),
+                )
+                for c in br.new_node_claims
+            )
+            b = sorted(
+                (
+                    len(c.pods),
+                    tuple(it.name for it in c.instance_type_options),
+                )
+                for c in sr.new_node_claims
+            )
+            assert a == b
+            # existing-node fills must match too (which nodes took pods)
+            fa = {
+                en.name: len(en.pods)
+                for en in br.existing_nodes
+                if en.pods
+            }
+            fb = {
+                en.name: len(en.pods)
+                for en in sr.existing_nodes
+                if en.pods
+            }
+            assert fa == fb
+
+    def test_scenarios_isolated(self):
+        """One scenario's fills must not leak into another's Results (the
+        per-scenario node clones)."""
+        ctx = build_env(n_nodes=8, seed=6, pods_per_node=(2,))
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        assert len(candidates) >= 2
+        sim = ScenarioSimulator(
+            ctx.client, ctx.cluster, ctx.cloud_provider, candidates,
+            encode_cache=ctx.encode_cache,
+        )
+        out = sim.solve([[candidates[0]], [candidates[0]]])
+        assert out is not None
+        r1, r2 = out
+        f1 = {en.name: len(en.pods) for en in r1.existing_nodes if en.pods}
+        f2 = {en.name: len(en.pods) for en in r2.existing_nodes if en.pods}
+        assert f1 == f2  # identical scenarios, identical (isolated) fills
+
+
+class TestNodeModelCacheIsolation:
+    def test_fills_do_not_pollute_cached_node_models(self):
+        """Decode's existing-node fill commit mutates the ExistingNode's
+        requirements container; the cross-solve node-model cache must hand
+        every solve a FRESH container over the shared entries, or one
+        probe's fills (e.g. a DoesNotExist pod requirement) leak into the
+        next probe's node model and wrongly reject future pods."""
+        from karpenter_tpu.api.objects import (
+            NodeAffinity,
+            NodeSelectorRequirement,
+        )
+
+        ctx = build_env(n_nodes=4, seed=0, pods_per_node=(1,))
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        assert candidates
+        snapshot = ctx.cluster.nodes()
+        p = _pod("dne-pod", 100, 128)
+        p.spec.node_affinity = NodeAffinity(
+            required=[
+                (NodeSelectorRequirement("example.com/team", "DoesNotExist", ()),)
+            ]
+        )
+        ctx.client.create(p)
+
+        def run():
+            return simulate_scheduling(
+                ctx.client, ctx.cluster, ctx.cloud_provider, candidates[:1],
+                encode_cache=ctx.encode_cache, state_snapshot=snapshot,
+            )
+
+        r1 = run()
+        host = [
+            en
+            for en in r1.existing_nodes
+            if any(pp.metadata.name == "dne-pod" for pp in en.pods)
+        ]
+        assert host, "the pending pod must land on an existing node"
+        assert host[0].requirements.has("example.com/team")
+        # the pod is gone from the cluster; the next solve's node model is
+        # built from the cache hit and must not carry the previous solve's
+        # fill-merged requirement
+        ctx.client.delete(p)
+        r2 = run()
+        fresh = [en for en in r2.existing_nodes if en.name == host[0].name]
+        assert fresh
+        assert not fresh[0].requirements.has("example.com/team")
+
+
+class TestSolveArgNames:
+    def test_names_track_solve_args(self):
+        """SOLVE_ARG_NAMES must mirror EncodedSnapshot.solve_args exactly —
+        the scenario axis selects batched positions by name through it."""
+        import numpy as np
+
+        from karpenter_tpu.solver import encode as enc
+
+        ctx = build_env(n_nodes=3, seed=0)
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        pods = [p for c in candidates for p in c.reschedulable_pods]
+        groups, rest = enc.partition_and_group(pods)
+        assert groups and not rest
+        its = ctx.cloud_provider.get_instance_types(None)
+        from karpenter_tpu.api.objects import NodePool
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver.driver import TpuSolver
+
+        pools = ctx.client.list(NodePool)
+        its_by_pool = {p.name: its for p in pools}
+        topo = Topology(ctx.client, [], pools, its_by_pool, pods)
+        solver = TpuSolver(pools, its_by_pool, topo)
+        snap, avail, _, _ = solver._encode_batch(groups)
+        args = snap.solve_args(*avail)
+        assert len(args) == len(enc.SOLVE_ARG_NAMES)
+        assert args[enc.SOLVE_ARG_NAMES.index("g_count")] is snap.g_count
+        assert args[enc.SOLVE_ARG_NAMES.index("n_tol")] is snap.n_tol
+        assert args[enc.SOLVE_ARG_NAMES.index("well_known")] is snap.well_known

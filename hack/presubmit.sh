@@ -11,7 +11,10 @@ python -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
 
 # the `go vet` analog: AST passes for tracer-safety in the kernels, lock
 # ordering / callback-under-lock in the store layer, blocking calls in
-# reconcile paths, and schema<->CRD drift (karpenter_tpu/analysis/)
+# reconcile paths, schema<->CRD drift, kernel-twin parity skeletons
+# (pack / pack_classed / solve_core.cc via `// parity:` anchors), and
+# axis/dtype shape discipline over ops/+solver/ (karpenter_tpu/analysis/).
+# Exit-code enforced by set -e: any unsuppressed finding fails presubmit.
 echo "== static analysis =="
 python -m karpenter_tpu.analysis
 

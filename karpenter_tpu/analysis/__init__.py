@@ -1,11 +1,17 @@
 """Static-analysis tier: AST passes for the hazards the runtime cannot see.
 
 The reference's presubmit leans on ``go vet`` + the race detector; this
-package is the Python/JAX analog, purpose-built for this codebase's two
+package is the Python/JAX analog, purpose-built for this codebase's
 dangerous seams:
 
 - the batched XLA kernels (ops/, solver/), where host Python control flow
-  on traced values silently recompiles or miscomputes (tracer.py);
+  on traced values silently recompiles or miscomputes (tracer.py), and
+  where axis-order/dtype mistakes broadcast instead of erroring
+  (shapes.py);
+- the three bit-exact kernel twins — pack, pack_classed, and the C++ core
+  — whose structural agreement parity.py pins via semantic skeletons and
+  ``// parity:`` anchors, so a change landing in only one twin fails
+  presubmit instead of a parity suite weeks later;
 - the threaded store/state layer, where lock-order inversions and
   callbacks invoked under a lock are the deadlock class tests/test_races.py
   can only catch dynamically (locks.py).
@@ -17,9 +23,27 @@ drift between api/schema.py and the checked-in CRD YAML (schema_drift.py).
 Run ``python -m karpenter_tpu.analysis`` (or hack/analyze.py); it exits
 nonzero on any new finding. Suppress with an inline
 ``# analysis: ignore[RULE] reason`` on the flagged line (or the line
-above), or a baseline entry in hack/analysis_baseline.txt.
+above; ``//`` in C++ sources), or a baseline entry in
+hack/analysis_baseline.txt.
 """
+
+from typing import Dict
 
 from .findings import Finding, Severity, load_baseline, filter_suppressed
 
-__all__ = ["Finding", "Severity", "load_baseline", "filter_suppressed"]
+
+def all_rules() -> Dict[str, str]:
+    """Every shipped rule id -> one-line description, aggregated from the
+    pass modules. The meta-test in tests/test_analysis.py asserts each has
+    a seeded-bad fixture; the SARIF writer uses it for rule metadata."""
+    from . import blocking, locks, parity, schema_drift, shapes, tracer
+
+    out: Dict[str, str] = {}
+    for mod in (tracer, locks, blocking, schema_drift, parity, shapes):
+        out.update(getattr(mod, "RULES", {}))
+    return out
+
+
+__all__ = [
+    "Finding", "Severity", "load_baseline", "filter_suppressed", "all_rules",
+]

@@ -22,6 +22,13 @@ from typing import Dict, List, Tuple
 from .astutil import call_name, import_aliases, iter_py_files, parse_file
 from .findings import Finding, Severity, SourceFile
 
+RULES = {
+    "BLK300": "unparsable file (blocking pass)",
+    "BLK301": "time.sleep in a reconcile path",
+    "BLK302": "direct wall-clock read in a reconcile path",
+    "BLK303": "blocking process/network call in a reconcile path",
+}
+
 _SLEEPS = {"time.sleep"}
 _CLOCK_READS = {"time.time", "time.monotonic", "time.perf_counter"}
 _BLOCKING_CALLS = {

@@ -5,22 +5,29 @@ Default targets mirror the hazards each pass exists for:
 - tracer:   karpenter_tpu/ops, karpenter_tpu/solver
 - locks:    kube/store.py, kube/filestore.py, controllers/state.py,
             solver/driver.py, metrics/registry.py
-- blocking: karpenter_tpu/controllers, karpenter_tpu/__main__.py
+- blocking: karpenter_tpu/controllers, karpenter_tpu/__main__.py,
+            solver/service.py, kube/leader.py
 - schema:   api/schema.py vs api/crds/
+- parity:   ops/packing.py vs native/solve_core.cc (kernel-twin skeletons)
+- shapes:   karpenter_tpu/ops, karpenter_tpu/solver (axis/dtype walker)
 
 Positional paths (with ``--pass``) override a pass's default targets so
 fixture suites can point a single pass at seeded-bad files. Exit status is
 the number of unsuppressed findings capped at 1 — suitable for presubmit.
+``--format sarif`` emits SARIF 2.1.0 for code-review UIs;
+``--write-baseline`` regenerates hack/analysis_baseline.txt so bulk
+grandfathering is a designed workflow instead of a hand-edit.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Dict, List
 
-from . import blocking, locks, schema_drift, tracer
+from . import all_rules, blocking, locks, parity, schema_drift, shapes, tracer
 from .findings import (
     Finding,
     Severity,
@@ -41,8 +48,21 @@ PASS_TARGETS = {
         "karpenter_tpu/solver/driver.py",
         "karpenter_tpu/metrics/registry.py",
     ],
-    "blocking": ["karpenter_tpu/controllers", "karpenter_tpu/__main__.py"],
+    "blocking": [
+        "karpenter_tpu/controllers",
+        "karpenter_tpu/__main__.py",
+        # the sidecar's solve path and the leader-election loop are
+        # reconcile-shaped too: both run behind level-triggered steps and
+        # must stay on the injectable clock
+        "karpenter_tpu/solver/service.py",
+        "karpenter_tpu/kube/leader.py",
+    ],
     "schema": ["karpenter_tpu/api/schema.py", "karpenter_tpu/api/crds"],
+    "parity": [
+        "karpenter_tpu/ops/packing.py",
+        "karpenter_tpu/native/solve_core.cc",
+    ],
+    "shapes": ["karpenter_tpu/ops", "karpenter_tpu/solver"],
 }
 
 
@@ -59,14 +79,80 @@ def _run_pass(name: str, targets: List[str]):
             os.path.dirname(targets[0]), "crds"
         )
         return schema_drift.check_schema(schema_py, crd_dir)
+    if name == "parity":
+        py_path = targets[0]
+        cc_path = targets[1] if len(targets) > 1 else os.path.join(
+            os.path.dirname(os.path.dirname(py_path)),
+            "native", "solve_core.cc",
+        )
+        return parity.check_parity(py_path, cc_path)
+    if name == "shapes":
+        return shapes.check_paths(targets)
     raise ValueError(f"unknown pass {name!r}")
+
+
+def _sarif(findings: List[Finding]) -> dict:
+    """Minimal SARIF 2.1.0 document for the given (unsuppressed) findings."""
+    rules_meta = all_rules()
+    used = sorted({f.rule for f in findings})
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        # informationUri omitted: SARIF 2.1.0 requires an
+                        # absolute URI and this tool has no canonical URL
+                        "name": "karpenter-tpu-analysis",
+                        "rules": [
+                            {
+                                "id": rule,
+                                "shortDescription": {
+                                    "text": rules_meta.get(rule, rule)
+                                },
+                            }
+                            for rule in used
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": (
+                            "error" if f.severity == Severity.ERROR
+                            else "warning"
+                        ),
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": max(f.line, 1)
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in sorted(
+                        findings, key=lambda f: (f.path, f.line, f.rule)
+                    )
+                ],
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m karpenter_tpu.analysis",
         description="AST static analysis: tracer-safety, lock ordering, "
-        "blocking calls, schema drift",
+        "blocking calls, schema drift, kernel-twin parity, axis/dtype "
+        "shape discipline",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -94,6 +180,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--write-baseline", action="store_true",
         help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "sarif"), default="text",
+        help="finding output format (sarif: SARIF 2.1.0 JSON on stdout)",
     )
     args = parser.parse_args(argv)
 
@@ -149,8 +239,12 @@ def main(argv=None) -> int:
         )
         return 0
 
-    for f in sorted(remaining, key=lambda f: (f.path, f.line, f.rule)):
-        print(f.render())
+    if args.format == "sarif":
+        json.dump(_sarif(remaining), sys.stdout, indent=2)
+        print()
+    else:
+        for f in sorted(remaining, key=lambda f: (f.path, f.line, f.rule)):
+            print(f.render())
     suppressed = len(all_findings) - len(remaining)
     errors = [f for f in remaining if f.severity == Severity.ERROR]
     summary = f"analysis: {len(remaining)} finding(s)"

@@ -36,7 +36,9 @@ class Finding:
         return (self.rule, self.path, self.message)
 
 
-_IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore\[([A-Z0-9,\s]+)\]")
+# both comment dialects: `# analysis: ignore[...]` (Python) and
+# `// analysis: ignore[...]` (the C++ kernel twin scanned by parity.py)
+_IGNORE_RE = re.compile(r"(?:#|//)\s*analysis:\s*ignore\[([A-Z0-9,\s]+)\]")
 
 
 def inline_suppressions(source_lines: Sequence[str]) -> dict:

@@ -36,6 +36,13 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 from .astutil import dotted_name, import_aliases, iter_py_files, parse_file
 from .findings import Finding, Severity, SourceFile
 
+RULES = {
+    "LCK200": "unparsable file (locks pass)",
+    "LCK201": "cycle in the lock acquisition-order graph (ABBA deadlock)",
+    "LCK202": "watcher/callback invoked while a lock is held",
+    "LCK203": "non-reentrant Lock re-acquired while already held",
+}
+
 _CALLBACK_COLLECTION_HINTS = ("watcher", "handler", "callback", "listener")
 _CALLBACK_PARAM_NAMES = {"fn", "func", "callback", "handler", "cb"}
 _MAX_DEPTH = 8

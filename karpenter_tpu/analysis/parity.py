@@ -1,0 +1,540 @@
+"""Pass 5: cross-implementation parity drift between the kernel twins.
+
+The packing program exists three times — ``ops/packing.py::pack``,
+``pack_classed``, and the C++ core ``native/solve_core.cc`` — and the three
+must stay bit-exact (tests/test_classed_kernel.py, tests/test_native.py
+assert it dynamically, but only for the shapes the fixtures cover). This
+pass makes the *structural* agreement a presubmit property: it builds a
+"semantic skeleton" from each twin and reports any divergence, so a
+cost-model tweak that lands in two of the three twins fails before the
+parity suites (or the TPU-only path the fallback grid skips) notice.
+
+A skeleton has five components:
+
+- **phases**: the ordered tier sequence (existing-nodes -> open-claims ->
+  fresh-claims), declared with anchor comments in every twin;
+- **consts**: the significant shared numeric constants (sentinels like
+  ``2**28``/``2**30``, epsilons like ``1e-9``, the proportional-spread
+  offset ``0.5``) — derived from the AST on the Python side (literals plus
+  module-level constant names like ``_BIGI``, resolved transitively through
+  same-module helpers such as ``spread_domain_choice``);
+- **dtypes**: the element-type vocabulary (float32/int32/bool);
+- **tiebreaks**: the order-sensitive reduction disciplines in use
+  (argmin/argmax/searchsorted/cumsum — each encodes a tie-break rule the
+  reference's sequential walk implies);
+- **state_fields**: the carried-state inventory, pinned to the
+  ``PackState`` NamedTuple declaration.
+
+Python skeletons are extracted from parse trees (astutil). The C++ core has
+no parser here, so it *declares* its skeleton with anchor comments::
+
+    // parity: phase existing-nodes
+    // parity: const 2**28
+    // parity: dtype float32
+    // parity: tiebreak argmin
+    // parity: state c_used, c_npods
+
+Rules:
+
+- PAR500: extraction failure (unparsable file, kernel/state class missing,
+  a twin with no anchors at all)
+- PAR501: phase-sequence drift between twins
+- PAR502: shared-constant drift (present in one twin, absent in another)
+- PAR503: dtype-literal drift
+- PAR504: tie-break discipline drift
+- PAR505: state-field inventory drift (a twin missing a declared field, or
+  an anchor naming a field with no Python twin — stale after a rename)
+- PAR506: malformed or unknown ``parity:`` anchor
+
+Suppress with ``# analysis: ignore[PAR50x] reason`` (Python) or
+``// analysis: ignore[PAR50x] reason`` (C++) on or above the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .astutil import call_name, import_aliases, parse_file
+from .findings import Finding, Severity, SourceFile
+
+RULES = {
+    "PAR500": "parity skeleton extraction failure",
+    "PAR501": "phase-sequence drift between kernel twins",
+    "PAR502": "shared-constant drift between kernel twins",
+    "PAR503": "dtype-literal drift between kernel twins",
+    "PAR504": "tie-break discipline drift between kernel twins",
+    "PAR505": "state-field inventory drift between kernel twins",
+    "PAR506": "malformed or unknown parity anchor",
+}
+
+# ints below this magnitude are structural (axis numbers, small offsets),
+# not shared semantic constants; non-integral floats always count
+_SIG_INT_MIN = 1024
+
+_TIEBREAK_OPS = ("argmin", "argmax", "searchsorted", "cumsum")
+_DTYPE_NAMES = {
+    "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64", "uint8", "bool_",
+}
+_DTYPE_BUILTINS = {"bool", "int", "float"}
+
+_ANCHOR_RE = re.compile(r"(?:#|//)\s*parity:\s*(.*?)\s*$")
+_SLUG_RE = re.compile(r"^[a-z0-9][a-z0-9_-]*$")
+
+
+@dataclass
+class Skeleton:
+    """One twin's semantic skeleton. Element maps carry the line each
+    element was first seen at, for finding locations."""
+
+    name: str
+    path: str
+    line: int = 0  # kernel def line (python) / first anchor line (C++)
+    phases: List[Tuple[str, int]] = field(default_factory=list)
+    consts: Dict[str, int] = field(default_factory=dict)  # canon value -> line
+    dtypes: Dict[str, int] = field(default_factory=dict)
+    tiebreaks: Dict[str, int] = field(default_factory=dict)
+    state_fields: Dict[str, int] = field(default_factory=dict)
+
+    def phase_slugs(self) -> List[str]:
+        return [slug for slug, _ in self.phases]
+
+
+def _canon_const(value) -> Optional[str]:
+    """Canonical comparison key for a numeric constant, or None when the
+    value is insignificant (small structural int) or non-finite."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    if isinstance(value, float):
+        if value != value or value in (float("inf"), float("-inf")):
+            return None
+        if value == int(value):  # integral float: same significance rule
+            value = int(value)
+        else:
+            return repr(value)
+    if abs(value) < _SIG_INT_MIN:
+        return None
+    return repr(value)
+
+
+def _eval_const_expr(node: ast.AST, table: Dict[str, object]):
+    """Restricted constant-expression evaluator: literals, +,-,*,**,//, /,
+    unary minus, and names resolved through ``table``. Raises ValueError
+    on anything else; arithmetic on admissible operands may still raise
+    ArithmeticError (``1/0``, ``10.0**400``) — callers catch both."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        if isinstance(node.value, bool):
+            raise ValueError("bool")
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in table:
+            return table[node.id]
+        raise ValueError(f"unknown name {node.id!r}")
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_eval_const_expr(node.operand, table)
+    if isinstance(node, ast.BinOp):
+        left = _eval_const_expr(node.left, table)
+        right = _eval_const_expr(node.right, table)
+        if isinstance(node.op, ast.Pow):
+            # bound the exponent: `2**2**30` must not hang the analyzer
+            if not isinstance(right, (int, float)) or abs(right) > 256:
+                raise ValueError("exponent out of range")
+            return left ** right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.FloorDiv):
+            return left // right
+        if isinstance(node.op, ast.Div):
+            return left / right
+    raise ValueError(ast.dump(node))
+
+
+def _module_const_table(tree: ast.Module) -> Dict[str, object]:
+    """{name: value} for top-level ``NAME = <const expr>`` assigns."""
+    table: Dict[str, object] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        try:
+            table[target.id] = _eval_const_expr(node.value, table)
+        except (ValueError, ArithmeticError):
+            continue
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Python-side extraction
+# ---------------------------------------------------------------------------
+
+
+def _transitive_helpers(
+    kernel: ast.FunctionDef, functions: Dict[str, ast.FunctionDef]
+) -> List[ast.FunctionDef]:
+    """The kernel plus every same-module function it (transitively)
+    references — shared helpers like spread_domain_choice contribute their
+    constants/ops to every caller's skeleton."""
+    seen = {kernel.name}
+    order = [kernel]
+    frontier = [kernel]
+    while frontier:
+        fn = frontier.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in functions and node.id not in seen:
+                    seen.add(node.id)
+                    order.append(functions[node.id])
+                    frontier.append(functions[node.id])
+    return order
+
+
+def _collect_phase_anchors(
+    src: SourceFile, start: int, end: int
+) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for i in range(start, min(end, len(src.lines)) + 1):
+        m = _ANCHOR_RE.search(src.lines[i - 1])
+        if m and m.group(1).startswith("phase"):
+            parts = m.group(1).split(None, 1)
+            if len(parts) == 2:
+                out.append((parts[1].strip(), i))
+    return out
+
+
+def _state_class_fields(tree: ast.Module, state_class: str) -> Dict[str, int]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == state_class:
+            fields: Dict[str, int] = {}
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                    item.target, ast.Name
+                ):
+                    fields[item.target.id] = item.lineno
+            return fields
+    return {}
+
+
+def _extract_python_skeleton(
+    name: str,
+    path: str,
+    src: SourceFile,
+    tree: ast.Module,
+    kernel: ast.FunctionDef,
+    functions: Dict[str, ast.FunctionDef],
+    declared_fields: Dict[str, int],
+    aliases: Dict[str, str],
+    const_table: Dict[str, object],
+) -> Skeleton:
+    sk = Skeleton(name=name, path=path, line=kernel.lineno)
+    end = getattr(kernel, "end_lineno", kernel.lineno) or kernel.lineno
+    sk.phases = _collect_phase_anchors(src, kernel.lineno, end)
+
+    for fn in _transitive_helpers(kernel, functions):
+        for node in ast.walk(fn):
+            # consts: literals (incl. 2**30-style expressions) and
+            # module-constant names
+            if isinstance(node, (ast.Constant, ast.BinOp)):
+                try:
+                    value = _eval_const_expr(node, const_table)
+                except (ValueError, ArithmeticError):
+                    value = None
+                key = _canon_const(value) if value is not None else None
+                if key is not None:
+                    sk.consts.setdefault(key, node.lineno)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in const_table:
+                    key = _canon_const(const_table[node.id])
+                    if key is not None:
+                        sk.consts.setdefault(key, node.lineno)
+            # dtypes: jnp.float32 / dtype=bool style references
+            if isinstance(node, ast.Attribute) and node.attr in _DTYPE_NAMES:
+                sk.dtypes.setdefault(node.attr.rstrip("_"), node.lineno)
+            if isinstance(node, ast.Call):
+                cname = call_name(node, aliases)
+                tail = cname.rpartition(".")[2]
+                if tail in _TIEBREAK_OPS and (
+                    cname.startswith("jax.") or "." not in cname
+                ):
+                    sk.tiebreaks.setdefault(tail, node.lineno)
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Name)
+                        and kw.value.id in _DTYPE_BUILTINS
+                    ):
+                        sk.dtypes.setdefault(kw.value.id, node.lineno)
+                # bare bool/float/int in a constructor's dtype slot
+                if tail in ("zeros", "ones", "empty", "full", "arange"):
+                    slot = 2 if tail == "full" else 1
+                    if len(node.args) > slot:
+                        arg = node.args[slot]
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in _DTYPE_BUILTINS
+                        ):
+                            sk.dtypes.setdefault(arg.id, node.lineno)
+            # state fields: attribute loads + constructor/_replace kwargs
+            if isinstance(node, ast.Attribute) and node.attr in declared_fields:
+                sk.state_fields.setdefault(node.attr, node.lineno)
+            if isinstance(node, ast.Call):
+                fname = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else getattr(node.func, "id", "")
+                )
+                if fname == "_replace" or fname in ("PackState",):
+                    for kw in node.keywords:
+                        if kw.arg in declared_fields:
+                            sk.state_fields.setdefault(kw.arg, node.lineno)
+    return sk
+
+
+# ---------------------------------------------------------------------------
+# C++-side extraction (anchor lexer)
+# ---------------------------------------------------------------------------
+
+
+def extract_cc_skeleton(
+    path: str, text: Optional[str] = None
+) -> Tuple[Skeleton, List[Finding], SourceFile]:
+    """Lex ``// parity:`` anchors out of a C++ source. Malformed anchors
+    become PAR506 findings, never crashes."""
+    if text is None:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    src = SourceFile(path=path, text=text)
+    sk = Skeleton(name="native", path=path)
+    findings: List[Finding] = []
+
+    def malformed(lineno: int, why: str) -> None:
+        findings.append(
+            Finding(
+                "PAR506", Severity.ERROR, path, lineno,
+                f"malformed parity anchor ({why}); expected "
+                "'// parity: phase|const|dtype|tiebreak|state <arg>'",
+            )
+        )
+
+    for i, line in enumerate(src.lines, start=1):
+        m = _ANCHOR_RE.search(line)
+        if not m:
+            continue
+        if sk.line == 0:
+            sk.line = i
+        body = m.group(1)
+        parts = body.split(None, 1)
+        if not parts:
+            malformed(i, "empty anchor")
+            continue
+        kind = parts[0]
+        arg = parts[1].strip() if len(parts) > 1 else ""
+        if not arg:
+            malformed(i, f"'{kind}' anchor has no argument")
+            continue
+        if kind == "phase":
+            if not _SLUG_RE.match(arg):
+                malformed(i, f"phase slug {arg!r} is not a slug")
+                continue
+            sk.phases.append((arg, i))
+        elif kind == "const":
+            try:
+                # optional "name =" prefix: `const kBigDom = 2**28`
+                expr = arg.rpartition("=")[2].strip() if "=" in arg else arg
+                value = _eval_const_expr(ast.parse(expr, mode="eval").body, {})
+            except (ValueError, SyntaxError, ArithmeticError):
+                # ZeroDivisionError/OverflowError from `1/0`, `10.0**400`
+                malformed(i, f"unevaluable const expression {arg!r}")
+                continue
+            key = _canon_const(value)
+            if key is None:
+                malformed(i, f"const {arg!r} is not a significant constant")
+                continue
+            sk.consts.setdefault(key, i)
+        elif kind == "dtype":
+            sk.dtypes.setdefault(arg.rstrip("_"), i)
+        elif kind == "tiebreak":
+            if not _SLUG_RE.match(arg):
+                malformed(i, f"tiebreak slug {arg!r} is not a slug")
+                continue
+            sk.tiebreaks.setdefault(arg, i)
+        elif kind == "state":
+            for fld in (f.strip() for f in arg.split(",")):
+                if not fld:
+                    continue
+                if not _SLUG_RE.match(fld):
+                    malformed(i, f"state field {fld!r} is not an identifier")
+                    continue
+                sk.state_fields.setdefault(fld, i)
+        else:
+            malformed(i, f"unknown anchor kind {kind!r}")
+    return sk, findings, src
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+
+def _compare(
+    ref: Skeleton, other: Skeleton, findings: List[Finding]
+) -> None:
+    if ref.phase_slugs() != other.phase_slugs():
+        line = other.phases[0][1] if other.phases else other.line
+        findings.append(
+            Finding(
+                "PAR501", Severity.ERROR, other.path, line,
+                f"phase sequence drift: {ref.name}="
+                f"{ref.phase_slugs()} vs {other.name}={other.phase_slugs()}",
+            )
+        )
+    for label, rule in (
+        ("consts", "PAR502"), ("dtypes", "PAR503"), ("tiebreaks", "PAR504")
+    ):
+        ref_map: Dict[str, int] = getattr(ref, label)
+        other_map: Dict[str, int] = getattr(other, label)
+        noun = label.rstrip("s").replace("const", "constant")
+        for key in sorted(set(ref_map) - set(other_map)):
+            findings.append(
+                Finding(
+                    rule, Severity.ERROR, other.path, other.line,
+                    f"{noun} {key} present in {ref.name} but absent from "
+                    f"{other.name} — a change may have landed in only one "
+                    "twin",
+                )
+            )
+        for key in sorted(set(other_map) - set(ref_map)):
+            findings.append(
+                Finding(
+                    rule, Severity.ERROR, other.path, other_map[key],
+                    f"{noun} {key} in {other.name} has no twin in "
+                    f"{ref.name}",
+                )
+            )
+
+
+def _check_state_fields(
+    sk: Skeleton, declared: Dict[str, int], declared_path: str,
+    findings: List[Finding],
+) -> None:
+    for fld in sorted(set(declared) - set(sk.state_fields)):
+        findings.append(
+            Finding(
+                "PAR505", Severity.ERROR, sk.path, sk.line,
+                f"state field '{fld}' declared by PackState is never "
+                f"carried by {sk.name}",
+            )
+        )
+    for fld in sorted(set(sk.state_fields) - set(declared)):
+        findings.append(
+            Finding(
+                "PAR505", Severity.ERROR, sk.path, sk.state_fields[fld],
+                f"state field '{fld}' in {sk.name} has no PackState twin "
+                f"in {declared_path} (stale after a rename?)",
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def check_parity(
+    py_path: str,
+    cc_path: str,
+    kernels: Sequence[str] = ("pack", "pack_classed"),
+    state_class: str = "PackState",
+) -> Tuple[List[Finding], Dict[str, SourceFile]]:
+    """Extract one skeleton per twin and report every divergence. The first
+    kernel name is the reference twin the others are compared against."""
+    findings: List[Finding] = []
+    sources: Dict[str, SourceFile] = {}
+
+    try:
+        src, tree = parse_file(py_path)
+    except (OSError, SyntaxError) as exc:
+        return (
+            [Finding("PAR500", Severity.ERROR, py_path, 0, f"unparsable: {exc}")],
+            sources,
+        )
+    sources[py_path] = src
+    aliases = import_aliases(tree)
+    const_table = _module_const_table(tree)
+    functions = {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+    }
+    declared = _state_class_fields(tree, state_class)
+    if not declared:
+        findings.append(
+            Finding(
+                "PAR500", Severity.ERROR, py_path, 0,
+                f"state class {state_class!r} not found — cannot build the "
+                "state-field inventory",
+            )
+        )
+
+    skeletons: List[Skeleton] = []
+    for kname in kernels:
+        fn = functions.get(kname)
+        if fn is None:
+            findings.append(
+                Finding(
+                    "PAR500", Severity.ERROR, py_path, 0,
+                    f"kernel {kname!r} not found in {py_path}",
+                )
+            )
+            continue
+        sk = _extract_python_skeleton(
+            kname, py_path, src, tree, fn, functions, declared, aliases,
+            const_table,
+        )
+        if not sk.phases:
+            findings.append(
+                Finding(
+                    "PAR500", Severity.ERROR, py_path, fn.lineno,
+                    f"kernel {kname!r} declares no '# parity: phase' "
+                    "anchors — the phase sequence cannot be compared",
+                )
+            )
+        skeletons.append(sk)
+
+    cc_sk = None
+    try:
+        cc_sk, cc_findings, cc_src = extract_cc_skeleton(cc_path)
+        sources[cc_path] = cc_src
+        findings.extend(cc_findings)
+        if cc_sk.line == 0:
+            findings.append(
+                Finding(
+                    "PAR500", Severity.ERROR, cc_path, 0,
+                    "no '// parity:' anchors found — the native twin "
+                    "declares no skeleton",
+                )
+            )
+            cc_sk = None
+    except OSError as exc:
+        findings.append(
+            Finding("PAR500", Severity.ERROR, cc_path, 0, f"unreadable: {exc}")
+        )
+
+    if skeletons:
+        ref = skeletons[0]
+        for other in skeletons[1:]:
+            _compare(ref, other, findings)
+        if cc_sk is not None:
+            _compare(ref, cc_sk, findings)
+    if declared:
+        for sk in skeletons:
+            _check_state_fields(sk, declared, py_path, findings)
+        if cc_sk is not None:
+            _check_state_fields(cc_sk, declared, py_path, findings)
+    return findings, sources

@@ -30,6 +30,14 @@ from typing import Any, Dict, List, Optional, Tuple
 from .astutil import parse_file
 from .findings import Finding, Severity, SourceFile
 
+RULES = {
+    "SCH400": "unparsable schema module (schema pass)",
+    "SCH401": "key present in schema.py but missing from the YAML artifact",
+    "SCH402": "key present in the YAML artifact but not in schema.py",
+    "SCH403": "literal value mismatch (enums, required lists, scalars)",
+    "SCH404": "artifact missing/unparsable, or PyYAML unavailable",
+}
+
 WILDCARD = object()
 
 # artifact filename -> schema-building function in the module

@@ -1,0 +1,763 @@
+"""Pass 6: axis/dtype abstract interpretation over the kernels (ops/, solver/).
+
+The kernels are index arithmetic over named axes — S (scenarios), G
+(groups), N (nodes), R (resources), T (types), K (requirement keys), V1
+(interned values), nmax (claim slots) — but JAX arrays carry none of those
+names: a ``[N, R] * [R, N]`` join broadcasts happily and miscomputes
+silently. This pass walks every function with a tiny abstract interpreter:
+
+- **bindings** get an abstract value (axes, dtype) at constructor sites —
+  ``jnp.zeros((nmax, R), jnp.float32)`` binds axes ``(nmax, R)`` and dtype
+  ``float32``, with axis identity taken from the local *names* used in the
+  shape tuple;
+- **propagation** runs through elementwise ``jnp`` calls and operators
+  (broadcast joins, aligned from the right), indexing (``[:, None]``,
+  integer drops, 1-D gathers), reductions with ``axis=``, ``reshape``/
+  ``.T``/``astype``, ``one_hot``, and ``einsum`` specs (each spec letter
+  must bind one axis name); ``vmap``/``scan`` wrappers and anything else
+  degrade to *unknown*, never to a guess;
+- **checks** fire only when both sides of a fact are known, so unknown
+  values can never false-positive.
+
+Rules:
+
+- SHP600: unparsable file
+- SHP601: axis-order mismatch — a broadcast join aligns two *different*
+  named axes (or an einsum letter binds two different axes)
+- SHP602: silent 64-bit widening — an explicit float64/int64 dtype in
+  device code (f32→f64 promotion is a TPU hazard; x64 is off everywhere)
+- SHP603: a literal dimension that bypasses the power-of-two bucket
+  ladder (compile-cache buster; see PARITY.md §2.3 on bucketing)
+
+Host-side numpy is out of scope on purpose: only ``jax``/``jax.numpy``
+origins construct tracked values, so encode-time ``np.int64`` index math
+stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .astutil import call_name, import_aliases, iter_py_files, parse_file
+from .findings import Finding, Severity, SourceFile
+
+RULES = {
+    "SHP600": "unparsable file (shape pass)",
+    "SHP601": "axis-order mismatch in a broadcast join",
+    "SHP602": "silent 64-bit dtype widening in device code",
+    "SHP603": "literal dimension bypasses the power-of-two bucket ladder",
+}
+
+# axes: tuple of str (named axis) | int (literal dim) | None (unknown dim);
+# axes itself None = unknown rank. dtype: canonical string or None.
+Axes = Optional[Tuple[object, ...]]
+
+
+@dataclass(frozen=True)
+class AV:
+    axes: Axes = None
+    dtype: Optional[str] = None
+
+
+UNKNOWN = AV()
+SCALAR = AV(axes=())
+
+_CONSTRUCTORS = {"zeros", "ones", "empty", "full", "arange"}
+_LIKE_CONSTRUCTORS = {"zeros_like", "ones_like", "full_like", "empty_like"}
+_ELEMENTWISE = {
+    "where", "maximum", "minimum", "clip", "add", "subtract", "multiply",
+    "divide", "floor_divide", "mod", "power", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "abs", "sign", "floor", "ceil", "round",
+    "exp", "log", "sqrt", "isinf", "isnan", "equal", "not_equal", "greater",
+    "greater_equal", "less", "less_equal",
+}
+_SHAPE_PRESERVING = {"cumsum", "cumprod", "flip", "sort", "negative", "copy"}
+_REDUCTIONS = {
+    "sum", "min", "max", "mean", "prod", "any", "all", "argmin", "argmax",
+    "count_nonzero", "nanmin", "nanmax",
+}
+_DTYPE_64 = {"float64", "int64", "uint64", "complex128"}
+_DTYPE_NAMES = {
+    "float16", "bfloat16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint32", "uint64", "bool_", "complex64", "complex128",
+}
+_WIDTH_PAIRS = {("float32", "float64"), ("int32", "int64")}
+
+
+def _is_pow2(v: int) -> bool:
+    return v >= 0 and (v & (v - 1)) == 0  # 0 and 1 count as bucketed
+
+
+class _Env:
+    def __init__(self, parent: Optional["_Env"] = None):
+        self.parent = parent
+        self.vals: Dict[str, AV] = {}
+
+    def get(self, name: str) -> AV:
+        env: Optional[_Env] = self
+        while env is not None:
+            if name in env.vals:
+                return env.vals[name]
+            env = env.parent
+        return UNKNOWN
+
+    def set(self, name: str, av: AV) -> None:
+        self.vals[name] = av
+
+
+def _join_axes(a: Axes, b: Axes) -> Tuple[Axes, Optional[Tuple[object, object]]]:
+    """Right-aligned broadcast join. Returns (joined, conflict) where
+    conflict is the first (dim_a, dim_b) pair of *known, unequal, non-1*
+    dims, or None. An unknown-rank operand poisons the join to unknown:
+    keeping the known side would manufacture facts about values the
+    interpreter lost track of (the false-positive mode this pass must
+    never have)."""
+    if a is None or b is None:
+        return None, None
+    out: List[object] = []
+    conflict = None
+    la, lb = len(a), len(b)
+    for i in range(1, max(la, lb) + 1):
+        da = a[-i] if i <= la else 1
+        db = b[-i] if i <= lb else 1
+        if da == 1:
+            out.append(db)
+        elif db == 1:
+            out.append(da)
+        elif da is None:
+            out.append(db)
+        elif db is None:
+            out.append(da)
+        elif da == db:
+            out.append(da)
+        else:
+            both_named = isinstance(da, str) and isinstance(db, str)
+            both_lits = isinstance(da, int) and isinstance(db, int)
+            if (both_named or both_lits) and conflict is None:
+                conflict = (da, db)
+            out.append(None)
+    return tuple(reversed(out)), conflict
+
+
+def _assigned_names(stmt: ast.AST) -> set:
+    """Names the statement may bind, without descending into nested
+    scopes (defs/lambdas/classes bind in their own frame). Deliberately
+    over-approximate — degrading an extra name to unknown is sound."""
+    out = set()
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _matmul_axes(
+    a: Axes, b: Axes
+) -> Tuple[Axes, Optional[Tuple[object, object]]]:
+    """``a @ b`` contraction: a's last axis against b's second-to-last
+    (or only, for 1-D b). Returns (result_axes, conflict) — conflict is
+    the contracted pair when both dims are known and unequal. Batched
+    (rank>2 both sides) results degrade to unknown rather than modelling
+    the batch-dim broadcast."""
+    if a is None or b is None or len(a) == 0 or len(b) == 0:
+        return None, None
+    ca = a[-1]
+    cb = b[-2] if len(b) >= 2 else b[-1]
+    conflict = None
+    both_named = isinstance(ca, str) and isinstance(cb, str)
+    both_lits = isinstance(ca, int) and isinstance(cb, int)
+    if (both_named or both_lits) and ca != cb:
+        conflict = (ca, cb)
+    if len(b) == 1:
+        return a[:-1], conflict
+    if len(a) == 1:
+        return b[:-2] + (b[-1],), conflict
+    if len(a) == 2 and len(b) == 2:
+        return (a[0], b[-1]), conflict
+    return None, conflict
+
+
+def _join_dtype(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    if a is None or b is None or a == b:
+        return a if a == b else None
+    if (a, b) in _WIDTH_PAIRS or (b, a) in _WIDTH_PAIRS:
+        return a if a in _DTYPE_64 else b
+    return None
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    def __init__(
+        self,
+        path: str,
+        aliases: Dict[str, str],
+        findings: List[Finding],
+        env: _Env,
+    ):
+        self.path = path
+        self.aliases = aliases
+        self.findings = findings
+        self.env = env
+        self._flagged: set = set()
+
+    # -- reporting --------------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if (line, rule) in self._flagged:
+            return
+        self._flagged.add((line, rule))
+        self.findings.append(
+            Finding(rule, Severity.ERROR, self.path, line, message)
+        )
+
+    # -- name resolution --------------------------------------------------
+
+    def _origin(self, cname: str) -> str:
+        return cname.partition(".")[0]
+
+    def _is_jnp(self, cname: str) -> bool:
+        return cname.startswith("jax.numpy.") or cname.startswith("jax.")
+
+    def _dtype_of_node(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and node.attr in _DTYPE_NAMES:
+            return node.attr.rstrip("_") if node.attr != "bool_" else "bool"
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value in _DTYPE_NAMES:
+                return node.value
+        if isinstance(node, ast.Name) and node.id == "bool":
+            return "bool"
+        return None
+
+    def _axis_of_dim(self, node: ast.AST) -> object:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        return None
+
+    # -- abstract evaluation ----------------------------------------------
+
+    def avof(self, node: ast.AST) -> AV:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (int, float, bool)):
+                return SCALAR
+            return UNKNOWN
+        if isinstance(node, ast.BinOp):
+            a, b = self.avof(node.left), self.avof(node.right)
+            if isinstance(node.op, ast.MatMult):
+                axes, _ = _matmul_axes(a.axes, b.axes)
+            else:
+                axes, _ = _join_axes(a.axes, b.axes)
+            return AV(axes, _join_dtype(a.dtype, b.dtype))
+        if isinstance(node, ast.UnaryOp):
+            return self.avof(node.operand)
+        if isinstance(node, ast.Compare):
+            avs = [self.avof(node.left)] + [self.avof(c) for c in node.comparators]
+            axes = avs[0].axes
+            for av in avs[1:]:
+                axes, _ = _join_axes(axes, av.axes)
+            return AV(axes, "bool")
+        if isinstance(node, ast.BoolOp):
+            return UNKNOWN
+        if isinstance(node, ast.IfExp):
+            a, b = self.avof(node.body), self.avof(node.orelse)
+            return a if a == b else UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._call_av(node)
+        if isinstance(node, ast.Attribute):
+            base = self.avof(node.value)
+            if node.attr == "T" and base.axes is not None:
+                return AV(tuple(reversed(base.axes)), base.dtype)
+            if node.attr in ("shape", "ndim", "size", "dtype"):
+                return SCALAR
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return self._subscript_av(node)
+        return UNKNOWN
+
+    def _shape_axes(self, node: ast.AST) -> Axes:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self._axis_of_dim(e) for e in node.elts)
+        dim = self._axis_of_dim(node)
+        return (dim,) if dim is not None else None
+
+    def _call_av(self, node: ast.Call) -> AV:
+        cname = call_name(node, self.aliases)
+        tail = cname.rpartition(".")[2]
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if not self._is_jnp(cname):
+            if isinstance(node.func, ast.Attribute):
+                return self._method_av(node)
+            return UNKNOWN
+        if tail in _CONSTRUCTORS:
+            dtype_node = kw.get("dtype")
+            if dtype_node is None:
+                slot = 2 if tail == "full" else 1
+                if tail != "arange" and len(node.args) > slot:
+                    dtype_node = node.args[slot]
+            dtype = self._dtype_of_node(dtype_node) if dtype_node is not None else None
+            if tail == "arange":
+                if len(node.args) == 1:
+                    dim = self._axis_of_dim(node.args[0])
+                    return AV((dim,), dtype or "int32")
+                return AV(None, dtype or "int32")
+            if node.args:
+                return AV(self._shape_axes(node.args[0]), dtype)
+            return AV(None, dtype)
+        if tail in _LIKE_CONSTRUCTORS and node.args:
+            base = self.avof(node.args[0])
+            dtype = (
+                self._dtype_of_node(kw["dtype"]) if "dtype" in kw else base.dtype
+            )
+            return AV(base.axes, dtype)
+        if tail in ("asarray", "array"):
+            return AV(None, self._dtype_of_node(kw.get("dtype")) if "dtype" in kw
+                      else (self._dtype_of_node(node.args[1])
+                            if len(node.args) > 1 else None))
+        if tail == "one_hot":
+            base = self.avof(node.args[0]) if node.args else UNKNOWN
+            dim = self._axis_of_dim(node.args[1]) if len(node.args) > 1 else None
+            dtype = self._dtype_of_node(kw.get("dtype")) if "dtype" in kw else None
+            if base.axes is not None:
+                return AV(base.axes + (dim,), dtype)
+            return AV(None, dtype)
+        if tail in _DTYPE_NAMES:  # jnp.int32(x)-style cast
+            base = self.avof(node.args[0]) if node.args else SCALAR
+            return AV(base.axes, tail.rstrip("_") if tail != "bool_" else "bool")
+        if tail in _ELEMENTWISE:
+            axes: Axes = ()
+            dtype: Optional[str] = None
+            first = True
+            for arg in node.args:
+                av = self.avof(arg)
+                axes, _ = _join_axes(axes, av.axes)
+                dtype = av.dtype if first else _join_dtype(dtype, av.dtype)
+                first = False
+            if tail in ("isinf", "isnan", "logical_and", "logical_or",
+                        "logical_not", "logical_xor"):
+                dtype = "bool"
+            return AV(axes, dtype)
+        if tail in _SHAPE_PRESERVING and node.args:
+            return self.avof(node.args[0])
+        if tail in _REDUCTIONS and node.args:
+            base = self.avof(node.args[0])
+            dtype = (
+                "int32" if tail in ("argmin", "argmax", "count_nonzero")
+                else ("bool" if tail in ("any", "all") else base.dtype)
+            )
+            if "keepdims" in kw:
+                return AV(None, dtype)
+            axis_node = kw.get("axis")
+            if axis_node is None and len(node.args) > 1:
+                axis_node = node.args[1]
+            if axis_node is None:
+                return AV((), dtype)
+            if base.axes is None:
+                return AV(None, dtype)
+            drops: List[int] = []
+            cands = (
+                axis_node.elts
+                if isinstance(axis_node, (ast.Tuple, ast.List))
+                else [axis_node]
+            )
+            for c in cands:
+                v = None
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    v = c.value
+                elif (
+                    isinstance(c, ast.UnaryOp)
+                    and isinstance(c.op, ast.USub)
+                    and isinstance(c.operand, ast.Constant)
+                ):
+                    v = -c.operand.value
+                if v is None:
+                    return AV(None, dtype)
+                drops.append(v % len(base.axes) if base.axes else v)
+            kept = tuple(
+                d for i, d in enumerate(base.axes) if i not in set(drops)
+            )
+            return AV(kept, dtype)
+        if tail == "einsum":
+            return self._einsum_av(node)
+        return UNKNOWN
+
+    def _method_av(self, node: ast.Call) -> AV:
+        attr = node.func.attr  # type: ignore[union-attr]
+        base = self.avof(node.func.value)  # type: ignore[union-attr]
+        if attr == "astype" and node.args:
+            dtype = self._dtype_of_node(node.args[0])
+            return AV(base.axes, dtype or None)
+        if attr == "reshape":
+            args = node.args
+            if len(args) == 1 and isinstance(args[0], (ast.Tuple, ast.List)):
+                return AV(self._shape_axes(args[0]), base.dtype)
+            dims = tuple(self._axis_of_dim(a) for a in args)
+            return AV(dims if dims else None, base.dtype)
+        if attr == "sum" and base.axes is not None:
+            return AV((), base.dtype)
+        return UNKNOWN
+
+    def _einsum_av(self, node: ast.Call) -> AV:
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            return UNKNOWN
+        spec = node.args[0].value
+        if not isinstance(spec, str) or "..." in spec or "->" not in spec:
+            return UNKNOWN
+        ins, _, out = spec.partition("->")
+        in_specs = [s.strip() for s in ins.split(",")]
+        operands = node.args[1:]
+        letter_axis: Dict[str, str] = {}
+        for op_spec, operand in zip(in_specs, operands):
+            av = self.avof(operand)
+            if av.axes is None or len(av.axes) != len(op_spec):
+                continue
+            for letter, dim in zip(op_spec, av.axes):
+                if not isinstance(dim, str):
+                    continue
+                prior = letter_axis.get(letter)
+                if prior is not None and prior != dim:
+                    self._flag(
+                        "SHP601", node,
+                        f"einsum {spec!r} binds letter '{letter}' to axis "
+                        f"'{prior}' and axis '{dim}' — operand axes are "
+                        "transposed or the spec is stale",
+                    )
+                else:
+                    letter_axis[letter] = dim
+        out_axes = tuple(letter_axis.get(l) for l in out.strip())
+        return AV(out_axes if out.strip() else (), None)
+
+    def _subscript_av(self, node: ast.Subscript) -> AV:
+        base = self.avof(node.value)
+        if base.axes is None:
+            return UNKNOWN
+        sl = node.slice
+        elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        out: List[object] = []
+        pos = 0
+        for e in elts:
+            if isinstance(e, ast.Slice):
+                if pos < len(base.axes):
+                    out.append(base.axes[pos])
+                pos += 1
+            elif isinstance(e, ast.Constant) and e.value is None:
+                out.append(1)
+            elif isinstance(e, ast.Constant) and isinstance(e.value, int):
+                pos += 1  # integer index drops the dim
+            elif (
+                isinstance(e, ast.UnaryOp)
+                and isinstance(e.op, ast.USub)
+                and isinstance(e.operand, ast.Constant)
+            ):
+                pos += 1
+            elif isinstance(e, ast.Name):
+                av = self.env.get(e.id)
+                if av.axes == () or av.axes is None:
+                    pos += 1  # scalar (or unknown treated as scalar index)
+                elif len(elts) == 1 and len(base.axes) == 1:
+                    # 1-D gather: result takes the index's axes
+                    return AV(av.axes, base.dtype)
+                else:
+                    return UNKNOWN
+            else:
+                return UNKNOWN
+        out.extend(base.axes[pos:])
+        return AV(tuple(out), base.dtype)
+
+    # -- checks -----------------------------------------------------------
+
+    def _check_literal_dims(self, node: ast.AST, where: str) -> None:
+        elts = (
+            node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+        )
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                if not isinstance(e.value, bool) and e.value > 1 and not _is_pow2(e.value):
+                    self._flag(
+                        "SHP603", node,
+                        f"literal dimension {e.value} in {where} bypasses "
+                        "the power-of-two bucket ladder — every distinct "
+                        "size recompiles; route it through the bucketing "
+                        "helpers or pad to a power of two",
+                    )
+
+    def _check_dtype_64(
+        self,
+        dtype_node: Optional[ast.AST],
+        ctx: str,
+        jax_origin_only: bool = False,
+    ) -> None:
+        """``jax_origin_only`` gates contexts that are not already known to
+        be device code (``.astype`` on an arbitrary object): only a dtype
+        spelled ``jnp.float64`` flags there — host ``np.float64`` index
+        math in the encoder is intentional and out of scope."""
+        if dtype_node is None:
+            return
+        name = None
+        if isinstance(dtype_node, ast.Attribute) and dtype_node.attr in _DTYPE_64:
+            from .astutil import dotted_name
+
+            dn = dotted_name(dtype_node) or ""
+            origin = self.aliases.get(dn.partition(".")[0], dn.partition(".")[0])
+            if not jax_origin_only or origin.startswith("jax"):
+                name = dtype_node.attr
+        elif (
+            not jax_origin_only
+            and isinstance(dtype_node, ast.Constant)
+            and isinstance(dtype_node.value, str)
+            and dtype_node.value in _DTYPE_64
+        ):
+            name = dtype_node.value
+        if name is not None:
+            self._flag(
+                "SHP602", dtype_node,
+                f"explicit {name} in {ctx}: 64-bit types silently "
+                "downcast (x64 off) or are unsupported on TPU — use the "
+                "32-bit twin",
+            )
+
+    # -- statement visitors ----------------------------------------------
+
+    def _bind(self, target: ast.AST, av: AV) -> None:
+        if isinstance(target, ast.Name):
+            self.env.set(target.id, av)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, UNKNOWN)
+
+    # -- path sensitivity --------------------------------------------------
+    # The walker is straight-line: a binding made inside only one branch of
+    # a conditional (or a loop body that may run zero times) is not a fact
+    # on the fall-through path. Each branch is checked against the
+    # pre-branch state, and every name the construct assigns degrades to
+    # unknown at its exit — the join that can never false-positive.
+
+    def _degrade_assigned(self, *bodies) -> None:
+        for body in bodies:
+            for stmt in body:
+                for name in _assigned_names(stmt):
+                    self.env.set(name, UNKNOWN)
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        before = dict(self.env.vals)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.env.vals = dict(before)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self.env.vals = before
+        self._degrade_assigned(node.body, node.orelse)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.generic_visit(node)
+        self._degrade_assigned([node])
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While) -> None:
+        self.generic_visit(node)
+        self._degrade_assigned([node])
+
+    def visit_Try(self, node: ast.Try) -> None:
+        self.generic_visit(node)
+        self._degrade_assigned(
+            node.body, node.orelse, node.finalbody,
+            *[h.body for h in node.handlers],
+        )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        av = self.avof(node.value)
+        for t in node.targets:
+            self._bind(t, av)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None:
+            self._bind(node.target, self.avof(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if isinstance(node.target, ast.Name):
+            a = self.env.get(node.target.id)
+            b = self.avof(node.value)
+            axes, conflict = _join_axes(a.axes, b.axes)
+            if conflict is not None:
+                self._flag(
+                    "SHP601", node,
+                    f"broadcast join aligns axis '{conflict[0]}' with axis "
+                    f"'{conflict[1]}' — operands look transposed",
+                )
+            self.env.set(node.target.id, AV(axes, _join_dtype(a.dtype, b.dtype)))
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        self.generic_visit(node)
+        a, b = self.avof(node.left), self.avof(node.right)
+        if isinstance(node.op, ast.MatMult):
+            # `@` contracts, it does not broadcast: check the contracted
+            # pair, not a right-aligned join (which would flag every
+            # legitimate [n,k] @ [k,m])
+            _, conflict = _matmul_axes(a.axes, b.axes)
+            if conflict is not None:
+                self._flag(
+                    "SHP601", node,
+                    f"matmul contracts axis '{conflict[0]}' against axis "
+                    f"'{conflict[1]}' — operands look transposed",
+                )
+        else:
+            _, conflict = _join_axes(a.axes, b.axes)
+            if conflict is not None:
+                self._flag(
+                    "SHP601", node,
+                    f"broadcast join aligns axis '{conflict[0]}' with axis "
+                    f"'{conflict[1]}' — operands look transposed",
+                )
+        if a.dtype and b.dtype and (
+            (a.dtype, b.dtype) in _WIDTH_PAIRS
+            or (b.dtype, a.dtype) in _WIDTH_PAIRS
+        ):
+            self._flag(
+                "SHP602", node,
+                f"join widens {a.dtype}/{b.dtype} to 64-bit — a TPU "
+                "promotion hazard; cast the wide operand down first",
+            )
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        self.generic_visit(node)
+        prev = self.avof(node.left)
+        for comp in node.comparators:
+            cur = self.avof(comp)
+            _, conflict = _join_axes(prev.axes, cur.axes)
+            if conflict is not None:
+                self._flag(
+                    "SHP601", node,
+                    f"broadcast join aligns axis '{conflict[0]}' with axis "
+                    f"'{conflict[1]}' — operands look transposed",
+                )
+            prev = cur
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        cname = call_name(node, self.aliases)
+        tail = cname.rpartition(".")[2]
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if self._is_jnp(cname):
+            if tail in _CONSTRUCTORS and tail != "arange" and node.args:
+                self._check_literal_dims(node.args[0], f"jnp.{tail} shape")
+                dtype_node = kw.get("dtype")
+                if dtype_node is None:
+                    slot = 2 if tail == "full" else 1
+                    if len(node.args) > slot:
+                        dtype_node = node.args[slot]
+                self._check_dtype_64(dtype_node, f"jnp.{tail}")
+            elif tail in ("asarray", "array"):
+                # dtype is positional arg 1 here — same slot _call_av reads
+                dtype_node = kw.get("dtype")
+                if dtype_node is None and len(node.args) > 1:
+                    dtype_node = node.args[1]
+                self._check_dtype_64(dtype_node, f"jnp.{tail}")
+            elif tail in ("full_like", "zeros_like", "ones_like",
+                          "one_hot", "arange"):
+                self._check_dtype_64(kw.get("dtype"), f"jnp.{tail}")
+            elif tail in _ELEMENTWISE:
+                avs = [self.avof(a) for a in node.args]
+                axes: Axes = ()
+                for av in avs:
+                    axes, conflict = _join_axes(axes, av.axes)
+                    if conflict is not None:
+                        self._flag(
+                            "SHP601", node,
+                            f"jnp.{tail} joins axis '{conflict[0]}' with "
+                            f"axis '{conflict[1]}' — operands look "
+                            "transposed",
+                        )
+            elif tail == "einsum":
+                self._einsum_av(node)  # flags letter conflicts
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "astype" and node.args:
+                self._check_dtype_64(
+                    node.args[0], ".astype", jax_origin_only=True
+                )
+            elif node.func.attr == "reshape":
+                # only values the interpreter tracked (jnp origins) are
+                # device code — host numpy reshape index math is out of
+                # scope, same rationale as .astype's jax_origin_only
+                recv = self.avof(node.func.value)
+                if recv.axes is not None or recv.dtype is not None:
+                    for a in node.args:
+                        self._check_literal_dims(a, ".reshape")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        check_function(
+            self.path, self.aliases, node, self.findings, parent=self.env
+        )
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        env = _Env(parent=self.env)
+        for arg in node.args.args + node.args.kwonlyargs:
+            env.set(arg.arg, UNKNOWN)
+        sub = _FunctionChecker(self.path, self.aliases, self.findings, env)
+        sub.visit(node.body)
+
+
+def check_function(
+    path: str,
+    aliases: Dict[str, str],
+    fn: ast.FunctionDef,
+    findings: List[Finding],
+    parent: Optional[_Env] = None,
+) -> None:
+    env = _Env(parent=parent)
+    for arg in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    ):
+        env.set(arg.arg, UNKNOWN)
+    if fn.args.vararg is not None:
+        env.set(fn.args.vararg.arg, UNKNOWN)
+    if fn.args.kwarg is not None:
+        env.set(fn.args.kwarg.arg, UNKNOWN)
+    checker = _FunctionChecker(path, aliases, findings, env)
+    for stmt in fn.body:
+        checker.visit(stmt)
+
+
+def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
+    """Run the axis/dtype pass over files/dirs of Python sources."""
+    findings: List[Finding] = []
+    sources: Dict[str, SourceFile] = {}
+    for path in iter_py_files(paths):
+        try:
+            src, tree = parse_file(path)
+        except (OSError, SyntaxError) as exc:
+            findings.append(
+                Finding("SHP600", Severity.ERROR, path, 0, f"unparsable: {exc}")
+            )
+            continue
+        sources[path] = src
+        aliases = import_aliases(tree)
+        # module-level statements run through the same checker (constructor
+        # sites like module constants are bindings too)
+        env = _Env()
+        checker = _FunctionChecker(path, aliases, findings, env)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_function(path, aliases, stmt, findings)
+            elif isinstance(stmt, ast.ClassDef):
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        check_function(path, aliases, item, findings)
+            else:
+                checker.visit(stmt)
+    return findings, sources

@@ -45,6 +45,14 @@ from .astutil import (
 )
 from .findings import Finding, Severity, SourceFile
 
+RULES = {
+    "TRC100": "unparsable file (tracer pass)",
+    "TRC101": "python if/while/ternary on a traced value",
+    "TRC102": "host materialization of a traced value",
+    "TRC103": "numpy/random/time use inside a jit region",
+    "TRC104": "python loop over a traced value",
+}
+
 TRACED = 2
 STATIC = 0
 

@@ -14,6 +14,17 @@
 //
 // Scalar float math is done in float32 to match XLA's element types so the
 // two implementations agree bit-for-bit on fits counts.
+//
+// The parity anchors below declare this twin's semantic skeleton —
+// phases, shared constants, dtypes, tie-break disciplines, and the
+// carried-state inventory — which karpenter_tpu/analysis/parity.py checks
+// against the AST-derived skeletons of pack/pack_classed. When a semantic
+// landmark moves here, move its anchor with it; when one is added to the
+// JAX kernels, add the matching anchor or presubmit fails with PAR5xx.
+//
+// parity: dtype float32
+// parity: dtype int32
+// parity: dtype bool
 
 #include <cstdint>
 #include <cstring>
@@ -27,10 +38,14 @@ using std::int32_t;
 using std::uint8_t;
 
 constexpr float kInf = std::numeric_limits<float>::infinity();
+// parity: const kBigFit = 2**30
 constexpr int32_t kBigFit = 1 << 30;
+// parity: const kBigDom = 2**28
 constexpr int32_t kBigDom = 1 << 28;  // "unbounded" domain capacity (_BIGI)
 
-// fits_count (ops/feasibility.py:68-80): identical float32 semantics.
+// fits_count (ops/feasibility.py:68-80): identical float32 semantics,
+// including the division epsilon.
+// parity: const 1e-9
 inline int32_t fits_count(const float* alloc, const float* base, const float* req,
                           int R) {
   bool ok_zero = true;
@@ -103,7 +118,9 @@ inline bool off_in_domain(const uint8_t* az /* [V1, V1] */, int dkey, int d,
   return false;
 }
 
-// greedy_prefix_fill (ops/packing.py)
+// greedy_prefix_fill (ops/packing.py): the running `before` total is the
+// exclusive prefix sum — slot priority order is the tie rule.
+// parity: tiebreak cumsum
 inline void greedy_prefix_fill(const std::vector<int32_t>& cap, int32_t n,
                                std::vector<int32_t>& fill) {
   int32_t before = 0;
@@ -116,7 +133,9 @@ inline void greedy_prefix_fill(const std::vector<int32_t>& cap, int32_t n,
   }
 }
 
-// waterfill (ops/packing.py): identical level/deficit semantics.
+// waterfill (ops/packing.py): identical level/deficit semantics — the
+// deficit layer hands out by slot index, exactly argmin's tie rule.
+// parity: tiebreak argmin
 inline void waterfill(const std::vector<int32_t>& npods,
                       const std::vector<int32_t>& cap, int32_t n,
                       std::vector<int32_t>& fills) {
@@ -293,6 +312,11 @@ int kt_solve(
   }
 
   // ---- pack state ------------------------------------------------------
+  // the carried-state inventory, one variable per PackState field
+  // parity: state exist_used, c_used, c_npods, c_active, c_pool, c_tmask
+  // parity: state c_def, c_neg, c_mask, c_dzone, c_dct
+  // parity: state ch_cnt, nhc, ddc, res_rem, c_resv
+  // parity: state pool_rem, n_open, overflow
   std::vector<float> exist_used(n_base, n_base + static_cast<size_t>(N) * R);
   std::vector<float> c_used(static_cast<size_t>(NMAX) * R, 0.0f);
   std::vector<int32_t> c_npods(NMAX, 0);
@@ -400,6 +424,7 @@ int kt_solve(
                (has_d ? ddc[static_cast<size_t>(jd) * V1 + v] : 0);
     const int32_t* D0 = D0v.data();
 
+    // parity: phase existing-nodes
     // ---- 1. existing nodes, fixed priority order ----
     for (int n = 0; n < N; ++n) {
       exist_cap[n] =
@@ -628,6 +653,7 @@ int kt_solve(
     // hostname-affinity group errors rather than spilling to claims
     if (haff && haff_exist_served) std::fill(qrem.begin(), qrem.end(), 0);
 
+    // parity: phase open-claims
     // ---- 2. open claims, least-loaded first ----
     std::vector<uint8_t> got(NMAX, 0);
     std::vector<int32_t> percap_d(dyn ? static_cast<size_t>(NMAX) * V1 : 0, 0);
@@ -725,6 +751,7 @@ int kt_solve(
       {
         int32_t acc = 0;
         const float denom = static_cast<float>(std::max(total_q, 1));
+        // parity: const 0.5
         for (int d = 0; d < V1; ++d) {
           acc += std::max(qrem[d], 0);
           cumf[d] = static_cast<float>(acc) / denom;
@@ -736,6 +763,8 @@ int kt_solve(
         const float x = (static_cast<float>(rank) + 0.5f) /
                         static_cast<float>(std::max(n_elig, 1));
         ++rank;
+        // first cumulative-quota bucket >= x: searchsorted's left rule
+        // parity: tiebreak searchsorted
         int d_prop = V1 - 1;
         for (int d = 0; d < V1; ++d)
           if (cumf[d] >= x) {
@@ -867,9 +896,12 @@ int kt_solve(
       }
     }
 
+    // parity: phase fresh-claims
     // ---- 3. new claims from highest-weight feasible template ----
-    // Serve one domain slot per iteration (largest remaining quota); a
-    // no-progress slot is retired so other domains still get served.
+    // Serve one domain slot per iteration (largest remaining quota — the
+    // argmax pick, first-hit ties by lowest slot index); a no-progress
+    // slot is retired so other domains still get served.
+    // parity: tiebreak argmax
     std::vector<uint8_t> ddead(NSLOT, 0);
     ddead[DEAD] = 1;
     while (!overflow) {

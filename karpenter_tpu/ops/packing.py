@@ -572,6 +572,7 @@ def pack(
                 None,
             )  # [NMAX, V1]
 
+        # parity: phase existing-nodes
         # ---- 1. existing nodes, fixed priority order ----
         exist_cap = jnp.where(
             cap_row > 0,
@@ -747,6 +748,7 @@ def pack(
         exist_used = state.exist_used + exist_fill[:, None] * req[None, :]
         nhc = state.nhc + exist_fill[:, None] * jh_oh[None, :]
 
+        # parity: phase open-claims
         # ---- 2. open claims, least-loaded first (feasibility tensors
         # computed above, shared with the bootstrap anchor) ----
         def _clamp(cap):
@@ -857,6 +859,7 @@ def pack(
             c_dzone2, c_dct2 = state.c_dzone, state.c_dct
         c_tmask = jnp.where(got[:, None], state.c_tmask & surv, state.c_tmask)
 
+        # parity: phase fresh-claims
         # ---- 3. new claims from highest-weight feasible template ----
         # Each iteration serves ONE domain slot (the largest remaining
         # quota) and opens a BULK of k identical claims of the chosen
@@ -1499,6 +1502,7 @@ def pack_classed(
             reg = g_dreg[gi]
             drank = g_drank[gi]
 
+            # parity: phase existing-nodes
             # ---- 1. existing nodes --------------------------------------
             e_cap = jnp.minimum(
                 exist_cap, jnp.maximum(hcap - n_hcnt[:, gi], 0)
@@ -1636,6 +1640,7 @@ def pack_classed(
             nhc = state.nhc + exist_fill[:, None] * jh_oh[None, :]
             exist_cap = exist_cap - exist_fill  # same-req decrement is exact
 
+            # parity: phase open-claims
             # ---- 2. open claims -----------------------------------------
             # capacity comes from the maintained summaries — no [NMAX, T]
             # tensor is touched per member (see the head comment for the
@@ -1751,6 +1756,7 @@ def pack_classed(
                 capv = capv - claim_fill
             cfills = cfills + claim_fill
 
+            # parity: phase fresh-claims
             # ---- 3. fresh claims ----------------------------------------
             def body(carry):
                 (st, qrem, fills, ddead, capv, percapv, af0, cfills,

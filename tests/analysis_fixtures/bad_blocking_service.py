@@ -1,0 +1,24 @@
+"""Seeded-bad blocking sites in the shapes BLK3xx newly covers: the
+solver sidecar's solve path and the leader-election loop. Both are
+reconcile-shaped — level-triggered steps driven by the injected clock —
+so wall-clock reads, sleeps, and blocking network I/O are the same hazard
+as in controllers/."""
+
+import time
+import urllib.request
+
+
+def solve_snapshot(data):
+    start = time.time()  # BLK302: wall-clock read in the solve path
+    health = urllib.request.urlopen(  # BLK303: blocking I/O in-band
+        "http://controller/healthz"
+    )
+    return data, health, time.time() - start  # BLK302 again
+
+
+class LeaderLoop:
+    def try_acquire(self, lease):
+        if lease.renew_time < time.monotonic():  # BLK302: bypasses Clock
+            time.sleep(1.0)  # BLK301: stalls the operator step
+            return True
+        return False

@@ -1,0 +1,51 @@
+"""Seeded-bad fixture for the SHP6xx axis/dtype pass.
+
+Each function below carries exactly the hazard its name says; the pass
+must flag every rule at least once and test_analysis.py pins the set.
+"""
+
+import jax.numpy as jnp
+
+
+def transposed_join(n, r):
+    a = jnp.zeros((n, r), jnp.float32)
+    b = jnp.zeros((r, n), jnp.float32)
+    return a + b  # SHP601: [n, r] + [r, n]
+
+
+def unexpanded_mask(n, r):
+    mask = jnp.zeros((n,), bool)
+    x = jnp.ones((n, r), jnp.float32)
+    # SHP601: mask needs [:, None] — as written 'n' aligns against 'r'
+    return jnp.where(mask, x, 0.0)
+
+
+def stale_einsum_spec(n, t):
+    a = jnp.zeros((n, t), jnp.float32)
+    b = jnp.zeros((t, n), jnp.float32)
+    # SHP601: letter 'n' binds axis n (from a) AND axis t (from b)
+    return jnp.einsum("nt,nt->n", a, b)
+
+
+def transposed_matmul(n, r, t):
+    a = jnp.zeros((n, r), jnp.float32)
+    b = jnp.zeros((t, r), jnp.float32)
+    return a @ b  # SHP601: contracts r against t (b needs transposing)
+
+
+def widened_accumulator(n):
+    acc = jnp.zeros((n,), jnp.float64)  # SHP602: explicit f64 constructor
+    x = jnp.ones((n,), jnp.float32)
+    y = x.astype(jnp.float64)  # SHP602: astype to 64-bit
+    return acc + x, y  # SHP602: f64/f32 join widens
+
+
+def widened_positional(spans):
+    # SHP602: positional dtype slot, no dtype= keyword
+    return jnp.asarray(spans, jnp.float64)
+
+
+def unbucketed_scratch(n):
+    pad = jnp.zeros((n, 1000), jnp.float32)  # SHP603: 1000 is not a bucket
+    flat = pad.reshape(n, 40, 25)  # SHP603: literal 40/25 dims
+    return flat

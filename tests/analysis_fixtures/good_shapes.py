@@ -1,0 +1,46 @@
+"""Clean twin of bad_shapes.py: the same computations with the axis
+order, dtypes, and bucketed literal dims done right — the SHP6xx pass
+must stay silent."""
+
+import jax.numpy as jnp
+
+
+def aligned_join(n, r):
+    a = jnp.zeros((n, r), jnp.float32)
+    b = jnp.zeros((n, r), jnp.float32)
+    return a + b
+
+
+def expanded_mask(n, r):
+    mask = jnp.zeros((n,), bool)
+    x = jnp.ones((n, r), jnp.float32)
+    return jnp.where(mask[:, None], x, 0.0)
+
+
+def consistent_einsum_spec(n, t):
+    a = jnp.zeros((n, t), jnp.float32)
+    b = jnp.zeros((t, n), jnp.float32)
+    return jnp.einsum("nt,tn->n", a, b)
+
+
+def aligned_matmul(n, r, t):
+    a = jnp.zeros((n, r), jnp.float32)
+    b = jnp.zeros((r, t), jnp.float32)
+    return a @ b  # legal contraction: [n, r] @ [r, t] -> [n, t]
+
+
+def narrow_positional(spans):
+    return jnp.asarray(spans, jnp.float32)
+
+
+def narrow_accumulator(n):
+    acc = jnp.zeros((n,), jnp.float32)
+    x = jnp.ones((n,), jnp.float32)
+    y = x.astype(jnp.int32)
+    return acc + x, y
+
+
+def bucketed_scratch(n):
+    pad = jnp.zeros((n, 1024), jnp.float32)
+    flat = pad.reshape(n, 32, 32)
+    return flat

@@ -1,0 +1,42 @@
+"""Miniature Python side of a kernel-twin triple for the PAR5xx extractor
+tests: two identical kernels over a tiny PackState, paired with
+parity_good.cc (anchors in sync) and parity_bad.cc (every anchor failure
+mode seeded)."""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+_BIG = 2**20
+
+
+class PackState(NamedTuple):
+    c_used: jnp.ndarray
+    c_npods: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def pack(xs, n):
+    # parity: phase fill
+    state = PackState(
+        c_used=jnp.zeros((n,), jnp.float32),
+        c_npods=jnp.zeros((n,), jnp.int32),
+        overflow=jnp.bool_(False),
+    )
+    level = jnp.argmin(jnp.where(xs > 0, xs, _BIG))
+    # parity: phase settle
+    order = jnp.cumsum(xs) * 0.25
+    return state._replace(c_used=state.c_used + order), level
+
+
+def pack_classed(xs, n):
+    # parity: phase fill
+    state = PackState(
+        c_used=jnp.zeros((n,), jnp.float32),
+        c_npods=jnp.zeros((n,), jnp.int32),
+        overflow=jnp.bool_(False),
+    )
+    level = jnp.argmin(jnp.where(xs > 0, xs, _BIG))
+    # parity: phase settle
+    order = jnp.cumsum(xs) * 0.25
+    return state._replace(c_used=state.c_used + order), level

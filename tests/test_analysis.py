@@ -8,7 +8,15 @@ import sys
 
 import pytest
 
-from karpenter_tpu.analysis import blocking, locks, schema_drift, tracer
+from karpenter_tpu.analysis import (
+    all_rules,
+    blocking,
+    locks,
+    parity,
+    schema_drift,
+    shapes,
+    tracer,
+)
 from karpenter_tpu.analysis.findings import (
     Finding,
     SourceFile,
@@ -175,6 +183,25 @@ class TestBlockingPass:
         assert filter_suppressed(findings, sources) == []
         assert not any(f.rule == "BLK301" for f in findings)
 
+    def test_sidecar_fixture_flags_every_rule(self):
+        # the service/leader coverage extension rides on this seeded twin
+        # of the sidecar's solve path and the lease loop
+        findings, _ = blocking.check_paths(
+            [fixture("bad_blocking_service.py")]
+        )
+        assert rules_of(findings) == {"BLK301", "BLK302", "BLK303"}
+
+    def test_real_sidecar_and_leader_clean(self):
+        # newly-covered targets (solver/service.py, kube/leader.py) must
+        # stay on the injected clock / off-thread I/O
+        findings, sources = blocking.check_paths(
+            [
+                os.path.join(REPO, "karpenter_tpu", "solver", "service.py"),
+                os.path.join(REPO, "karpenter_tpu", "kube", "leader.py"),
+            ]
+        )
+        assert filter_suppressed(findings, sources) == []
+
 
 class TestSchemaDriftPass:
     def test_drifted_fixture_flags_all_three_shapes(self):
@@ -222,6 +249,298 @@ class TestSchemaDriftPass:
         )
         findings, _ = schema_drift.check_schema(str(schema_py), str(crds))
         assert findings == []
+
+
+class TestParityPass:
+    """PAR5xx: skeleton agreement between pack, pack_classed, and the C++
+    core — anchors, constants, dtypes, tie-breaks, state inventory."""
+
+    REAL_PY = os.path.join(REPO, "karpenter_tpu", "ops", "packing.py")
+    REAL_CC = os.path.join(
+        REPO, "karpenter_tpu", "native", "solve_core.cc"
+    )
+
+    def test_real_twins_in_sync(self):
+        findings, _ = parity.check_parity(self.REAL_PY, self.REAL_CC)
+        assert findings == []
+
+    def test_real_skeletons_are_substantial(self):
+        # guard against the pass going quiet by extracting nothing: the
+        # real kernels must yield the full phase/const/dtype/tiebreak/state
+        # skeleton (a regression here would mask every drift rule)
+        import ast as ast_mod
+
+        from karpenter_tpu.analysis.astutil import import_aliases, parse_file
+        from karpenter_tpu.analysis.parity import (
+            _extract_python_skeleton,
+            _module_const_table,
+            _state_class_fields,
+        )
+
+        src, tree = parse_file(self.REAL_PY)
+        functions = {
+            n.name: n for n in tree.body
+            if isinstance(n, ast_mod.FunctionDef)
+        }
+        declared = _state_class_fields(tree, "PackState")
+        assert len(declared) == 19
+        for kname in ("pack", "pack_classed"):
+            sk = _extract_python_skeleton(
+                kname, self.REAL_PY, src, tree, functions[kname], functions,
+                declared, import_aliases(tree), _module_const_table(tree),
+            )
+            assert sk.phase_slugs() == [
+                "existing-nodes", "open-claims", "fresh-claims"
+            ]
+            assert set(sk.consts) == {
+                repr(2**28), repr(2**30), repr(1e-9), repr(0.5)
+            }
+            assert set(sk.dtypes) == {"float32", "int32", "bool"}
+            assert set(sk.tiebreaks) == {
+                "argmin", "argmax", "searchsorted", "cumsum"
+            }
+            assert set(sk.state_fields) == set(declared)
+
+    def test_fixture_twins_in_sync(self):
+        findings, _ = parity.check_parity(
+            fixture("parity_twin.py"), fixture("parity_good.cc")
+        )
+        assert findings == []
+
+    def test_seeded_bad_anchors_each_distinct_no_crash(self):
+        findings, _ = parity.check_parity(
+            fixture("parity_twin.py"), fixture("parity_bad.cc")
+        )
+        assert rules_of(findings) == {
+            "PAR501", "PAR502", "PAR503", "PAR504", "PAR505", "PAR506"
+        }
+        messages = "\n".join(f.message for f in findings)
+        # malformed anchors: empty arg, unevaluable expr, unknown kind
+        malformed = [f for f in findings if f.rule == "PAR506"]
+        assert len(malformed) == 3
+        assert "no argument" in messages
+        assert "banana" in messages
+        assert "flavor" in messages
+        # an anchor with no Python twin is directional, not a crash
+        assert "has no twin in pack" in messages
+        # a stale anchor after a rename names the missing field
+        assert "c_oldname" in messages and "stale after a rename" in messages
+
+    def test_constant_drift_in_one_twin_caught(self, tmp_path):
+        """The acceptance contract: mutate ONE constant in ONE twin (a
+        fixture copy of the real kernels) and the pass must flag it."""
+        with open(self.REAL_PY, encoding="utf-8") as fh:
+            text = fh.read()
+        assert text.count("1e-9") >= 2  # one occurrence per Python twin
+        mutated = text.replace("1e-9", "1e-6", 1)  # pack only
+        py = tmp_path / "packing.py"
+        py.write_text(mutated)
+        with open(self.REAL_CC, encoding="utf-8") as fh:
+            cc = tmp_path / "solve_core.cc"
+            cc.write_text(fh.read())
+        findings, _ = parity.check_parity(str(py), str(cc))
+        drift = [f for f in findings if f.rule == "PAR502"]
+        assert drift, "mutated constant produced no PAR502 finding"
+        messages = "\n".join(f.message for f in drift)
+        assert "1e-06" in messages  # the new value has no twin
+        assert "1e-09" in messages  # the old value is now missing somewhere
+
+    def test_missing_kernel_reported(self, tmp_path):
+        py = tmp_path / "packing.py"
+        py.write_text("class PackState:\n    pass\n")
+        findings, _ = parity.check_parity(
+            str(py), fixture("parity_good.cc")
+        )
+        assert "PAR500" in rules_of(findings)
+
+    def test_cc_without_anchors_reported(self, tmp_path):
+        cc = tmp_path / "core.cc"
+        cc.write_text("// no anchors here\nint main() { return 0; }\n")
+        findings, _ = parity.check_parity(fixture("parity_twin.py"), str(cc))
+        assert any(
+            f.rule == "PAR500" and "no '// parity:' anchors" in f.message
+            for f in findings
+        )
+
+    def test_pathological_anchor_consts_become_findings(self, tmp_path):
+        # arithmetic errors and huge exponents in anchor const expressions
+        # are PAR506 findings, not analyzer crashes or hangs
+        with open(fixture("parity_good.cc"), encoding="utf-8") as fh:
+            text = fh.read()
+        text += (
+            "// parity: const 1/0\n"
+            "// parity: const 10.0**400\n"
+            "// parity: const 2**2**30\n"
+        )
+        cc = tmp_path / "core.cc"
+        cc.write_text(text)
+        findings, _ = parity.check_parity(fixture("parity_twin.py"), str(cc))
+        assert rules_of(findings) == {"PAR506"}
+        assert len(findings) == 3
+        assert all("unevaluable" in f.message for f in findings)
+
+    def test_cc_suppression_comment_honored(self, tmp_path):
+        """`// analysis: ignore[PAR...]` next to a C++ anchor suppresses
+        like the Python marker does."""
+        with open(fixture("parity_good.cc"), encoding="utf-8") as fh:
+            text = fh.read()
+        text = text.replace(
+            "// parity: const 0.25",
+            "// analysis: ignore[PAR502] intentional fixed-point rescale\n"
+            "// parity: const 0.125",
+        )
+        cc = tmp_path / "core.cc"
+        cc.write_text(text)
+        findings, sources = parity.check_parity(
+            fixture("parity_twin.py"), str(cc)
+        )
+        kept = filter_suppressed(findings, sources)
+        # the 0.125 anchor's "no twin" finding is suppressed inline; the
+        # missing-0.25 direction (reported at the file head) remains
+        assert all("0.125" not in f.message for f in kept)
+        assert any("0.25" in f.message for f in kept)
+
+
+class TestShapesPass:
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = shapes.check_paths([fixture("bad_shapes.py")])
+        assert rules_of(findings) == {"SHP601", "SHP602", "SHP603"}
+        messages = "\n".join(f.message for f in findings)
+        # the four seeded SHP601 shapes: operator join, where join,
+        # einsum, transposed matmul contraction
+        assert len([f for f in findings if f.rule == "SHP601"]) == 4
+        assert "einsum" in messages
+        assert "matmul contracts" in messages
+        # widening via constructor, astype, join, and a positional
+        # asarray dtype are all distinct hits
+        assert len([f for f in findings if f.rule == "SHP602"]) == 4
+        # the non-bucketed constructor dim and the reshape literal
+        assert len([f for f in findings if f.rule == "SHP603"]) == 2
+        assert "1000" in messages
+
+    def test_clean_fixture_silent(self):
+        findings, _ = shapes.check_paths([fixture("good_shapes.py")])
+        assert findings == []
+
+    def test_real_kernels_clean(self):
+        findings, sources = shapes.check_paths(
+            [
+                os.path.join(REPO, "karpenter_tpu", "ops"),
+                os.path.join(REPO, "karpenter_tpu", "solver"),
+            ]
+        )
+        assert filter_suppressed(findings, sources) == []
+
+    def test_unknown_rank_never_false_positives(self, tmp_path):
+        # joining a tracked array against a value the interpreter lost
+        # track of must stay silent (the poison-to-unknown rule)
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(n, r, blob):\n"
+            "    a = jnp.zeros((n, r), jnp.float32)\n"
+            "    b = blob.some_method()\n"
+            "    return a + b\n"
+        )
+        p = tmp_path / "unknown.py"
+        p.write_text(src)
+        findings, _ = shapes.check_paths([str(p)])
+        assert findings == []
+
+    def test_host_numpy_out_of_scope(self, tmp_path):
+        # encode-time np.int64 index math is intentional host code
+        src = (
+            "import numpy as np\n"
+            "def g(spans):\n"
+            "    arr = np.asarray(spans, np.int64)\n"
+            "    return arr.astype(np.float64)\n"
+        )
+        p = tmp_path / "host.py"
+        p.write_text(src)
+        findings, _ = shapes.check_paths([str(p)])
+        assert findings == []
+
+    def test_host_numpy_reshape_out_of_scope(self, tmp_path):
+        # .reshape literal-dim checks gate on a jnp-tracked receiver,
+        # same rationale as .astype: host index math is intentional
+        src = (
+            "import numpy as np\n"
+            "def g(spans):\n"
+            "    return np.asarray(spans, np.int64).reshape(5, 1000)\n"
+        )
+        p = tmp_path / "host_reshape.py"
+        p.write_text(src)
+        findings, _ = shapes.check_paths([str(p)])
+        assert findings == []
+
+    def test_branch_rebinding_never_false_positives(self, tmp_path):
+        # a rebinding inside one branch is not a fact on the fall-through
+        # path: `a` is still [n, r] when flag is False
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(n, r, flag):\n"
+            "    a = jnp.zeros((n, r), jnp.float32)\n"
+            "    if flag:\n"
+            "        a = a.T\n"
+            "        return a.sum()\n"
+            "    return a + jnp.zeros((n, r), jnp.float32)\n"
+        )
+        p = tmp_path / "branchy.py"
+        p.write_text(src)
+        findings, _ = shapes.check_paths([str(p)])
+        assert findings == []
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = shapes.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"SHP600"}
+
+
+class TestRuleRegistry:
+    """The meta-contract: every shipped rule id has at least one seeded-bad
+    fixture. Parse-failure rules (x00) are seeded at runtime because a
+    committed broken .py would fail presubmit's compileall step."""
+
+    def test_registry_covers_every_pass(self):
+        rules = all_rules()
+        for prefix in ("TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6"):
+            assert any(r.startswith(prefix) for r in rules), prefix
+
+    def test_every_rule_has_seeded_bad_coverage(self, tmp_path):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def oops(:\n")
+        empty_crds = tmp_path / "no_crds"
+        empty_crds.mkdir()
+
+        produced = set()
+        runs = [
+            tracer.check_paths([fixture("bad_tracer.py"), str(broken)]),
+            locks.check_paths([fixture("bad_locks.py"), str(broken)]),
+            blocking.check_paths(
+                [
+                    fixture("bad_blocking.py"),
+                    fixture("bad_blocking_service.py"),
+                    str(broken),
+                ]
+            ),
+            schema_drift.check_schema(
+                fixture("drift_schema.py"), fixture("drift_crds")
+            ),
+            schema_drift.check_schema(str(broken), fixture("drift_crds")),
+            schema_drift.check_schema(
+                fixture("drift_schema.py"), str(empty_crds)
+            ),
+            parity.check_parity(
+                fixture("parity_twin.py"), fixture("parity_bad.cc")
+            ),
+            parity.check_parity(str(broken), fixture("parity_good.cc")),
+            shapes.check_paths([fixture("bad_shapes.py"), str(broken)]),
+        ]
+        for findings, _sources in runs:
+            produced |= {f.rule for f in findings}
+        missing = set(all_rules()) - produced
+        assert not missing, (
+            f"shipped rule(s) with no seeded-bad fixture: {sorted(missing)}"
+        )
 
 
 class TestSuppressions:
@@ -296,10 +615,70 @@ class TestCli:
         assert proc.returncode == 1, proc.stdout + proc.stderr
         assert "SCH4" in proc.stdout
 
+    def test_cli_nonzero_on_parity_drift(self):
+        proc = self._run(
+            "--pass", "parity", fixture("parity_twin.py"),
+            fixture("parity_bad.cc"),
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "PAR5" in proc.stdout
+
+    def test_cli_nonzero_on_shape_violations(self):
+        proc = self._run("--pass", "shapes", fixture("bad_shapes.py"))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "SHP6" in proc.stdout
+
     def test_cli_clean_on_final_tree(self):
         proc = self._run()
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "0 finding(s)" in proc.stderr
+
+    def test_sarif_output_on_seeded_violation(self):
+        import json
+
+        proc = self._run(
+            "--format", "sarif", "--pass", "shapes", fixture("bad_shapes.py")
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        results = doc["runs"][0]["results"]
+        assert {r["ruleId"] for r in results} == {
+            "SHP601", "SHP602", "SHP603"
+        }
+        assert all(r["level"] == "error" for r in results)
+        rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+        assert rule_ids == {"SHP601", "SHP602", "SHP603"}
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad_shapes.py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_sarif_clean_tree_empty_results(self):
+        import json
+
+        proc = self._run("--format", "sarif")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["runs"][0]["results"] == []
+
+    def test_write_baseline_workflow(self, tmp_path):
+        """--write-baseline then --baseline is the designed grandfathering
+        loop: seeded violations land in the file, a rerun against it is
+        clean, and unrelated rules still gate."""
+        baseline = tmp_path / "baseline.txt"
+        proc = self._run(
+            "--pass", "shapes", fixture("bad_shapes.py"),
+            "--baseline", str(baseline), "--write-baseline",
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        text = baseline.read_text()
+        assert "SHP601\t" in text and "SHP603\t" in text
+        proc = self._run(
+            "--pass", "shapes", fixture("bad_shapes.py"),
+            "--baseline", str(baseline),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "suppressed" in proc.stderr
 
     def test_wrapper_clean_on_final_tree(self):
         proc = subprocess.run(

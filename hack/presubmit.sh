@@ -49,6 +49,15 @@ PY
 echo "== test suite =="
 python -m pytest tests/ -q
 
+# fixed-seed chaos smoke: the operator under seeded fault plans (solver
+# crash + corrupt solve, provider ICE, registration stalls, store
+# conflicts) must quarantine bad solves, never orphan/double-delete, and
+# converge once faults clear — deterministically (tests/e2e/test_chaos.py).
+# The full-length soak is marked `slow` and excluded here so tier-1 wall
+# time is unchanged.
+echo "== chaos smoke (fixed seeds) =="
+python -m pytest tests/e2e -k chaos -m 'not slow' -q
+
 # the race tier re-runs with different hash seeds (dict/set iteration
 # orders) — the deflake analog of the reference's `-race` + `-count`
 # loops (Makefile:78,85-93); the full suite above already ran it once

@@ -10,6 +10,8 @@ Default targets mirror the hazards each pass exists for:
 - schema:   api/schema.py vs api/crds/
 - parity:   ops/packing.py vs native/solve_core.cc (kernel-twin skeletons)
 - shapes:   karpenter_tpu/ops, karpenter_tpu/solver (axis/dtype walker)
+- retry:    karpenter_tpu/controllers, karpenter_tpu/solver, operator.py
+            (swallowed exceptions, unbounded retry loops)
 
 Positional paths (with ``--pass``) override a pass's default targets so
 fixture suites can point a single pass at seeded-bad files. Exit status is
@@ -27,7 +29,16 @@ import os
 import sys
 from typing import Dict, List
 
-from . import all_rules, blocking, locks, parity, schema_drift, shapes, tracer
+from . import (
+    all_rules,
+    blocking,
+    locks,
+    parity,
+    retry,
+    schema_drift,
+    shapes,
+    tracer,
+)
 from .findings import (
     Finding,
     Severity,
@@ -63,6 +74,13 @@ PASS_TARGETS = {
         "karpenter_tpu/native/solve_core.cc",
     ],
     "shapes": ["karpenter_tpu/ops", "karpenter_tpu/solver"],
+    # retry/except hygiene where the degradation ladder lives: the
+    # reconcile roster, the solver path, and the operator's requeue loop
+    "retry": [
+        "karpenter_tpu/controllers",
+        "karpenter_tpu/solver",
+        "karpenter_tpu/operator.py",
+    ],
 }
 
 
@@ -88,6 +106,8 @@ def _run_pass(name: str, targets: List[str]):
         return parity.check_parity(py_path, cc_path)
     if name == "shapes":
         return shapes.check_paths(targets)
+    if name == "retry":
+        return retry.check_paths(targets)
     raise ValueError(f"unknown pass {name!r}")
 
 

@@ -1,0 +1,150 @@
+"""Pass 7: retry/except hygiene in the fault-handling paths.
+
+The robustness tier (karpenter_tpu/faults/) only works if every seam
+either retries through a clock-driven ``Backoff``/``RetryTracker`` or
+surfaces its failure where the breaker and the requeue machinery can see
+it. Two anti-patterns defeat it structurally, and both are statically
+visible:
+
+- **RTY701 — swallowed failure**: an ``except Exception:`` (or bare
+  ``except:`` / ``except BaseException:``) whose body is only
+  ``pass``/``continue``/``...``. The fault disappears: no event, no
+  metric, no backoff, and the chaos soak can never attribute the orphan
+  it produces. Typed catches (``except ConflictError: continue``) are the
+  designed idiom and are NOT flagged — the type documents exactly which
+  transient the level-triggered loop absorbs.
+- **RTY702 — unbounded retry loop**: a ``while True`` loop whose
+  ``except`` handler keeps looping (``continue``, or a body that just
+  falls through) with no visible bound anywhere in the loop — no attempt
+  counter, no ``Backoff``/``RetryTracker``/clock call, no
+  raise/break/return in the handler. Under a persistent fault such a
+  loop spins the reconcile thread forever; ``Backoff.call`` is the
+  bounded replacement.
+
+The bound detection is deliberately permissive (any attempt-counter-ish
+name comparison, any backoff/clock reference, any escape statement in the
+handler counts): the rule exists to catch the *structurally* unbounded
+shape, not to lint retry style.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .astutil import iter_py_files, parse_file
+from .findings import Finding, Severity, SourceFile
+
+RULES = {
+    "RTY700": "unparsable file (retry pass)",
+    "RTY701": "broad exception handler silently swallows the failure",
+    "RTY702": "retry loop without a Backoff/attempt/clock bound",
+}
+
+_BROAD = {"Exception", "BaseException"}
+_SWALLOW_BODY = (ast.Pass, ast.Continue)
+_BOUND_NAME_HINTS = ("backoff", "attempt", "retries", "tries", "deadline")
+_BOUND_CALL_ATTRS = {"sleep", "delay", "ready", "failure", "call", "retry"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in _BROAD for n in names)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does nothing but loop/fall through."""
+    return all(
+        isinstance(stmt, _SWALLOW_BODY)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in handler.body
+    )
+
+
+def _ident_chain(node: ast.AST) -> str:
+    """Lowercased dotted-ish identifier text of a Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def _has_bound(loop: ast.While) -> bool:
+    """Any structural evidence the loop's retrying is bounded."""
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            ident = _ident_chain(node)
+            if any(h in ident for h in _BOUND_NAME_HINTS):
+                return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _BOUND_CALL_ATTRS:
+                return True
+    return False
+
+
+def _handler_escapes(handler: ast.ExceptHandler) -> bool:
+    """The handler itself can leave the loop (raise/break/return)."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Break, ast.Return)):
+            return True
+    return False
+
+
+def _loops_forever(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
+    findings: List[Finding] = []
+    sources: Dict[str, SourceFile] = {}
+    for path in iter_py_files(paths):
+        try:
+            src, tree = parse_file(path)
+        except (OSError, SyntaxError) as exc:
+            findings.append(
+                Finding("RTY700", Severity.ERROR, path, 0, f"unparsable: {exc}")
+            )
+            continue
+        sources[path] = src
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler):
+                if _is_broad(node) and _swallows(node):
+                    findings.append(
+                        Finding(
+                            "RTY701", Severity.ERROR, path, node.lineno,
+                            "broad except swallows the failure with no "
+                            "event/metric/backoff; catch the specific "
+                            "transient type, or record before requeueing",
+                        )
+                    )
+            elif isinstance(node, ast.While) and _loops_forever(node.test):
+                retrying = [
+                    h
+                    for t in ast.walk(node)
+                    if isinstance(t, ast.Try)
+                    for h in t.handlers
+                    if not _handler_escapes(h)
+                ]
+                if retrying and not _has_bound(node):
+                    findings.append(
+                        Finding(
+                            "RTY702", Severity.ERROR, path, node.lineno,
+                            "while-True retry loop with a non-escaping "
+                            "except handler and no visible bound (attempt "
+                            "counter, Backoff/RetryTracker, clock); use "
+                            "faults.backoff.Backoff.call",
+                        )
+                    )
+    return findings, sources

@@ -14,6 +14,7 @@ from ..api import labels as labels_mod
 from ..api.objects import NodeClaim, ObjectMeta
 from ..api.requirements import Requirements
 from . import corpus
+from .icecache import InsufficientCapacityCache, mask_unavailable_offerings
 from .types import (
     CloudProvider,
     InstanceType,
@@ -32,7 +33,11 @@ def instance_types(count: int = 5) -> List[InstanceType]:
 
 
 class FakeCloudProvider(CloudProvider):
-    def __init__(self, types: Optional[Sequence[InstanceType]] = None):
+    def __init__(
+        self,
+        types: Optional[Sequence[InstanceType]] = None,
+        clock=None,
+    ):
         self._instance_types = list(types if types is not None else instance_types())
         self.created: Dict[str, NodeClaim] = {}
         self.create_calls: List[NodeClaim] = []
@@ -44,6 +49,19 @@ class FakeCloudProvider(CloudProvider):
         self.drifted: str = ""
         self._repair_policies: List[RepairPolicy] = []
         self._seq = itertools.count(1)
+        self._tombstones: set = set()
+        # ICE cache mirrors kwok's: clock-driven TTL skip of failed
+        # offerings; tests mark cells via mark_insufficient_capacity
+        self.ice_cache = (
+            InsufficientCapacityCache(clock) if clock is not None else None
+        )
+
+    def mark_insufficient_capacity(
+        self, instance_type: str, zone: str, capacity_type: str
+    ) -> None:
+        if self.ice_cache is None:
+            raise RuntimeError("FakeCloudProvider built without a clock")
+        self.ice_cache.mark_unavailable(instance_type, zone, capacity_type)
 
     def name(self) -> str:
         return "fake"
@@ -56,10 +74,13 @@ class FakeCloudProvider(CloudProvider):
         if self.allowed_create_calls is not None and len(self.create_calls) > self.allowed_create_calls:
             raise InsufficientCapacityError("exceeded allowed create calls")
         reqs = node_claim.spec.scheduling_requirements()
+        ice_active = self.ice_cache is not None and self.ice_cache.active()
         for it in self._instance_types:
             if reqs.intersects(it.requirements) is not None:
                 continue
             ofs = compatible_offerings(available(it.offerings), reqs)
+            if ice_active:
+                ofs = self.ice_cache.filter_offerings(it.name, ofs)
             of = cheapest(ofs)
             if of is None:
                 continue
@@ -81,9 +102,14 @@ class FakeCloudProvider(CloudProvider):
         if self.next_delete_err is not None:
             err, self.next_delete_err = self.next_delete_err, None
             raise err
-        if node_claim.status.provider_id not in self.created:
-            raise NodeClaimNotFoundError(node_claim.status.provider_id)
-        del self.created[node_claim.status.provider_id]
+        pid = node_claim.status.provider_id
+        if pid not in self.created:
+            # typed NotFound for unknown ids and double-deletes alike
+            if pid in self._tombstones:
+                raise NodeClaimNotFoundError(f"{pid} already terminated")
+            raise NodeClaimNotFoundError(pid or "<no provider id>")
+        del self.created[pid]
+        self._tombstones.add(pid)
 
     def get(self, provider_id: str) -> NodeClaim:
         if self.next_get_err is not None:
@@ -98,6 +124,10 @@ class FakeCloudProvider(CloudProvider):
         return list(self.created.values())
 
     def get_instance_types(self, node_pool) -> List[InstanceType]:
+        if self.ice_cache is not None and self.ice_cache.active():
+            return mask_unavailable_offerings(
+                self._instance_types, self.ice_cache
+            )
         return list(self._instance_types)
 
     def is_drifted(self, node_claim: NodeClaim) -> str:

@@ -14,6 +14,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from .. import faults
 from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api import taints as taints_mod
@@ -21,6 +22,7 @@ from ..api.objects import Node, NodeClaim, NodeStatus, ObjectMeta, Taint
 from ..api.requirements import Requirements
 from ..kube import Client
 from . import corpus
+from .icecache import InsufficientCapacityCache, mask_unavailable_offerings
 from .types import (
     CloudProvider,
     InstanceType,
@@ -56,6 +58,13 @@ class KwokCloudProvider(CloudProvider):
         self._pending: List[tuple] = []  # (due_time, KwokInstance)
         self._registration_delay = registration_delay
         self._seq = itertools.count(1)
+        # terminated provider ids: a second delete (or a get) for one of
+        # these is a typed NodeClaimNotFoundError, never a KeyError leaking
+        # through the termination controller
+        self._tombstones: set = set()
+        # failed offerings are skipped for a TTL, keyed (instance type,
+        # zone, capacity type) — the reference's ICE cache
+        self.ice_cache = InsufficientCapacityCache(client.clock)
         self._rehydrate()
 
     def _rehydrate(self) -> None:
@@ -114,6 +123,7 @@ class KwokCloudProvider(CloudProvider):
 
     def create(self, node_claim: NodeClaim) -> NodeClaim:
         reqs = node_claim.spec.scheduling_requirements()
+        ice_active = self.ice_cache.active()
         # cheapest compatible (instance type, offering) pair, mirroring
         # kwok/cloudprovider/cloudprovider.go:168-216
         best = None
@@ -121,6 +131,8 @@ class KwokCloudProvider(CloudProvider):
             if reqs.intersects(it.requirements) is not None:
                 continue
             ofs = compatible_offerings(available(it.offerings), reqs)
+            if ice_active:
+                ofs = self.ice_cache.filter_offerings(it.name, ofs)
             # also respect requirements tightened to the instance type
             merged = Requirements(*reqs.values())
             merged.add(*it.requirements.values())
@@ -133,6 +145,23 @@ class KwokCloudProvider(CloudProvider):
                 f"no compatible instance type/offering for {node_claim.name}"
             )
         it, offering = best
+        try:
+            # chaos seam: the real cloud fails launches with per-offering
+            # insufficient capacity, timeouts, or generic provider errors
+            faults.hit(
+                faults.PROVIDER_CREATE,
+                claim=node_claim.name,
+                instance_type=it.name,
+                zone=offering.zone(),
+                capacity_type=offering.capacity_type(),
+            )
+        except InsufficientCapacityError:
+            # a per-offering ICE: remember the failed cell for a TTL so the
+            # retry (next reconcile) routes around it instead of re-picking
+            self.ice_cache.mark_unavailable(
+                it.name, offering.zone(), offering.capacity_type()
+            )
+            raise
         provider_id = f"kwok://{node_claim.name}-{next(self._seq)}"
 
         node = self._to_node(node_claim, it, offering, provider_id)
@@ -203,21 +232,40 @@ class KwokCloudProvider(CloudProvider):
         self._pending = [(t, i) for t, i in self._pending if t > now and not i.terminated]
         created = []
         for inst in due:
-            if self._client.try_get(Node, inst.node.name) is None:
-                self._client.create(inst.node)
-                created.append(inst.node)
+            try:
+                # chaos seam: registration-never-completes — the kubelet
+                # (or its network path) stalls; the instance stays pending
+                # and liveness eventually reaps the claim
+                faults.hit(faults.PROVIDER_REGISTER, name=inst.node.name)
+                if self._client.try_get(Node, inst.node.name) is None:
+                    self._client.create(inst.node)
+                    created.append(inst.node)
+            except Exception:
+                # ANY failure (injected fault, store conflict, crash
+                # mid-write) defers this instance rather than dropping it:
+                # `due` was already popped from _pending, and a silently
+                # lost registration stalls the claim until the liveness
+                # reaper — the orphan class the chaos soak forbids
+                self._pending.append((now + 1.0, inst))
         return created
 
     def delete(self, node_claim: NodeClaim) -> None:
-        inst = self._instances.pop(node_claim.status.provider_id, None)
+        pid = node_claim.status.provider_id
+        faults.hit(faults.PROVIDER_DELETE, provider_id=pid)
+        inst = self._instances.pop(pid, None) if pid else None
         if inst is None:
-            raise NodeClaimNotFoundError(node_claim.status.provider_id)
+            # typed NotFound for an unknown id AND for a double-delete
+            # (tombstoned) — both idempotent from the controllers' view
+            if pid in self._tombstones:
+                raise NodeClaimNotFoundError(f"{pid} already terminated")
+            raise NodeClaimNotFoundError(pid or "<no provider id>")
         inst.terminated = True
+        self._tombstones.add(pid)
 
     def get(self, provider_id: str) -> NodeClaim:
-        inst = self._instances.get(provider_id)
+        inst = self._instances.get(provider_id) if provider_id else None
         if inst is None or inst.terminated:
-            raise NodeClaimNotFoundError(provider_id)
+            raise NodeClaimNotFoundError(provider_id or "<no provider id>")
         return self._instance_to_claim(inst)
 
     def list(self) -> List[NodeClaim]:
@@ -233,6 +281,12 @@ class KwokCloudProvider(CloudProvider):
         return claim
 
     def get_instance_types(self, node_pool) -> List[InstanceType]:
+        if self.ice_cache.active():
+            # ICE-cached offerings read as unavailable so the solver routes
+            # around recently failed capacity cells until the TTL lapses
+            return mask_unavailable_offerings(
+                self._instance_types, self.ice_cache
+            )
         return list(self._instance_types)
 
     def is_drifted(self, node_claim: NodeClaim) -> str:

@@ -11,7 +11,13 @@ import time
 from typing import List
 
 from ..metrics import Counter, Histogram
-from .types import CloudProvider, CloudProviderError, InstanceType, RepairPolicy
+from .types import (
+    CloudProvider,
+    CloudProviderError,
+    InstanceType,
+    InsufficientCapacityError,
+    RepairPolicy,
+)
 
 METHOD_DURATION = Histogram(
     "cloudprovider_duration_seconds",
@@ -20,6 +26,10 @@ METHOD_DURATION = Histogram(
 METHOD_ERRORS = Counter(
     "cloudprovider_errors_total",
     "Total cloud provider method errors",
+)
+INSUFFICIENT_CAPACITY = Counter(
+    "cloudprovider_insufficient_capacity_total",
+    "Create calls that failed for lack of capacity (feeds the ICE cache)",
 )
 
 
@@ -38,6 +48,10 @@ class MetricsCloudProvider(CloudProvider):
             METHOD_ERRORS.inc(
                 labels={**labels, "error": type(e).__name__}
             )
+            if isinstance(e, InsufficientCapacityError):
+                INSUFFICIENT_CAPACITY.inc(
+                    labels={"provider": self.inner.name()}
+                )
             raise
         finally:
             METHOD_DURATION.observe(time.perf_counter() - t0, labels)
@@ -74,4 +88,7 @@ class MetricsCloudProvider(CloudProvider):
         return getattr(self.inner, item)
 
 
-__all__ = ["MetricsCloudProvider", "METHOD_DURATION", "METHOD_ERRORS"]
+__all__ = [
+    "MetricsCloudProvider", "METHOD_DURATION", "METHOD_ERRORS",
+    "INSUFFICIENT_CAPACITY",
+]

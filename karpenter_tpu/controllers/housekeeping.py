@@ -11,6 +11,7 @@ pkg/controllers/nodepool/{hash,counter,readiness}
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Dict, List, Optional
 
@@ -26,6 +27,7 @@ from ..api.objects import (
     NodeClaim,
     NodePool,
 )
+from ..cloudprovider.types import CloudProviderError, NodeClaimNotFoundError
 from ..events import Event, Recorder
 from ..kube import Client
 from ..metrics import Counter
@@ -37,6 +39,8 @@ MAX_REPAIR_FRACTION = 0.20  # health/controller.go:196-198
 
 CLAIMS_EXPIRED = Counter("nodeclaims_expired_total", "")
 INSTANCES_COLLECTED = Counter("instances_garbage_collected_total", "")
+
+_GC_LOG = logging.getLogger("karpenter_tpu.housekeeping")
 NODES_REPAIRED = Counter("nodes_repaired_total", "")
 
 
@@ -80,8 +84,15 @@ class GarbageCollectionController:
                 try:
                     self.cloud_provider.delete(cloud_claim)
                     INSTANCES_COLLECTED.inc()
-                except Exception:
-                    pass
+                except NodeClaimNotFoundError:
+                    pass  # raced with another deleter; already gone
+                except CloudProviderError as exc:
+                    # transient provider failure: the orphan survives until
+                    # the next GC pass — never let it crash the roster
+                    _GC_LOG.debug(
+                        "garbage collection of %s deferred: %s",
+                        cloud_claim.status.provider_id, exc,
+                    )
         # claims whose instances disappeared (and are registered)
         cloud_ids = {c.status.provider_id for c in self.cloud_provider.list()}
         for claim in self.client.list(NodeClaim):

@@ -30,7 +30,9 @@ from ..cloudprovider.types import (
     NodeClaimNotFoundError,
 )
 from ..events import Event, Recorder
+from ..faults.backoff import RetryTracker
 from ..kube import Client
+from ..kube.store import ConflictError
 from ..metrics import Counter
 
 LIVENESS_TTL = 15 * 60.0  # liveness.go:44
@@ -47,10 +49,24 @@ class LifecycleController:
         self.cloud_provider = cloud_provider
         self.clock = client.clock
         self.recorder = recorder or Recorder(self.clock)
+        # cross-pass backoff per claim: a failed cloud create/delete is
+        # NOT re-attempted every tick — attempts space out exponentially
+        # on the injected clock (faults/backoff.py), the in-process analog
+        # of controller-runtime's rate-limited requeue
+        self._launch_retry = RetryTracker(self.clock, initial=5.0, max_delay=120.0)
+        self._delete_retry = RetryTracker(self.clock, initial=5.0, max_delay=120.0)
 
     def reconcile_all(self) -> None:
-        for claim in self.client.list(NodeClaim):
-            self.reconcile(claim)
+        claims = self.client.list(NodeClaim)
+        self._launch_retry.prune(c.uid for c in claims)
+        self._delete_retry.prune(c.uid for c in claims)
+        for claim in claims:
+            try:
+                self.reconcile(claim)
+            except ConflictError:
+                # transient store conflict: the level-triggered loop
+                # retries this claim on the next pass with fresh state
+                continue
 
     def reconcile(self, claim: NodeClaim) -> None:
         if claim.metadata.deletion_timestamp is not None:
@@ -67,6 +83,8 @@ class LifecycleController:
         conds = claim.conds()
         if conds.is_true(COND_LAUNCHED):
             return
+        if not self._launch_retry.ready(claim.uid):
+            return  # backing off a failed create; retried when due
         # schema-tier admission (the CRD CEL rules, nodeclaim.go:38-41):
         # an invalid claim can never produce a node; delete it like an
         # unrecoverable launch failure
@@ -91,9 +109,14 @@ class LifecycleController:
             self._finalize(claim)
             return
         except CloudProviderError as e:
+            # transient provider failure (timeout, throttle): surface it on
+            # the claim and back off before the next attempt — liveness
+            # still bounds how long an unlaunched claim may live
+            self._launch_retry.failure(claim.uid)
             conds.set(COND_LAUNCHED, "False", "LaunchFailed", str(e), now=self.clock.now())
             self.client.update_status(claim)
             return
+        self._launch_retry.success(claim.uid)
         conds.set(COND_LAUNCHED, "True", now=self.clock.now())
         CLAIMS_LAUNCHED.inc(labels={"nodepool": claim.nodepool_name})
         self.client.update_status(claim)
@@ -182,10 +205,21 @@ class LifecycleController:
         if labels_mod.TERMINATION_FINALIZER not in claim.metadata.finalizers:
             return
         if claim.status.provider_id:
+            if not self._delete_retry.ready(claim.uid):
+                return  # instance termination backing off; finalizer holds
             try:
                 self.cloud_provider.delete(claim)
             except NodeClaimNotFoundError:
                 pass  # already gone
+            except CloudProviderError as e:
+                # transient: keep the finalizer (the instance MUST die
+                # before the claim may disappear) and back off the retry
+                self._delete_retry.failure(claim.uid)
+                self.recorder.publish(
+                    Event(claim.uid, "Warning", "TerminationFailed", str(e))
+                )
+                return
+            self._delete_retry.success(claim.uid)
         node = self.client.try_get(Node, claim.status.node_name) if claim.status.node_name else None
         if node is None:
             node = self._node_for(claim)

@@ -18,7 +18,9 @@ from ..api import labels as labels_mod
 from ..api.objects import DaemonSet, Node, NodeClaim, NodePool, Pod
 from ..api.requirements import Requirements, pod_requirements
 from ..events import Event, Recorder
+from ..faults.backoff import Backoff
 from ..kube import Client
+from ..kube.store import ConflictError
 from ..metrics import Counter, Gauge, Histogram
 from ..scheduling.inflight import ExistingNode, InFlightNodeClaim
 from ..scheduling.scheduler import Results
@@ -104,6 +106,12 @@ class Provisioner:
         self.solver_address = solver_address
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self._encode_cache = EncodeCache()  # survives across schedule() calls
+        # transient store conflicts (a real apiserver's 409s) get a couple
+        # of bounded in-cycle retries on the injected clock; conflicts that
+        # outlive the budget leave the pods pending for the next cycle
+        self._store_backoff = Backoff(
+            self.clock, initial=0.05, max_delay=1.0, max_attempts=3
+        )
         self.batcher = Batcher(self.clock, batch_idle_duration, batch_max_duration)
         self.volume_topology = VolumeTopology(client)
         self.volume_resolver = VolumeResolver(client)
@@ -237,6 +245,13 @@ class Provisioner:
                 state_nodes=state_nodes,
                 volume_objects=self._volume_objects(pods),
                 reserved_capacity_enabled=self.reserved_capacity_enabled,
+                # carries the per-call gRPC deadline and the degradation
+                # ladder into the remote seam (retry once, then solve
+                # in-process — service.py:RemoteSolver); the long-lived
+                # encode cache keeps outage-time fallback solves from
+                # re-encoding the catalog every cycle
+                config=self.solver_config,
+                encode_cache=self._encode_cache,
             )
         else:
             solver = TpuSolver(
@@ -312,7 +327,26 @@ class Provisioner:
         created = []
         for claim_model in results.new_node_claims:
             try:
-                claim = materialize_claim(self.client, claim_model, pools)
+                # bounded, clock-driven retry on transient store conflicts;
+                # a conflict that survives the budget leaves these pods
+                # pending and the next cycle re-solves with fresh state
+                claim = self._store_backoff.call(
+                    lambda: materialize_claim(
+                        self.client, claim_model, pools
+                    ),
+                    retriable=(ConflictError,),
+                )
+            except ConflictError as exc:
+                for pod in claim_model.pods:
+                    self.recorder.publish(
+                        Event(
+                            object_uid=pod.uid,
+                            type="Warning",
+                            reason="RetryableCreateFailed",
+                            message=f"store conflict creating NodeClaim: {exc}",
+                        )
+                    )
+                continue
             except ValueError as exc:
                 # launch-time refusal (e.g. minValues unmet after the
                 # 60-type truncation): pods stay pending and retry next

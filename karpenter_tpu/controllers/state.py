@@ -270,6 +270,7 @@ class Cluster:
         ):
             try:
                 objs = self._client.list(kind)
+            # analysis: ignore[RTY701] capability probe — an unlistable kind means "empty", not a retriable fault
             except Exception:
                 continue
             for obj in objs:

@@ -23,6 +23,7 @@ from ..api.objects import (
 )
 from ..events import Event, Recorder
 from ..kube import Client
+from ..kube.store import ConflictError
 from ..metrics import Histogram
 from ..utils import pod as pod_utils
 from ..utils.pdb import Limits
@@ -72,7 +73,12 @@ class TerminationController:
     def reconcile_all(self) -> None:
         for node in self.client.list(Node):
             if node.metadata.deletion_timestamp is not None:
-                self.reconcile(node)
+                try:
+                    self.reconcile(node)
+                except ConflictError:
+                    # transient store conflict mid-drain: termination is
+                    # re-entrant per step, the next pass resumes this node
+                    continue
 
     def reconcile(self, node: Node) -> None:
         """Drive one deleting node toward removal; re-entrant per step."""

@@ -1,0 +1,261 @@
+"""Circuit breaker + the solver's degradation ladder.
+
+The solve hot path has three rungs, fastest first:
+
+1. **batched** — the scenario-batched kernel (one vmapped dispatch for a
+   whole consolidation probe set, ops/solve.py:solve_all_scenarios_packed);
+2. **kernel** — the per-probe fused kernel (solve_all_packed /
+   solve_all_classed_packed, or the native C++ core);
+3. **oracle** — the exact host scheduler (scheduling/scheduler.py), the
+   semantic source of truth. Always available; never guarded.
+
+Each guarded rung sits behind a ``CircuitBreaker``: consecutive failures
+trip it open, a clock-driven cool-down admits a half-open probe, and a
+probe success closes it again — so the solver drops DOWN the ladder when
+a rung misbehaves and re-probes UPWARD once the cool-down passes
+(CvxCluster's degradation argument for LP allocators; the reference
+treats provider errors as first-class state the same way). An integrity
+violation caught by faults/guard.py trips the rung immediately
+(quarantine) instead of counting toward the threshold: a kernel emitting
+garbage must not get ``failure_threshold`` chances to corrupt a commit.
+
+``SolverHealth`` is the shared handle threaded through ``SolverConfig``:
+one instance per operator, surviving the per-solve TpuSolver instances,
+publishing rung changes as events and metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ..events import (
+    REASON_SOLVER_DEGRADED,
+    REASON_SOLVER_QUARANTINED,
+    REASON_SOLVER_RESTORED,
+)
+from ..metrics import Counter, Gauge
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+DEGRADATION_RUNG = Gauge(
+    "solver_degradation_rung",
+    "Current solver rung: 0=scenario-batched, 1=per-probe kernel, 2=host oracle",
+)
+BREAKER_TRIPS = Counter(
+    "solver_breaker_trips_total",
+    "Circuit-breaker trips per solver rung",
+)
+QUARANTINES = Counter(
+    "solver_quarantines_total",
+    "Solves discarded by the post-solve invariant guard",
+)
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with a clock-driven cool-down.
+
+    closed → open after ``failure_threshold`` consecutive failures (or an
+    explicit ``trip()``); open → half-open once ``cooldown`` seconds pass
+    on the injected clock; a half-open success closes, a half-open
+    failure re-opens and restarts the cool-down."""
+
+    def __init__(self, clock, failure_threshold: int = 3, cooldown: float = 60.0):
+        self.clock = clock
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self.state = CLOSED
+        self.failures = 0
+        self.trips = 0
+        self._opened_at = 0.0
+
+    def allow(self) -> bool:
+        if self.state == OPEN and (
+            self.clock.now() - self._opened_at >= self.cooldown
+        ):
+            self.state = HALF_OPEN
+        return self.state != OPEN
+
+    def record_success(self) -> None:
+        self.state = CLOSED
+        self.failures = 0
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == HALF_OPEN or self.failures >= self.failure_threshold:
+            self.trip()
+
+    def trip(self) -> None:
+        self.state = OPEN
+        self.trips += 1
+        self.failures = 0
+        self._opened_at = self.clock.now()
+
+
+class DegradationLadder:
+    """Ordered rungs, fastest first; every rung but the last sits behind a
+    breaker, and the last is unconditional."""
+
+    def __init__(
+        self,
+        clock,
+        rungs: Sequence[str] = ("batched", "kernel", "oracle"),
+        failure_threshold: int = 2,
+        cooldown: float = 120.0,
+    ):
+        self.rungs = tuple(rungs)
+        self.breakers: Dict[str, CircuitBreaker] = {
+            rung: CircuitBreaker(clock, failure_threshold, cooldown)
+            for rung in self.rungs[:-1]
+        }
+
+    def allows(self, rung: str) -> bool:
+        breaker = self.breakers.get(rung)
+        return breaker is None or breaker.allow()
+
+    def record(self, rung: str, ok: bool) -> None:
+        breaker = self.breakers.get(rung)
+        if breaker is None:
+            return
+        if ok:
+            breaker.record_success()
+        else:
+            breaker.record_failure()
+
+    def trip(self, rung: str) -> None:
+        breaker = self.breakers.get(rung)
+        if breaker is not None:
+            breaker.trip()
+
+    def current(self) -> str:
+        for rung in self.rungs:
+            if self.allows(rung):
+                return rung
+        return self.rungs[-1]
+
+    def level(self) -> int:
+        return self.rungs.index(self.current())
+
+
+class SolverHealth:
+    """The solver path's ladder, shared across TpuSolver instances.
+
+    ``allow_batched``/``allow_kernel`` gate the two accelerated rungs
+    (a quarantined kernel also takes the batched rung with it — both run
+    the same kernels); ``record_*`` feed successes/failures to the
+    breakers; ``quarantine`` trips a rung immediately on an integrity
+    violation. Rung changes are published as events through ``recorder``
+    (events/recorder.py) and mirrored in the metrics above."""
+
+    RUNGS = ("batched", "kernel", "oracle")
+
+    def __init__(
+        self,
+        clock,
+        recorder=None,
+        failure_threshold: int = 2,
+        cooldown: float = 120.0,
+    ):
+        self.clock = clock
+        self.recorder = recorder
+        self.ladder = DegradationLadder(
+            clock, self.RUNGS, failure_threshold, cooldown
+        )
+        self.quarantines = 0
+        self._last_level = 0
+        DEGRADATION_RUNG.set(0.0)
+
+    # -- gates --------------------------------------------------------------
+
+    def allow_batched(self) -> bool:
+        return self.ladder.allows("batched") and self.ladder.allows("kernel")
+
+    def allow_kernel(self) -> bool:
+        return self.ladder.allows("kernel")
+
+    # -- outcomes -----------------------------------------------------------
+
+    def record_batched(self, ok: bool, reason: str = "") -> None:
+        self._record("batched", ok, reason)
+
+    def record_kernel(self, ok: bool, reason: str = "") -> None:
+        self._record("kernel", ok, reason)
+
+    def quarantine(self, rung: str, reason: str) -> None:
+        """Integrity violation: trip the rung NOW and drop to the oracle
+        (the violating solve is discarded by the caller, never committed)."""
+        self.quarantines += 1
+        QUARANTINES.inc()
+        self.ladder.trip(rung)
+        BREAKER_TRIPS.inc(labels={"rung": rung})
+        self._publish(
+            REASON_SOLVER_QUARANTINED,
+            f"solver {rung} rung quarantined: {reason}",
+        )
+        self._observe(probe_succeeded=False)
+
+    def _record(self, rung: str, ok: bool, reason: str) -> None:
+        breaker = self.ladder.breakers[rung]
+        trips_before = breaker.trips
+        self.ladder.record(rung, ok)
+        if breaker.trips > trips_before:
+            BREAKER_TRIPS.inc(labels={"rung": rung})
+            self._publish(
+                REASON_SOLVER_DEGRADED,
+                f"solver {rung} rung opened after repeated failures"
+                + (f": {reason}" if reason else ""),
+            )
+        self._observe(probe_succeeded=ok)
+
+    def _level(self) -> int:
+        """Effective rung index from the composite gates (a quarantined
+        kernel takes the batched rung with it, which the raw ladder's
+        per-breaker view can't see)."""
+        if self.allow_batched():
+            return 0
+        if self.allow_kernel():
+            return 1
+        return 2
+
+    def _observe(self, probe_succeeded: bool) -> None:
+        """Refresh the rung gauge (it reports what the NEXT solve will
+        try, half-open probes included), but only announce a restore when
+        an actual probe SUCCEEDED — a cool-down lapsing merely admits a
+        probe, it proves nothing yet."""
+        level = self._level()
+        if probe_succeeded and level < self._last_level:
+            self._publish(
+                REASON_SOLVER_RESTORED,
+                f"solver re-probed upward to the {self.RUNGS[level]} rung",
+            )
+        # after a failed probe the observation-time half-open flip of an
+        # unrelated breaker must not lower the remembered level, or the
+        # NEXT success would miss its restore announcement
+        if probe_succeeded or level > self._last_level:
+            self._last_level = level
+        DEGRADATION_RUNG.set(float(level))
+
+    def _publish(self, reason: str, message: str) -> None:
+        if self.recorder is None:
+            return
+        from ..events import Event
+
+        self.recorder.publish(
+            Event(
+                object_uid="solver",
+                type=(
+                    "Normal" if reason == REASON_SOLVER_RESTORED
+                    else "Warning"
+                ),
+                reason=reason,
+                message=message,
+            )
+        )
+
+
+__all__ = [
+    "CircuitBreaker", "DegradationLadder", "SolverHealth",
+    "CLOSED", "OPEN", "HALF_OPEN",
+    "DEGRADATION_RUNG", "BREAKER_TRIPS", "QUARANTINES",
+]

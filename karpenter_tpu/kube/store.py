@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from .. import faults
 from .clock import Clock, RealClock
 
 ADDED = "ADDED"
@@ -54,13 +55,21 @@ def kind_of(obj) -> str:
 class Client:
     """Typed in-memory object store with watch + finalizer semantics."""
 
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(
+        self, clock: Optional[Clock] = None, fault_injection: bool = True
+    ):
         self._clock = clock or RealClock()
         self._objects: Dict[Tuple[str, str, str], object] = {}
         self._by_uid: Dict[str, Tuple[str, str, str]] = {}
         self._watchers: List[Callable[[Event], None]] = []
         self._lock = threading.RLock()
         self._rv = 0
+        # fault_injection=False exempts this store from the chaos seams:
+        # scratch stores (the solver's shipped-cluster-view rebuild in
+        # solver/service.py) model plain memory, not an apiserver — a
+        # store-chaos plan must not crash the very fallback path that
+        # exists to survive the injected outage
+        self._fault_injection = fault_injection
 
     # -- watch ------------------------------------------------------------
 
@@ -84,6 +93,10 @@ class Client:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj):
+        # chaos seam: a real apiserver returns transient 409s/timeouts;
+        # fault plans inject ConflictError/latency here (faults/)
+        if self._fault_injection:
+            faults.hit(faults.STORE_CREATE, kind=kind_of(obj))
         with self._lock:
             key = self._key(obj)
             if key in self._objects:
@@ -130,6 +143,8 @@ class Client:
         return out
 
     def update(self, obj):
+        if self._fault_injection:
+            faults.hit(faults.STORE_UPDATE, kind=kind_of(obj))
         with self._lock:
             key = self._key(obj)
             if key not in self._objects:
@@ -145,6 +160,8 @@ class Client:
 
     def delete(self, obj, grace_period: Optional[float] = None):
         """Two-phase delete honoring finalizers (apiserver semantics)."""
+        if self._fault_injection:
+            faults.hit(faults.STORE_DELETE, kind=kind_of(obj))
         with self._lock:
             key = self._key(obj)
             stored = self._objects.get(key)

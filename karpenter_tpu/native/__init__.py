@@ -20,6 +20,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from .. import faults
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "solve_core.cc")
 _LIB = os.path.join(_HERE, "libkt_solver.so")
@@ -55,6 +57,10 @@ def build(force: bool = False) -> str:
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is None:
+        # chaos seam: a missing toolchain / corrupt .so on a fresh host
+        # surfaces as NativeBuildError, which the solver's degradation
+        # ladder turns into an oracle fallback instead of a crashed solve
+        faults.hit(faults.NATIVE_LOAD)
         path = build()
         lib = ctypes.CDLL(path)
         lib.kt_solve.restype = ctypes.c_int

@@ -33,10 +33,18 @@ from .controllers.nodeclaim_disruption import (
 from .controllers.provisioning import Provisioner
 from .controllers.state import Cluster
 from .controllers.termination import TerminationController
-from .events import Recorder
+from .events import Event, REASON_RECONCILE_ERROR, Recorder
+from .faults.backoff import RetryTracker
+from .faults.breaker import SolverHealth
 from .kube import Client, Clock, RealClock
+from .metrics import Counter
 from .options import Options
 from .solver.driver import SolverConfig
+
+RECONCILE_ERRORS = Counter(
+    "controller_reconcile_errors_total",
+    "Reconcile passes that raised; requeued with backoff",
+)
 
 
 @dataclass
@@ -99,6 +107,22 @@ class Operator:
         self.cloud_provider = cloud_provider
         self.recorder = Recorder(self.clock)
         self.cluster = Cluster(client)
+        # the solver degradation ladder is operator-scoped: one SolverHealth
+        # survives the per-solve TpuSolver instances (provisioning AND
+        # disruption share it through the config), so breaker state and
+        # cool-downs apply to the solver path as a whole
+        solver_config = self.options.solver_config or SolverConfig()
+        if solver_config.health is None:
+            solver_config.health = SolverHealth(
+                self.clock, recorder=self.recorder
+            )
+        self.options.solver_config = solver_config
+        self.solver_health = solver_config.health
+        # crashed controller passes requeue with exponential backoff
+        # instead of hot-looping (or taking the whole roster down)
+        self._requeue = RetryTracker(
+            self.clock, initial=2.0, factor=2.0, max_delay=60.0
+        )
 
         self.provisioner = Provisioner(
             client,
@@ -155,11 +179,38 @@ class Operator:
             import jax
 
             jax.profiler.start_server(self.options.profiling_port)
-        except Exception:  # accelerator-less deployments still run
+        # analysis: ignore[RTY701] best-effort profiler: accelerator-less deployments run without it
+        except Exception:
             pass
 
     def is_leader(self) -> bool:
         return self.leader_elector is None or self.leader_elector.try_acquire()
+
+    def _guarded(self, name: str, fn, *args, **kwargs) -> None:
+        """Run one controller pass the way controller-runtime would: an
+        exception is recorded (metric + event) and the controller requeues
+        with exponential backoff instead of taking the roster down. The
+        level-triggered loop makes the skip safe — nothing is lost, the
+        next ready pass re-reads the store."""
+        if not self._requeue.ready(name):
+            return
+        try:
+            fn(*args, **kwargs)
+        except Exception as exc:
+            self._requeue.failure(name)
+            RECONCILE_ERRORS.inc(
+                labels={"controller": name, "error": type(exc).__name__}
+            )
+            self.recorder.publish(
+                Event(
+                    object_uid=f"controller/{name}",
+                    type="Warning",
+                    reason=REASON_RECONCILE_ERROR,
+                    message=f"{name}: {type(exc).__name__}: {exc}",
+                )
+            )
+            return
+        self._requeue.success(name)
 
     def step(self, force_provision: bool = False, force_disruption: bool = False) -> None:
         """One reconcile pass over the roster. Non-leader replicas keep
@@ -168,21 +219,31 @@ class Operator:
         if not self.is_leader():
             return
         if hasattr(self.cloud_provider, "process_registrations"):
-            self.cloud_provider.process_registrations()
-        self.provisioner.reconcile(force=force_provision)
-        self.lifecycle.reconcile_all()
-        self.termination.reconcile_all()
-        self.nodeclaim_disruption.reconcile_all()
-        self.nodepool_status.reconcile_all()
-        self.expiration.reconcile_all()
-        self.garbage_collection.reconcile()
+            self._guarded(
+                "registrations", self.cloud_provider.process_registrations
+            )
+        self._guarded(
+            "provisioner", self.provisioner.reconcile, force=force_provision
+        )
+        self._guarded("lifecycle", self.lifecycle.reconcile_all)
+        self._guarded("termination", self.termination.reconcile_all)
+        self._guarded(
+            "nodeclaim_disruption", self.nodeclaim_disruption.reconcile_all
+        )
+        self._guarded("nodepool_status", self.nodepool_status.reconcile_all)
+        self._guarded("expiration", self.expiration.reconcile_all)
+        self._guarded(
+            "garbage_collection", self.garbage_collection.reconcile
+        )
         if self.options.node_repair:
-            self.health.reconcile_all()
-        self.consistency.reconcile_all()
-        self.disruption.reconcile(force=force_disruption)
-        self.node_metrics.reconcile_all()
-        self.nodepool_metrics.reconcile_all()
-        self.pod_metrics.reconcile_all()
+            self._guarded("health", self.health.reconcile_all)
+        self._guarded("consistency", self.consistency.reconcile_all)
+        self._guarded(
+            "disruption", self.disruption.reconcile, force=force_disruption
+        )
+        self._guarded("node_metrics", self.node_metrics.reconcile_all)
+        self._guarded("nodepool_metrics", self.nodepool_metrics.reconcile_all)
+        self._guarded("pod_metrics", self.pod_metrics.reconcile_all)
 
     def run(self, duration: float, tick: float = 1.0) -> None:
         """Advance simulated time, stepping each tick (TestClock only)."""

@@ -295,3 +295,41 @@ solve_all_scenarios_packed = jax.jit(
         "tile_feasibility", "wf_iters", "fills_dtype",
     ),
 )
+
+
+# -- fault seam -------------------------------------------------------------
+#
+# The jitted kernels stay pure; chaos testing (faults/) hooks the HOST side
+# of each dispatch through these thin wrappers — an error site before the
+# call (the tunnel/compile-cache failure shape) and a mutation site on the
+# outputs (the garbage-solve shape the invariant guard in faults/guard.py
+# must catch). With no injector installed each wrapper costs one global
+# None check and returns the kernel outputs untouched (byte-identical,
+# pinned by tests/test_faults.py).
+
+from .. import faults  # noqa: E402  (after the jitted kernels they wrap)
+
+
+def dispatch_packed(*args, **kw):
+    faults.hit(faults.SOLVER_DISPATCH, kernel="pack")
+    return faults.mutate(
+        faults.SOLVER_OUTPUT, solve_all_packed(*args, **kw), kernel="pack"
+    )
+
+
+def dispatch_classed_packed(*args, **kw):
+    faults.hit(faults.SOLVER_DISPATCH, kernel="pack_classed")
+    return faults.mutate(
+        faults.SOLVER_OUTPUT,
+        solve_all_classed_packed(*args, **kw),
+        kernel="pack_classed",
+    )
+
+
+def dispatch_scenarios_packed(*args, **kw):
+    faults.hit(faults.SOLVER_SCENARIOS, kernel="scenarios")
+    return faults.mutate(
+        faults.SOLVER_OUTPUT,
+        solve_all_scenarios_packed(*args, **kw),
+        kernel="scenarios",
+    )

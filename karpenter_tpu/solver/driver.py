@@ -28,6 +28,11 @@ from ..api import resources as res
 from ..api.objects import NodePool, Pod
 from ..api.requirements import Operator, Requirement, Requirements
 from ..cloudprovider import types as cp
+from ..faults.guard import (
+    DecodeCommitError,
+    SolverIntegrityError,
+    check_solution,
+)
 from ..scheduling.inflight import RESERVED_OFFERING_MODE_STRICT
 from ..scheduling.scheduler import Results, Scheduler
 from ..scheduling.template import NodeClaimTemplate
@@ -140,6 +145,14 @@ class SolverConfig:
     # pods into ~1.9k groups sharing ~30 classes). None = auto-route when
     # the mean class size crosses _CLASSED_MIN_MEAN_SIZE; True/False force.
     classed: Optional[bool] = None
+    # shared degradation ladder (faults/breaker.py:SolverHealth): gates the
+    # batched/kernel rungs, absorbs dispatch failures and invariant-guard
+    # quarantines into oracle fallbacks. None (the default, and every
+    # direct-test construction) keeps the old contract: kernel errors
+    # propagate to the caller.
+    health: Optional[object] = None
+    # per-call gRPC deadline for RemoteSolver dispatches (seconds)
+    solve_deadline: float = 30.0
 
 
 def _clone_existing_node(en):
@@ -225,6 +238,11 @@ class TpuSolver:
     def solve(self, pods: Sequence[Pod]) -> Results:
         if self.config.force_oracle:
             return self.oracle.solve(pods)
+        health = self.config.health
+        if health is not None and not health.allow_kernel():
+            # kernel rung is open (tripped breaker / quarantine cool-down):
+            # the oracle rung is always available and exact
+            return self.oracle.solve(pods)
         if (
             self.oracle.reserved_capacity_enabled
             and self.oracle.reserved_offering_mode
@@ -266,7 +284,43 @@ class TpuSolver:
         tpu_claims: List[DecodedClaim] = []
         tpu_errors: Dict[str, object] = {}
         if groups:
-            tpu_claims, tpu_errors = self._solve_fast(groups)
+            try:
+                tpu_claims, tpu_errors = self._solve_fast(groups)
+            except SolverIntegrityError as exc:
+                # the invariant guard runs on the RAW kernel outputs, before
+                # any decode — nothing was committed, so the whole batch
+                # re-solves host-side while the kernel rung sits quarantined
+                if health is None:
+                    raise
+                health.quarantine("kernel", str(exc))
+                return self.oracle.solve(pods)
+            except DecodeCommitError as exc:
+                # decode crashed AFTER fills landed on the live node
+                # models: an oracle re-solve HERE would double-count them,
+                # so drop the whole batch — pods stay pending and the next
+                # cycle re-solves on a fresh solver with clean models
+                if health is None:
+                    raise
+                health.quarantine("kernel", str(exc))
+                return Results(
+                    new_node_claims=[],
+                    existing_nodes=[],
+                    pod_errors={
+                        p.uid: "solver decode aborted mid-commit; "
+                        "batch re-queued" for p in pods
+                    },
+                )
+            except Exception as exc:
+                # dispatch/backend failure (XLA error, native load failure,
+                # injected fault): count toward the breaker and degrade
+                if health is None:
+                    raise
+                health.record_kernel(
+                    False, reason=f"{type(exc).__name__}: {exc}"
+                )
+                return self.oracle.solve(pods)
+            if health is not None:
+                health.record_kernel(True)
             # the oracle's ReservationManager must see the fast path's
             # holdings before it solves the remainder, or a mixed batch
             # double-books reservation capacity
@@ -338,6 +392,11 @@ class TpuSolver:
         if not scenarios:
             return []
         if self.config.force_oracle or self.config.backend != "tpu":
+            return None
+        health = self.config.health
+        if health is not None and not health.allow_batched():
+            # batched rung is open: callers fall back to per-probe solves
+            # (themselves ladder-gated) — rung 2 of the degradation ladder
             return None
         if self._resolve_mesh() is not None:
             return None
@@ -441,24 +500,52 @@ class TpuSolver:
         import jax
         import jax.numpy as jnp
 
-        from ..ops.solve import solve_all_scenarios_packed
+        from ..ops.solve import dispatch_scenarios_packed
 
         fills_dtype = (
             jnp.int16 if self._fill_bound(snap, fit) < 2**15 else jnp.int32
         )
         dispatches = 0
-        while True:
-            out = solve_all_scenarios_packed(
-                *args, nmax=nmax, fills_dtype=fills_dtype, **statics
+        try:
+            while True:
+                out = dispatch_scenarios_packed(
+                    *args, nmax=nmax, fills_dtype=fills_dtype, **statics
+                )
+                (c_pool, packed, n_open, overflow,
+                 exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                 c_resv) = [np.asarray(x) for x in jax.device_get(out)]
+                dispatches += 1
+                if not overflow.any():
+                    break
+                nmax *= 2
+        except Exception as exc:
+            # batched dispatch failed mid-search: nothing decoded, nothing
+            # committed — record the rung failure and decline, so the
+            # caller replays per-probe (the documented fallback contract)
+            if health is None:
+                raise
+            health.record_batched(
+                False, reason=f"{type(exc).__name__}: {exc}"
             )
-            (c_pool, packed, n_open, overflow,
-             exist_fills, claim_fills, unplaced, c_dzone, c_dct,
-             c_resv) = [np.asarray(x) for x in jax.device_get(out)]
-            dispatches += 1
-            if not overflow.any():
-                break
-            nmax *= 2
+            return None
         self.last_scenario_dispatches = dispatches
+        # invariant guard per scenario, still pre-decode: one corrupt
+        # scenario poisons the whole batch (they share one dispatch)
+        try:
+            for si in range(S_real):
+                self._verify_solution(
+                    snap, snap_run, c_pool[si], packed[si], int(n_open[si]),
+                    exist_fills[si], claim_fills[si], unplaced[si], nmax,
+                    g_count=g_count_s[si],
+                    c_dzone=c_dzone[si], c_dct=c_dct[si],
+                )
+        except SolverIntegrityError as exc:
+            if health is None:
+                raise
+            health.quarantine("batched", str(exc))
+            return None
+        if health is not None:
+            health.record_batched(True)
         if self.config.max_claims is None and S_real:
             with self._shared_cache.lock:
                 lease_cache["nmax_hint"] = max(
@@ -467,35 +554,46 @@ class TpuSolver:
                 )
 
         results: List[Results] = []
-        for si in range(S_real):
-            # fills commit onto per-scenario node clones so scenarios never
-            # observe each other's placements (only touched nodes clone;
-            # the rest share the untouched oracle models)
-            nodes = list(self.oracle.existing_nodes)
-            for ni in np.nonzero(exist_fills[si].any(axis=0))[0]:
-                if ni < len(nodes):
-                    nodes[ni] = _clone_existing_node(nodes[ni])
-            claims, errors = self._decode(
-                snap,
-                c_pool[si].astype(np.int32),
-                packed[si],
-                int(n_open[si]),
-                exist_fills[si].astype(np.int32),
-                claim_fills[si].astype(np.int32),
-                unplaced[si],
-                c_dzone[si].astype(np.int32),
-                c_dct[si].astype(np.int32),
-                c_resv[si].astype(bool),
-                group_pods=scen_group_pods[si],
-                existing_nodes=nodes,
-            )
-            results.append(
-                Results(
-                    new_node_claims=claims,
+        try:
+            for si in range(S_real):
+                # fills commit onto per-scenario node clones so scenarios
+                # never observe each other's placements (only touched nodes
+                # clone; the rest share the untouched oracle models)
+                nodes = list(self.oracle.existing_nodes)
+                for ni in np.nonzero(exist_fills[si].any(axis=0))[0]:
+                    if ni < len(nodes):
+                        nodes[ni] = _clone_existing_node(nodes[ni])
+                claims, errors = self._decode(
+                    snap,
+                    c_pool[si].astype(np.int32),
+                    packed[si],
+                    int(n_open[si]),
+                    exist_fills[si].astype(np.int32),
+                    claim_fills[si].astype(np.int32),
+                    unplaced[si],
+                    c_dzone[si].astype(np.int32),
+                    c_dct[si].astype(np.int32),
+                    c_resv[si].astype(bool),
+                    group_pods=scen_group_pods[si],
                     existing_nodes=nodes,
-                    pod_errors=errors,
-                ).truncate_instance_types()
+                )
+                results.append(
+                    Results(
+                        new_node_claims=claims,
+                        existing_nodes=nodes,
+                        pod_errors=errors,
+                    ).truncate_instance_types()
+                )
+        except Exception as exc:
+            # scenario decode commits onto clones, so a crash pollutes
+            # nothing shared — decline the batch and let the caller replay
+            # per-probe (which re-guards and re-decodes independently)
+            if health is None:
+                raise
+            health.record_batched(
+                False, reason=f"{type(exc).__name__}: {exc}"
             )
+            return None
         return results
 
     # -- fast path --------------------------------------------------------
@@ -578,7 +676,10 @@ class TpuSolver:
             import jax
             import jax.numpy as jnp
 
-            from ..ops.solve import solve_all_classed_packed, solve_all_packed
+            from ..ops.solve import (
+                dispatch_classed_packed,
+                dispatch_packed,
+            )
 
             # args ride WITH the dispatch (no separate device_put leg: the
             # tunnel charges fixed latency per RPC, and jit transfers host
@@ -596,12 +697,12 @@ class TpuSolver:
             def call(nmax):
                 if classed_args is not None:
                     cls_arrays, lmax = classed_args
-                    out = solve_all_classed_packed(
+                    out = dispatch_classed_packed(
                         *args, *cls_arrays, nmax=nmax, lmax=lmax,
                         fills_dtype=fills_dtype, **statics,
                     )
                 else:
-                    out = solve_all_packed(
+                    out = dispatch_packed(
                         *args, nmax=nmax, fills_dtype=fills_dtype, **statics
                     )
                 (c_pool, packed, n_open, overflow,
@@ -633,15 +734,76 @@ class TpuSolver:
             if not overflow:
                 break
             nmax *= 2
+        # invariant guard BEFORE decode: a violating solve is discarded
+        # with zero state mutated (faults/guard.py — conservation,
+        # capacity, pool limits, domain-pin ranges), so the oracle
+        # fallback is exact
+        self._verify_solution(
+            snap, snap_run, c_pool, c_tmask, int(n_open),
+            exist_fills, claim_fills, unplaced, nmax,
+            c_dzone=c_dzone, c_dct=c_dct,
+        )
         if self.config.max_claims is None:
             with self._shared_cache.lock:
                 lease_cache["nmax_hint"] = max(
                     lease_cache.get("nmax_hint", 0), int(n_open)
                 )
-        return self._decode(
-            snap, c_pool, c_tmask, int(n_open), exist_fills, claim_fills,
-            unplaced, c_dzone, c_dct, c_resv,
+        try:
+            return self._decode(
+                snap, c_pool, c_tmask, int(n_open), exist_fills,
+                claim_fills, unplaced, c_dzone, c_dct, c_resv,
+            )
+        except Exception as exc:
+            # decode mutates the live existing-node models as it walks
+            # (driver._decode); a crash here may have HALF-committed —
+            # flag it so solve() drops the batch instead of re-solving
+            # over the polluted models (pods re-queue on a fresh solver)
+            raise DecodeCommitError(
+                f"decode aborted mid-commit: {type(exc).__name__}: {exc}"
+            ) from exc
+
+    @staticmethod
+    def _vocab_bound(snap, kid: int) -> int:
+        """Valid value-id bound for a vocab key id (0 when absent)."""
+        if 0 <= kid < len(snap.vocab.values):
+            return len(snap.vocab.values[kid])
+        return 0
+
+    def _verify_solution(
+        self, snap, snap_run, c_pool, c_tmask, n_open,
+        exist_fills, claim_fills, unplaced, nmax, g_count=None,
+        c_dzone=None, c_dct=None,
+    ) -> None:
+        """Raise SolverIntegrityError if the raw kernel outputs violate a
+        post-solve invariant. Runs on every solve (a few small host
+        matmuls); the caller quarantines the kernel rung on failure.
+        ``g_count`` overrides the run snapshot's counts for scenario
+        fan-out, where each scenario activates its own subset."""
+        violations = check_solution(
+            g_count=snap_run.g_count if g_count is None else g_count,
+            g_req=snap_run.g_req,
+            c_pool=c_pool,
+            c_tmask=c_tmask,
+            n_open=n_open,
+            exist_fills=exist_fills,
+            claim_fills=claim_fills,
+            unplaced=unplaced,
+            t_alloc=snap.t_alloc,
+            n_avail=snap.n_avail,
+            nmax=nmax,
+            P=len(snap.templates),
+            templates_pool=[
+                nct.node_pool_name for nct in snap.templates
+            ],
+            p_limit=snap.p_limit,
+            p_has_limit=snap.p_has_limit,
+            c_dzone=c_dzone,
+            c_dct=c_dct,
+            zone_vals=self._vocab_bound(snap, snap.zone_kid),
+            ct_vals=self._vocab_bound(snap, snap.ct_kid),
         )
+        if violations:
+            raise SolverIntegrityError(violations)
 
     def _encode_batch(self, groups: List[enc.PodGroup]):
         """Encode ``groups`` against the shared cache. Returns

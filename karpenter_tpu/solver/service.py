@@ -15,12 +15,15 @@ wire.py — no generated stubs, one method:
 
 from __future__ import annotations
 
+import copy
+import logging
 from concurrent import futures
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 import grpc
 
+from .. import faults
 from ..api.objects import NodePool, Pod
 from ..cloudprovider import types as cp
 from ..kube import Client, TestClock
@@ -29,32 +32,81 @@ from ..scheduling.topology import Topology
 from . import wire
 from .driver import DecodedClaim, EncodeCache, SolverConfig, TpuSolver
 
+_LOG = logging.getLogger("karpenter_tpu.solver.service")
+
 # one process-wide cache: the sidecar serves many solves of one catalog
 _SIDECAR_ENCODE_CACHE = EncodeCache()
 
 SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
 SOLVE_METHOD = f"/{SERVICE_NAME}/Solve"
 
+# gRPC status codes that mean "the sidecar may answer if asked again" —
+# RemoteSolver retries these once, then degrades to an in-process solve
+RETRIABLE_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
 
-def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
-    snap = wire.decode_solve_request(data)
-    pods: List[Pod] = snap["pods"]
-    node_pools: List[NodePool] = snap["node_pools"]
-    instance_types = snap["instance_types"]
-    daemonset_pods = snap["daemonset_pods"]
-    state_nodes = snap["state_nodes"]
-    # rebuild the controller's cluster view: state nodes pack FIRST
-    # (scheduler.go:357-425), their bound pods feed the topology priors and
-    # inverse anti-affinity gates, and PVC/PV/StorageClass objects let the
-    # VolumeResolver answer identically — so the scratch client holds them
-    scratch = Client(TestClock())
+
+class InjectedRpcError(grpc.RpcError):
+    """Fault-injection stand-in for a channel-level RPC failure, carrying
+    a status code the way a real ``grpc.Call`` error does. Fault plans
+    raise this at the ``faults.REMOTE_SOLVE`` site."""
+
+    def __init__(self, code: "grpc.StatusCode"):
+        super().__init__(f"injected rpc failure: {code}")
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+def _status_name(exc: "grpc.RpcError") -> str:
+    """The status-code name of an RpcError ("UNAVAILABLE", ...), tolerant
+    of both real channel errors and injected ones."""
+    code = getattr(exc, "code", None)
+    if callable(code):
+        try:
+            code = code()
+        except Exception:
+            return ""
+    return getattr(code, "name", str(code) if code is not None else "")
+
+
+def build_solver(
+    pods: Sequence[Pod],
+    node_pools: Sequence[NodePool],
+    instance_types,
+    daemonset_pods: Sequence[Pod],
+    state_nodes: Sequence,
+    volume_objects,
+    reserved_capacity_enabled: bool,
+    config: Optional[SolverConfig] = None,
+    encode_cache: Optional[EncodeCache] = None,
+    copy_objects: bool = False,
+) -> TpuSolver:
+    """The one recipe for a solver over a shipped cluster view — used by
+    the sidecar for every request and by RemoteSolver's in-process
+    fallback, so the two can never pack differently.
+
+    Rebuilds the controller's cluster view: state nodes pack FIRST
+    (scheduler.go:357-425), their bound pods feed the topology priors and
+    inverse anti-affinity gates, and PVC/PV/StorageClass objects let the
+    VolumeResolver answer identically — so the scratch client holds them.
+    ``copy_objects`` deep-copies objects into the scratch store (the
+    fallback path feeds LIVE controller objects, and the scratch create
+    must not bump their resource versions). The scratch store is plain
+    memory, not an apiserver — store-chaos plans are exempted so an
+    injected store outage can't crash the fallback built to survive it."""
+    scratch = Client(TestClock(), fault_injection=False)
+
+    def _add(obj):
+        scratch.create(copy.deepcopy(obj) if copy_objects else obj)
+
     for sn in state_nodes:
         if sn.node is not None:
-            scratch.create(sn.node)
+            _add(sn.node)
         for p in sn.pods:
-            scratch.create(p)
-    for vo in snap["volume_objects"] or ():
-        scratch.create(vo)
+            _add(p)
+    for vo in volume_objects or ():
+        _add(vo)
     topology = Topology(scratch, state_nodes, node_pools, instance_types, pods)
     from ..scheduling.volumeusage import VolumeResolver
 
@@ -62,10 +114,8 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
     # []) never ship PVC/PV objects; resolving against the empty scratch
     # store would fail every PVC-bearing pod, so keep the old no-resolver
     # behavior for them
-    resolver = (
-        VolumeResolver(scratch) if snap["volume_objects"] is not None else None
-    )
-    solver = TpuSolver(
+    resolver = VolumeResolver(scratch) if volume_objects is not None else None
+    return TpuSolver(
         node_pools,
         instance_types,
         topology,
@@ -75,12 +125,30 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
         config=config,
         # catalog encode amortizes across requests; the cache's lock
         # serializes the host-side encode under the gRPC thread pool
-        encode_cache=_SIDECAR_ENCODE_CACHE,
+        encode_cache=encode_cache,
+        reserved_capacity_enabled=reserved_capacity_enabled,
+    )
+
+
+def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
+    return _solve_decoded(wire.decode_solve_request(data), config)
+
+
+def _solve_decoded(snap: dict, config: Optional[SolverConfig]) -> bytes:
+    pods: List[Pod] = snap["pods"]
+    state_nodes = snap["state_nodes"]
+    solver = build_solver(
+        pods,
+        snap["node_pools"],
+        snap["instance_types"],
+        snap["daemonset_pods"],
+        state_nodes,
+        snap["volume_objects"],
         # behavior knobs travel in the snapshot so controller and sidecar
         # can never disagree on gate-dependent packing
-        reserved_capacity_enabled=bool(
-            snap["solver_options"].get("reserved_capacity_enabled", False)
-        ),
+        bool(snap["solver_options"].get("reserved_capacity_enabled", False)),
+        config=config,
+        encode_cache=_SIDECAR_ENCODE_CACHE,
     )
     results = solver.solve(pods)
     return wire.encode_solve_response(
@@ -89,16 +157,39 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
 
 
 class SolverService(grpc.GenericRpcHandler):
-    """Generic unary handler for the Solve method."""
+    """Generic unary handler for the Solve method.
+
+    Exceptions map to proper gRPC status codes instead of crashing the
+    stream through the generic handler: a request the codec cannot decode
+    is the CLIENT's bug (INVALID_ARGUMENT — retrying it can never help),
+    while a solve that raises is the sidecar's (INTERNAL, retriable by
+    policy). RemoteSolver keys its retry/fallback ladder off these."""
 
     def __init__(self, config: Optional[SolverConfig] = None):
         self.config = config
+
+    def _handle(self, request, context):
+        try:
+            snap = wire.decode_solve_request(request)
+        except Exception as exc:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"malformed solve request: {type(exc).__name__}: {exc}",
+            )
+        try:
+            return _solve_decoded(snap, self.config)
+        except Exception as exc:
+            _LOG.exception("solve failed")
+            context.abort(
+                grpc.StatusCode.INTERNAL,
+                f"solve failed: {type(exc).__name__}: {exc}",
+            )
 
     def service(self, handler_call_details):
         if handler_call_details.method != SOLVE_METHOD:
             return None
         return grpc.unary_unary_rpc_method_handler(
-            lambda request, context: _solve_snapshot(request, self.config),
+            self._handle,
             request_deserializer=None,  # raw bytes
             response_serializer=None,
         )
@@ -137,7 +228,15 @@ class RemoteSolver:
     capacity first exactly like the in-process solve — without them a
     non-empty cluster over-provisions every batch. Pass the PVC/PV/
     StorageClass objects pending pods reference (``volume_objects``) so
-    CSI attach-limit checks match too."""
+    CSI attach-limit checks match too.
+
+    Every dispatch carries a deadline (``SolverConfig.solve_deadline``
+    when a config is given, else ``timeout``). UNAVAILABLE and
+    DEADLINE_EXCEEDED get exactly one retry; if the sidecar still doesn't
+    answer, the solve degrades to an IN-PROCESS run over the same shipped
+    cluster view (``build_solver`` — the sidecar's own recipe), so a gRPC
+    outage slows a reconcile instead of failing it. Any other status
+    (catalog skew, malformed request) propagates: retrying those lies."""
 
     def __init__(
         self,
@@ -150,21 +249,71 @@ class RemoteSolver:
         reserved_capacity_enabled: bool = False,
         state_nodes: Sequence = (),
         volume_objects: Sequence = (),
+        config: Optional[SolverConfig] = None,
+        encode_cache: Optional[EncodeCache] = None,
     ):
         self._channel = channel or grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(SOLVE_METHOD)
-        self.timeout = timeout
+        self.config = config
+        self.timeout = (
+            config.solve_deadline if config is not None else timeout
+        )
         self.reserved_capacity_enabled = reserved_capacity_enabled
         self.node_pools = list(node_pools)
         self.instance_types = instance_types
         self.daemonset_pods = list(daemonset_pods)
         self.state_nodes = list(state_nodes)
         self.volume_objects = list(volume_objects)
+        self.fallback_solves = 0  # telemetry: in-process degradations
+        # a sidecar outage makes EVERY reconcile fall back — amortize the
+        # host-side catalog encode across those solves. Callers that build
+        # a RemoteSolver per cycle (the Provisioner does) must pass their
+        # long-lived cache; the per-instance default still de-dups repeat
+        # solves on one instance
+        self._fallback_cache = encode_cache or EncodeCache()
         self._pools_by_name = {np_.name: np_ for np_ in self.node_pools}
         self._types_by_pool = {
             pool: {it.name: it for it in its}
             for pool, its in instance_types.items()
         }
+
+    def _dispatch(self, request: bytes) -> Optional[bytes]:
+        """The raw RPC with one bounded retry on retriable status codes;
+        None when the sidecar is out (callers degrade in-process)."""
+        for attempt in range(2):
+            try:
+                # chaos seam: plans raise InjectedRpcError here to model
+                # channel outages and deadline blowouts
+                faults.hit(faults.REMOTE_SOLVE, attempt=attempt)
+                return self._solve(request, timeout=self.timeout)
+            except grpc.RpcError as exc:
+                code = _status_name(exc)
+                if code not in RETRIABLE_CODES:
+                    raise
+                _LOG.warning(
+                    "solver sidecar dispatch failed with %s (attempt %d)",
+                    code, attempt + 1,
+                )
+        return None
+
+    def _solve_in_process(self, pods: Sequence[Pod]) -> Results:
+        """Degraded rung: the sidecar is unreachable, so run the identical
+        solve locally from the parts the request was built from."""
+        self.fallback_solves += 1
+        solver = build_solver(
+            pods,
+            self.node_pools,
+            self.instance_types,
+            self.daemonset_pods,
+            self.state_nodes,
+            self.volume_objects,
+            self.reserved_capacity_enabled,
+            config=self.config,
+            encode_cache=self._fallback_cache,
+            # live controller objects: never bump their resource versions
+            copy_objects=True,
+        )
+        return solver.solve(pods)
 
     def solve(self, pods: Sequence[Pod]) -> Results:
         from ..scheduling.template import NodeClaimTemplate
@@ -180,9 +329,10 @@ class RemoteSolver:
             state_nodes=self.state_nodes,
             volume_objects=self.volume_objects,
         )
-        response = wire.decode_solve_response(
-            self._solve(request, timeout=self.timeout)
-        )
+        raw = self._dispatch(request)
+        if raw is None:
+            return self._solve_in_process(pods)
+        response = wire.decode_solve_response(raw)
         if self.state_nodes and response.get("state_nodes_packed") != len(
             self.state_nodes
         ):
@@ -241,7 +391,8 @@ class RemoteSolver:
 
 __all__ = [
     "SOLVE_METHOD", "SolverService", "serve", "RemoteSolver",
-    "RemoteExistingNode",
+    "RemoteExistingNode", "InjectedRpcError", "build_solver",
+    "RETRIABLE_CODES",
 ]
 
 

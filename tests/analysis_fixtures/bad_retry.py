@@ -1,0 +1,41 @@
+"""Seeded-bad fixture for the retry pass (RTY7xx): every anti-pattern the
+rules exist for, in the shapes they take in reconcile code."""
+
+
+def swallow_broad(items):
+    for it in items:
+        try:
+            it.sync()
+        except Exception:  # RTY701: the failure vanishes
+            pass
+
+
+def swallow_bare(obj):
+    try:
+        obj.delete()
+    except:  # noqa: E722  RTY701: bare except, body only pass
+        pass
+
+
+def swallow_continue(items):
+    for it in items:
+        try:
+            it.reconcile()
+        except BaseException:  # RTY701: continue-only body
+            continue
+
+
+def spin_forever(fn):
+    while True:  # RTY702: no counter, no backoff, no clock, no escape
+        try:
+            return fn()
+        except Exception:
+            continue
+
+
+def spin_forever_fallthrough(fn, log):
+    while True:  # RTY702: handler records but the loop never bounds
+        try:
+            return fn()
+        except OSError as exc:
+            log.append(exc)

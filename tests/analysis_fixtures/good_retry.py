@@ -1,0 +1,48 @@
+"""Clean twin of bad_retry.py: the designed idioms the retry pass must
+stay silent on — typed transient catches, recorded failures, and bounded
+clock-driven retry loops."""
+
+
+class ConflictError(ValueError):
+    pass
+
+
+def typed_skip(items):
+    # typed transient absorbed by the level-triggered loop: NOT flagged —
+    # the type documents exactly which failure requeues
+    for it in items:
+        try:
+            it.reconcile()
+        except ConflictError:
+            continue
+
+
+def recorded_broad(items, recorder):
+    # broad catch is fine when the failure is surfaced, not swallowed
+    for it in items:
+        try:
+            it.sync()
+        except Exception as exc:
+            recorder.publish(exc)
+
+
+def bounded_retry(fn, clock, backoff):
+    # the Backoff.call shape: attempt counter + clock-driven sleep
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except TimeoutError:
+            attempt += 1
+            if attempt >= 3:
+                raise
+            clock.sleep(backoff.delay(attempt - 1))
+
+
+def doubling_probe(call, nmax):
+    # the driver's overflow-doubling loop: no except handler at all
+    while True:
+        out, overflow = call(nmax)
+        if not overflow:
+            return out
+        nmax *= 2

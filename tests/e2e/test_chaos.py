@@ -1,0 +1,243 @@
+"""Chaos soak: the whole operator roster under seeded fault plans.
+
+The acceptance contract (ISSUE 5): under injected solver crashes, corrupt
+solves, provider insufficient-capacity, registration stalls, and store
+conflicts, the operator
+
+- never commits an invariant-violating solve (checked every tick: no node
+  holds more than its allocatable),
+- never orphans a NodeClaim and never double-deletes a cloud instance,
+- converges to the fault-free fixed point within a bounded number of
+  ticks once faults clear,
+
+and the whole run REPLAYS: same seed, same fault schedule, same outcome
+(faults/__init__.py's determinism contract).
+
+The fast tests here are the presubmit chaos smoke
+(``pytest tests/e2e -k chaos -m 'not slow'``); the long soak is marked
+``slow`` so tier-1 wall time is unchanged.
+"""
+
+import sys
+from collections import Counter
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # tests/ for helpers
+
+from karpenter_tpu import faults
+from karpenter_tpu.api import resources as res
+from karpenter_tpu.api.objects import COND_INITIALIZED, Node, NodeClaim, Pod
+from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+from karpenter_tpu.kube.store import ConflictError
+from karpenter_tpu.utils import pod as pod_utils
+
+from e2e.harness import Scenario, record
+from helpers import make_nodepool, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.uninstall()
+
+
+def _operator_kinds(ctx):
+    # only the kinds the OPERATOR writes: the test harness (deployment
+    # sim, binder) writes Pods, and a fault crashing the harness itself
+    # would test pytest, not the control plane
+    return ctx.get("kind") in ("NodeClaim", "Node")
+
+
+def chaos_rules(until):
+    return [
+        faults.FaultRule(
+            faults.STORE_CREATE, probability=0.15, until=until,
+            error=lambda: ConflictError("injected store conflict"),
+            match=_operator_kinds,
+        ),
+        faults.FaultRule(
+            faults.STORE_UPDATE, probability=0.05, until=until,
+            error=lambda: ConflictError("injected store conflict"),
+            match=_operator_kinds,
+        ),
+        faults.FaultRule(
+            faults.STORE_DELETE, probability=0.05, until=until,
+            error=lambda: ConflictError("injected store conflict"),
+            match=_operator_kinds,
+        ),
+        faults.FaultRule(
+            faults.PROVIDER_CREATE, probability=0.2, until=until,
+            error=lambda: InsufficientCapacityError("injected ICE"),
+        ),
+        faults.FaultRule(
+            faults.PROVIDER_REGISTER, probability=0.3, until=until,
+        ),
+        faults.FaultRule(
+            faults.SOLVER_DISPATCH, probability=0.15, until=until,
+        ),
+    ]
+
+
+def _assert_no_overcommit(s):
+    """The invariant an invariant-violating commit would break: no node
+    ever holds more than its allocatable."""
+    pods = s.client.list(Pod)
+    for node in s.client.list(Node):
+        total = res.merge(
+            *(
+                p.spec.requests
+                for p in pods
+                if p.spec.node_name == node.name and pod_utils.is_active(p)
+            )
+        ) if any(p.spec.node_name == node.name for p in pods) else {}
+        assert res.fits(total, node.status.allocatable), (
+            f"node {node.name} overcommitted: {total} > "
+            f"{node.status.allocatable}"
+        )
+
+
+def _count_successful_deletes(provider):
+    """Instrument the provider: successful instance deletions per id."""
+    successes = Counter()
+    orig = provider.delete
+
+    def counting_delete(claim):
+        out = orig(claim)
+        successes[claim.status.provider_id] += 1
+        return out
+
+    provider.delete = counting_delete
+    return successes
+
+
+def run_chaos(seed, replicas=40, fault_ticks=20, converge_ticks=400,
+              rules=chaos_rules, record_as=None):
+    s = Scenario()
+    s.client.create(make_nodepool())
+    dep = s.deployment(
+        "chaos", replicas, lambda: make_pod(cpu="1", memory="2Gi")
+    )
+    deletes = _count_successful_deletes(s.provider)
+    until = s.clock.now() + fault_ticks
+    injector = faults.install(
+        faults.FaultInjector(rules(until), seed=seed, clock=s.clock)
+    )
+    s.timer.start("chaos")
+    for _ in range(fault_ticks):
+        s.tick()
+        _assert_no_overcommit(s)
+    s.timer.end("chaos", fired=injector.fired())
+    injector.clear()  # faults over (the until deadline also passed)
+
+    def converged():
+        _assert_no_overcommit(s)
+        return (
+            dep.all_bound()
+            and s.monitor.pending_pod_count() == 0
+            and all(
+                c.conds().is_true(COND_INITIALIZED)
+                for c in s.client.list(NodeClaim)
+            )
+        )
+
+    s.timer.start("converge")
+    ticks = s.run_until(converged, converge_ticks, "post-chaos convergence")
+    s.timer.end("converge", ticks=ticks)
+
+    # no orphans in either direction: every claim has a live instance and
+    # a node, every instance has a claim
+    claims = s.client.list(NodeClaim)
+    claim_pids = {c.status.provider_id for c in claims}
+    cloud_pids = {c.status.provider_id for c in s.provider.list()}
+    assert claim_pids == cloud_pids, (claim_pids, cloud_pids)
+    node_pids = {n.provider_id for n in s.client.list(Node)}
+    assert claim_pids <= node_pids
+    # no double-deletes: no instance was successfully deleted twice
+    doubles = {pid: n for pid, n in deletes.items() if n > 1}
+    assert not doubles, doubles
+    if record_as:
+        record(record_as, s.timer, faults_fired=injector.fired())
+    return s, dep, injector
+
+
+class TestChaosSmoke:
+    def test_chaos_soak_converges_no_orphans(self):
+        s, dep, injector = run_chaos(seed=11, record_as="chaos_smoke")
+        assert injector.fired() > 0  # the plan actually bit
+        assert dep.bound_count() == dep.replicas
+
+    def test_chaos_replay_is_deterministic(self):
+        _, _, a = run_chaos(seed=23, replicas=25, fault_ticks=12)
+        faults.uninstall()
+        _, _, b = run_chaos(seed=23, replicas=25, fault_ticks=12)
+        assert a.log == b.log
+        assert a.log  # non-trivial schedule
+        faults.uninstall()
+        _, _, c = run_chaos(seed=24, replicas=25, fault_ticks=12)
+        assert c.log != a.log  # the seed is the schedule
+
+    def test_chaos_corrupt_solve_quarantined_then_recovers(self):
+        """A kernel emitting garbage: the guard quarantines it (the bad
+        solve is never committed), the batch lands via the oracle rung,
+        and after the cool-down the ladder re-probes upward."""
+
+        def corrupt(outs):
+            import numpy as np
+
+            outs = list(outs)
+            outs[5] = np.asarray(outs[5]) - 7  # negative claim fills
+            return tuple(outs)
+
+        def rules(until):
+            return [
+                faults.FaultRule(
+                    faults.SOLVER_OUTPUT, mutate=corrupt, times=2,
+                )
+            ]
+
+        s, dep, injector = run_chaos(
+            seed=5, replicas=30, fault_ticks=10, rules=rules,
+        )
+        health = s.operator.solver_health
+        assert injector.fired(faults.SOLVER_OUTPUT) >= 1
+        assert health.quarantines >= 1
+        # cool-down re-probe upward: past the breaker window the kernel
+        # rung admits a half-open probe, and a clean solve closes it
+        s.clock.step(130.0)  # > default 120 s cool-down
+        assert health.allow_kernel()
+        dep.scale(dep.replicas + 1)  # force one fresh solve
+        s.run_until(dep.all_bound, 60, "post-quarantine re-probe solve")
+        assert health.ladder.breakers["kernel"].state == "closed"
+
+
+@pytest.mark.slow
+class TestChaosSoakFull:
+    def test_long_soak_with_scale_down(self):
+        """The full-length soak: heavier plan, more replicas, plus a
+        scale-down while faults are still firing — consolidation under
+        chaos must not strand or double-free capacity either."""
+        s, dep, injector = run_chaos(
+            seed=101, replicas=120, fault_ticks=60, converge_ticks=900,
+            record_as="chaos_soak_full",
+        )
+        # phase 2: scale down under a fresh fault wave, then converge
+        deletes = _count_successful_deletes(s.provider)
+        until2 = s.clock.now() + 30
+        injector2 = faults.install(
+            faults.FaultInjector(chaos_rules(until2), seed=202, clock=s.clock)
+        )
+        dep.scale(40)
+        for _ in range(30):
+            s.tick()
+            _assert_no_overcommit(s)
+        injector2.clear()
+        s.run_until(
+            lambda: dep.all_bound()
+            and s.monitor.pending_pod_count() == 0,
+            900,
+            "post-scale-down convergence",
+        )
+        doubles = {pid: n for pid, n in deletes.items() if n > 1}
+        assert not doubles, doubles
+        assert dep.bound_count() == 40

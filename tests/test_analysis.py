@@ -13,6 +13,7 @@ from karpenter_tpu.analysis import (
     blocking,
     locks,
     parity,
+    retry,
     schema_drift,
     shapes,
     tracer,
@@ -495,6 +496,50 @@ class TestShapesPass:
         assert rules_of(findings) == {"SHP600"}
 
 
+class TestRetryPass:
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = retry.check_paths([fixture("bad_retry.py")])
+        assert rules_of(findings) == {"RTY701", "RTY702"}
+        # the three swallow shapes (broad/bare/continue) and the extra
+        # RTY701 inside the spinning loop's handler
+        assert sum(1 for f in findings if f.rule == "RTY701") == 4
+        assert sum(1 for f in findings if f.rule == "RTY702") == 2
+
+    def test_clean_fixture_silent(self):
+        findings, _ = retry.check_paths([fixture("good_retry.py")])
+        assert findings == []
+
+    def test_typed_catch_not_flagged(self, tmp_path):
+        (tmp_path / "typed.py").write_text(
+            "def f(x):\n"
+            "    try:\n"
+            "        x.go()\n"
+            "    except KeyError:\n"
+            "        pass\n"
+        )
+        findings, _ = retry.check_paths([str(tmp_path)])
+        assert findings == []
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = retry.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"RTY700"}
+
+    def test_real_tree_reconcile_paths_clean(self):
+        """The dogfood contract: the roster + solver carry no swallowed
+        broad excepts or unbounded retry loops (modulo the inline-
+        suppressed capability probe in state.py)."""
+        findings, sources = retry.check_paths(
+            [
+                os.path.join(REPO, "karpenter_tpu", "controllers"),
+                os.path.join(REPO, "karpenter_tpu", "solver"),
+                os.path.join(REPO, "karpenter_tpu", "operator.py"),
+            ]
+        )
+        remaining = filter_suppressed(findings, sources)
+        assert remaining == [], [f.render() for f in remaining]
+
+
 class TestRuleRegistry:
     """The meta-contract: every shipped rule id has at least one seeded-bad
     fixture. Parse-failure rules (x00) are seeded at runtime because a
@@ -502,7 +547,9 @@ class TestRuleRegistry:
 
     def test_registry_covers_every_pass(self):
         rules = all_rules()
-        for prefix in ("TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6"):
+        for prefix in (
+            "TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6", "RTY7",
+        ):
             assert any(r.startswith(prefix) for r in rules), prefix
 
     def test_every_rule_has_seeded_bad_coverage(self, tmp_path):
@@ -534,6 +581,7 @@ class TestRuleRegistry:
             ),
             parity.check_parity(str(broken), fixture("parity_good.cc")),
             shapes.check_paths([fixture("bad_shapes.py"), str(broken)]),
+            retry.check_paths([fixture("bad_retry.py"), str(broken)]),
         ]
         for findings, _sources in runs:
             produced |= {f.rule for f in findings}

@@ -319,3 +319,85 @@ class TestMetricsDecorator:
             KwokCloudProvider(Client(TestClock()), corpus.generate(4))
         )
         provider.process_registrations()  # kwok extension reachable
+
+
+class TestTypedNotFound:
+    """Regression: unknown provider ids and double-deletes surface as
+    typed NodeClaimNotFoundError through every provider path — never a
+    bare KeyError leaking through the termination controller."""
+
+    def _provider(self):
+        client = Client(TestClock())
+        return client, KwokCloudProvider(client, corpus.generate(6))
+
+    def test_kwok_double_delete_is_typed(self):
+        _, provider = self._provider()
+        claim = provider.create(make_claim())
+        provider.delete(claim)
+        with pytest.raises(cp.NodeClaimNotFoundError) as exc_info:
+            provider.delete(claim)
+        assert not isinstance(exc_info.value, KeyError)
+        assert "already terminated" in str(exc_info.value)
+
+    def test_kwok_unknown_and_empty_provider_id(self):
+        _, provider = self._provider()
+        ghost = make_claim("ghost")
+        ghost.status.provider_id = "kwok://never-created-1"
+        with pytest.raises(cp.NodeClaimNotFoundError):
+            provider.delete(ghost)
+        blank = make_claim("blank")  # no provider id at all
+        with pytest.raises(cp.NodeClaimNotFoundError):
+            provider.delete(blank)
+        with pytest.raises(cp.NodeClaimNotFoundError):
+            provider.get("")
+        with pytest.raises(cp.NodeClaimNotFoundError):
+            provider.get("kwok://never-created-1")
+
+    def test_get_after_delete_is_typed(self):
+        _, provider = self._provider()
+        claim = provider.create(make_claim())
+        pid = claim.status.provider_id
+        provider.delete(claim)
+        with pytest.raises(cp.NodeClaimNotFoundError):
+            provider.get(pid)
+
+    def test_fake_double_delete_is_typed(self):
+        provider = fake.FakeCloudProvider(corpus.generate(4))
+        claim = provider.create(make_claim())
+        provider.delete(claim)
+        with pytest.raises(cp.NodeClaimNotFoundError) as exc_info:
+            provider.delete(claim)
+        assert "already terminated" in str(exc_info.value)
+
+    def test_termination_path_survives_vanished_instance(self):
+        """Full controller path: the cloud instance disappears (or was
+        already deleted) mid-termination — the claim still finalizes and
+        the node goes away, with no exception escaping reconcile."""
+        from karpenter_tpu.controllers.lifecycle import LifecycleController
+        from karpenter_tpu.controllers.termination import (
+            TerminationController,
+        )
+
+        client, provider = self._provider()
+        lifecycle = LifecycleController(client, provider)
+        termination = TerminationController(client, provider)
+        claim = make_claim()
+        claim.metadata.finalizers.append(labels.TERMINATION_FINALIZER)
+        client.create(claim)
+        lifecycle.reconcile_all()       # launch
+        provider.process_registrations()
+        lifecycle.reconcile_all()       # register + initialize
+        node = client.list(__import__(
+            "karpenter_tpu.api.objects", fromlist=["Node"]
+        ).Node)[0]
+        # the instance dies out from under the controller
+        provider.delete(claim)
+        client.delete(node)
+        client.delete(claim)
+        termination.reconcile_all()
+        lifecycle.reconcile_all()       # finalize: second delete -> typed
+        termination.reconcile_all()     # claim gone -> node finalizer drops
+        from karpenter_tpu.api.objects import Node, NodeClaim as NC
+
+        assert client.list(NC) == []
+        assert client.list(Node) == []

@@ -1,0 +1,600 @@
+"""The robustness tier: fault injector, backoff, circuit breaker /
+degradation ladder, post-solve invariant guard, and their solver/provider
+integrations.
+
+The zero-overhead contract is pinned here: with no injector installed
+(and with an installed-but-empty one) the solver's decisions are
+identical to an uninstrumented run — the fault seams may not perturb the
+hot path.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.api.objects import NodeClaim, ObjectMeta
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.icecache import InsufficientCapacityCache
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import (
+    CloudProviderError,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+)
+from karpenter_tpu.faults.backoff import Backoff, RetryTracker
+from karpenter_tpu.faults.breaker import (
+    CircuitBreaker, DegradationLadder, SolverHealth,
+)
+from karpenter_tpu.faults.guard import SolverIntegrityError, check_solution
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.kube.store import ConflictError
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import TpuSolver
+from karpenter_tpu.solver.driver import SolverConfig
+
+from helpers import make_nodepool, make_pod, make_pods
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faults.uninstall()
+
+
+def build_solver(pods, config=None, n_types=10):
+    node_pools = [make_nodepool()]
+    its_by_pool = {np_.name: corpus.generate(n_types) for np_ in node_pools}
+    topo = Topology(Client(TestClock()), [], node_pools, its_by_pool, pods)
+    return TpuSolver(node_pools, its_by_pool, topo, config=config)
+
+
+def results_signature(results):
+    """Decision-level fingerprint: claim pools, option names, pod uids,
+    and errors — what the controller would commit."""
+    claims = sorted(
+        (
+            c.template.node_pool_name,
+            tuple(sorted(p.uid for p in c.pods)),
+            tuple(it.name for it in c.instance_type_options),
+        )
+        for c in results.new_node_claims
+    )
+    return claims, dict(results.pod_errors)
+
+
+class TestFaultInjector:
+    def test_deterministic_replay(self):
+        def run(seed):
+            inj = faults.FaultInjector(
+                [faults.FaultRule("x", probability=0.5)], seed=seed
+            )
+            log = []
+            for i in range(50):
+                try:
+                    inj.hit("x")
+                except faults.InjectedFault:
+                    log.append(i)
+            return log, list(inj.log)
+
+        a = run(7)
+        b = run(7)
+        c = run(8)
+        assert a == b
+        assert a[0] and a != c  # fires, and the seed matters
+
+    def test_after_times_and_match(self):
+        inj = faults.FaultInjector(
+            [
+                faults.FaultRule(
+                    "s", after=2, times=1,
+                    match=lambda ctx: ctx.get("kind") == "Node",
+                )
+            ]
+        )
+        inj.hit("s", kind="Node")          # call 1: skipped (after)
+        inj.hit("s", kind="Node")          # call 2: skipped (after)
+        inj.hit("s", kind="Pod")           # call 3: no match
+        with pytest.raises(faults.InjectedFault):
+            inj.hit("s", kind="Node")      # call 4: fires
+        inj.hit("s", kind="Node")          # call 5: times exhausted
+        assert inj.fired("s") == 1
+
+    def test_until_clears_on_the_injected_clock(self):
+        clock = TestClock()
+        inj = faults.FaultInjector(
+            [faults.FaultRule("s", until=clock.now() + 10.0)], clock=clock
+        )
+        with pytest.raises(faults.InjectedFault):
+            inj.hit("s")
+        clock.step(11.0)
+        inj.hit("s")  # faults cleared by time passing
+        assert inj.fired("s") == 1
+
+    def test_typed_error_factory_and_mutation(self):
+        inj = faults.FaultInjector(
+            [
+                faults.FaultRule(
+                    "e", error=lambda: ConflictError("injected")
+                ),
+                faults.FaultRule("m", mutate=lambda v: v + 1),
+            ]
+        )
+        with pytest.raises(ConflictError):
+            inj.hit("e")
+        assert inj.mutate("m", 41) == 42
+
+    def test_clear_makes_injector_inert(self):
+        inj = faults.FaultInjector([faults.FaultRule("s")])
+        with pytest.raises(faults.InjectedFault):
+            inj.hit("s")
+        inj.clear()
+        inj.hit("s")
+        assert inj.mutate("m", 1) == 1
+
+    def test_latency_rule_advances_injected_clock(self):
+        clock = TestClock()
+        inj = faults.FaultInjector(
+            [faults.FaultRule("s", latency=3.0)], clock=clock
+        )
+        t0 = clock.now()
+        inj.hit("s")  # latency-only: sleeps, does not raise
+        assert clock.now() == t0 + 3.0
+
+
+class TestBackoff:
+    def test_delays_grow_and_cap(self):
+        b = Backoff(TestClock(), initial=1.0, factor=2.0, max_delay=5.0,
+                    jitter=0.0)
+        assert [b.delay(i) for i in range(4)] == [1.0, 2.0, 4.0, 5.0]
+
+    def test_jitter_deterministic_per_seed(self):
+        mk = lambda: Backoff(TestClock(), jitter=0.5, seed=3)
+        assert [mk().delay(i) for i in range(3)] == [
+            mk().delay(i) for i in range(3)
+        ]
+
+    def test_call_retries_on_injected_clock_then_raises(self):
+        clock = TestClock()
+        b = Backoff(clock, initial=1.0, jitter=0.0, max_attempts=3)
+        attempts = []
+
+        def flaky():
+            attempts.append(clock.now())
+            raise ConflictError("still conflicting")
+
+        t0 = clock.now()
+        with pytest.raises(ConflictError):
+            b.call(flaky, retriable=(ConflictError,))
+        assert len(attempts) == 3
+        assert clock.now() == t0 + 1.0 + 2.0  # slept BETWEEN attempts only
+
+    def test_call_recovers(self):
+        clock = TestClock()
+        b = Backoff(clock, max_attempts=3, jitter=0.0)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise ConflictError("conflict")
+            return "ok"
+
+        assert b.call(flaky, retriable=(ConflictError,)) == "ok"
+
+    def test_tracker_gates_and_clears(self):
+        clock = TestClock()
+        t = RetryTracker(clock, initial=4.0, jitter=0.0)
+        assert t.ready("k")
+        d = t.failure("k")
+        assert d == 4.0 and not t.ready("k")
+        clock.step(4.0)
+        assert t.ready("k")
+        t.failure("k")  # second failure: 8s
+        clock.step(4.0)
+        assert not t.ready("k")
+        t.success("k")
+        assert t.ready("k") and t.attempts("k") == 0
+
+    def test_tracker_prune(self):
+        t = RetryTracker(TestClock())
+        t.failure("gone")
+        t.failure("kept")
+        t.prune(["kept"])
+        assert t.ready("gone") and not t.ready("kept")
+
+
+class TestBreakerAndLadder:
+    def test_breaker_trips_cools_reprobes(self):
+        clock = TestClock()
+        b = CircuitBreaker(clock, failure_threshold=2, cooldown=30.0)
+        assert b.allow()
+        b.record_failure()
+        assert b.allow()
+        b.record_failure()  # trip
+        assert not b.allow()
+        clock.step(30.0)
+        assert b.allow()  # half-open probe
+        b.record_failure()  # re-trip immediately
+        assert not b.allow()
+        clock.step(30.0)
+        assert b.allow()
+        b.record_success()
+        assert b.allow() and b.state == "closed"
+
+    def test_ladder_degrades_and_recovers(self):
+        clock = TestClock()
+        ladder = DegradationLadder(
+            clock, ("batched", "kernel", "oracle"),
+            failure_threshold=1, cooldown=60.0,
+        )
+        assert ladder.current() == "batched"
+        ladder.record("batched", ok=False)
+        assert ladder.current() == "kernel"
+        ladder.record("kernel", ok=False)
+        assert ladder.current() == "oracle"  # last rung unconditional
+        clock.step(60.0)
+        assert ladder.current() == "batched"  # cool-down re-probe upward
+
+    def test_solver_health_quarantine_and_events(self):
+        from karpenter_tpu.events import Recorder
+
+        clock = TestClock()
+        recorder = Recorder(clock)
+        h = SolverHealth(clock, recorder=recorder, cooldown=60.0)
+        assert h.allow_kernel() and h.allow_batched()
+        h.quarantine("kernel", "conservation violated")
+        assert not h.allow_kernel()
+        assert not h.allow_batched()  # batched rides the same kernels
+        assert recorder.for_reason("SolverQuarantined")
+        clock.step(60.0)
+        assert h.allow_kernel()  # half-open re-probe
+        h.record_kernel(True)
+        assert recorder.for_reason("SolverRestored")
+
+
+class TestInvariantGuard:
+    def _clean(self):
+        """A tiny hand-built solution: 1 group of 3 pods, 1 claim taking
+        2, 1 existing node taking 1."""
+        return dict(
+            g_count=np.array([3]),
+            g_req=np.array([[1.0, 2.0]]),
+            c_pool=np.array([0, 0]),
+            c_tmask=np.array([[True, True], [False, False]]),
+            n_open=1,
+            exist_fills=np.array([[1]]),
+            claim_fills=np.array([[2, 0]]),
+            unplaced=np.array([0]),
+            t_alloc=np.array([[4.0, 8.0], [2.0, 4.0]]),
+            n_avail=np.array([[2.0, 4.0]]),
+            nmax=2,
+            P=1,
+        )
+
+    def test_clean_solution_passes(self):
+        assert check_solution(**self._clean()) == []
+
+    def test_conservation_violation(self):
+        bad = self._clean()
+        bad["unplaced"] = np.array([5])
+        assert any("conservation" in v for v in check_solution(**bad))
+
+    def test_negative_fills(self):
+        bad = self._clean()
+        bad["claim_fills"] = np.array([[-2, 0]])
+        assert any("negative" in v for v in check_solution(**bad))
+
+    def test_nan_fills(self):
+        bad = self._clean()
+        bad["exist_fills"] = np.array([[np.nan]])
+        assert any("non-finite" in v for v in check_solution(**bad))
+
+    def test_capacity_violation_on_claim(self):
+        bad = self._clean()
+        bad["claim_fills"] = np.array([[9, 0]])  # 9 pods > any type fits
+        bad["g_count"] = np.array([10])
+        assert any("instance type" in v for v in check_solution(**bad))
+
+    def test_existing_node_overfill(self):
+        bad = self._clean()
+        bad["exist_fills"] = np.array([[3]])  # 3*1cpu > 2 available
+        bad["g_count"] = np.array([5])
+        assert any("existing node" in v for v in check_solution(**bad))
+
+    def test_n_open_out_of_bounds(self):
+        bad = self._clean()
+        bad["n_open"] = 99
+        assert any("n_open" in v for v in check_solution(**bad))
+
+    def test_domain_pin_out_of_range(self):
+        bad = self._clean()
+        bad.update(
+            c_dzone=np.array([99, -1]), c_dct=np.array([-1, -1]),
+            zone_vals=3, ct_vals=2,
+        )
+        assert any("c_dzone" in v for v in check_solution(**bad))
+        ok = self._clean()
+        ok.update(
+            c_dzone=np.array([2, -1]), c_dct=np.array([-1, -1]),
+            zone_vals=3, ct_vals=2,
+        )
+        assert check_solution(**ok) == []
+
+    def test_pool_limit_violation(self):
+        bad = self._clean()
+        bad.update(
+            templates_pool=["default"],
+            p_limit=np.array([[1.0, 100.0]]),
+            p_has_limit=np.array([[True, False]]),
+        )
+        # the claim's 2 pods want 2 cpu > pool limit 1
+        assert any("limits" in v for v in check_solution(**bad))
+
+
+class TestSolverIntegration:
+    def test_zero_overhead_when_off_byte_identical(self):
+        """No injector vs installed-but-empty injector vs plain run: the
+        committed decisions are identical (the acceptance pin)."""
+        pods_a = make_pods(40, cpu="1", memory="2Gi")
+        baseline = results_signature(
+            build_solver(copy.deepcopy(pods_a)).solve(copy.deepcopy(pods_a))
+        )
+        faults.install(faults.FaultInjector([], seed=0))
+        with_empty = results_signature(
+            build_solver(copy.deepcopy(pods_a)).solve(copy.deepcopy(pods_a))
+        )
+        faults.uninstall()
+        again = results_signature(
+            build_solver(copy.deepcopy(pods_a)).solve(copy.deepcopy(pods_a))
+        )
+        assert baseline == with_empty == again
+
+    def test_dispatch_fault_degrades_to_oracle(self):
+        pods = make_pods(12, cpu="1", memory="1Gi")
+        clock = TestClock()
+        health = SolverHealth(clock, failure_threshold=1, cooldown=60.0)
+        faults.install(
+            faults.FaultInjector([faults.FaultRule(faults.SOLVER_DISPATCH)])
+        )
+        try:
+            solver = build_solver(
+                copy.deepcopy(pods), config=SolverConfig(health=health)
+            )
+            results = solver.solve(copy.deepcopy(pods))
+        finally:
+            faults.uninstall()
+        # every pod still placed — by the oracle rung
+        assert not results.pod_errors
+        assert results.new_node_claims
+        assert not health.allow_kernel()  # breaker tripped (threshold 1)
+        # same decisions as an explicit force_oracle run
+        oracle = results_signature(
+            build_solver(
+                copy.deepcopy(pods), config=SolverConfig(force_oracle=True)
+            ).solve(copy.deepcopy(pods))
+        )
+        assert results_signature(results) == oracle
+
+    def test_dispatch_fault_propagates_without_health(self):
+        pods = make_pods(4)
+        faults.install(
+            faults.FaultInjector([faults.FaultRule(faults.SOLVER_DISPATCH)])
+        )
+        try:
+            with pytest.raises(faults.InjectedFault):
+                build_solver(pods).solve(pods)
+        finally:
+            faults.uninstall()
+
+    def test_corrupt_output_quarantined_never_committed(self):
+        """A kernel emitting garbage fills is caught by the guard BEFORE
+        decode; with a ladder the batch re-solves on the oracle, without
+        one the integrity error surfaces."""
+
+        def corrupt(outs):
+            outs = list(outs)
+            outs[5] = np.asarray(outs[5]) - 7  # claim_fills negative
+            return tuple(outs)
+
+        pods = make_pods(10, cpu="1", memory="1Gi")
+        rule = faults.FaultRule(faults.SOLVER_OUTPUT, mutate=corrupt)
+        faults.install(faults.FaultInjector([rule]))
+        try:
+            with pytest.raises(SolverIntegrityError):
+                build_solver(copy.deepcopy(pods)).solve(copy.deepcopy(pods))
+        finally:
+            faults.uninstall()
+
+        clock = TestClock()
+        health = SolverHealth(clock, cooldown=60.0)
+        faults.install(
+            faults.FaultInjector(
+                [faults.FaultRule(faults.SOLVER_OUTPUT, mutate=corrupt)]
+            )
+        )
+        try:
+            results = build_solver(
+                copy.deepcopy(pods), config=SolverConfig(health=health)
+            ).solve(copy.deepcopy(pods))
+        finally:
+            faults.uninstall()
+        assert not results.pod_errors  # oracle placed everything
+        assert health.quarantines == 1
+        assert not health.allow_kernel()
+
+    def test_corrupt_domain_pins_quarantined_pre_decode(self):
+        """The decode-crash vector: garbage c_dzone ids would raise
+        IndexError mid-commit; the guard must reject them pre-decode."""
+
+        def corrupt_pins(outs):
+            outs = list(outs)
+            outs[7] = np.asarray(outs[7]) + 500  # c_dzone → out of vocab
+            return tuple(outs)
+
+        pods = make_pods(6, cpu="1", memory="1Gi")
+        faults.install(
+            faults.FaultInjector(
+                [faults.FaultRule(faults.SOLVER_OUTPUT, mutate=corrupt_pins)]
+            )
+        )
+        try:
+            with pytest.raises(SolverIntegrityError):
+                build_solver(copy.deepcopy(pods)).solve(copy.deepcopy(pods))
+        finally:
+            faults.uninstall()
+
+    def test_scenario_fault_declines_batch(self):
+        """An injected scenario-dispatch failure makes solve_scenarios
+        return None (the documented per-probe fallback), recording the
+        batched rung failure."""
+        from karpenter_tpu.solver.driver import Scenario
+
+        pods = make_pods(8, cpu="1", memory="1Gi")
+        clock = TestClock()
+        health = SolverHealth(clock, failure_threshold=1, cooldown=60.0)
+        faults.install(
+            faults.FaultInjector(
+                [faults.FaultRule(faults.SOLVER_SCENARIOS)]
+            )
+        )
+        try:
+            solver = build_solver(
+                copy.deepcopy(pods), config=SolverConfig(health=health)
+            )
+            out = solver.solve_scenarios([Scenario(pods=pods)])
+        finally:
+            faults.uninstall()
+        assert out is None
+        assert not health.allow_batched()
+        # the per-probe kernel rung is NOT taken down by a batched failure
+        assert health.allow_kernel()
+
+
+class TestProviderFaults:
+    def _pool_and_claim(self, client):
+        client.create(make_nodepool())
+        claim = NodeClaim(metadata=ObjectMeta(name="c1"))
+        return claim
+
+    def test_kwok_ice_marks_cache_and_masks_offerings(self):
+        client = Client(TestClock())
+        provider = KwokCloudProvider(client, corpus.generate(4))
+        claim = self._pool_and_claim(client)
+        ctx = {}
+
+        def remember(c):
+            ctx.update(c)
+            return True
+
+        faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        faults.PROVIDER_CREATE,
+                        error=lambda: InsufficientCapacityError("injected"),
+                        times=1,
+                        match=remember,
+                    )
+                ]
+            )
+        )
+        try:
+            with pytest.raises(InsufficientCapacityError):
+                provider.create(claim)
+        finally:
+            faults.uninstall()
+        assert len(provider.ice_cache) == 1
+        assert provider.ice_cache.is_unavailable(
+            ctx["instance_type"], ctx["zone"], ctx["capacity_type"]
+        )
+        # the failed offering reads unavailable through the catalog
+        masked = {
+            (it.name, o.zone(), o.capacity_type())
+            for it in provider.get_instance_types(None)
+            for o in it.offerings
+            if not o.available
+        }
+        assert (
+            ctx["instance_type"], ctx["zone"], ctx["capacity_type"]
+        ) in masked
+        # retry routes around the cached cell (different offering/type)
+        claim2 = NodeClaim(metadata=ObjectMeta(name="c2"))
+        provider.create(claim2)
+        from karpenter_tpu.api import labels as labels_mod
+
+        got = (
+            claim2.metadata.labels[labels_mod.INSTANCE_TYPE],
+            claim2.metadata.labels[labels_mod.TOPOLOGY_ZONE],
+            claim2.metadata.labels[labels_mod.CAPACITY_TYPE_LABEL_KEY],
+        )
+        assert got != (
+            ctx["instance_type"], ctx["zone"], ctx["capacity_type"]
+        )
+        # TTL expiry restores the cell
+        client.clock.step(1000.0)
+        assert len(provider.ice_cache) == 0
+        assert all(
+            o.available or True
+            for it in provider.get_instance_types(None)
+            for o in it.offerings
+        )
+
+    def test_ice_cache_ttl_clock_driven(self):
+        clock = TestClock()
+        ice = InsufficientCapacityCache(clock, ttl=30.0)
+        ice.mark_unavailable("t", "z", "spot")
+        assert ice.is_unavailable("t", "z", "spot") and ice.active()
+        clock.step(29.0)
+        assert ice.is_unavailable("t", "z", "spot")
+        clock.step(1.0)
+        assert not ice.is_unavailable("t", "z", "spot")
+        assert not ice.active()
+
+    def test_fake_provider_ice_cache(self):
+        clock = TestClock()
+        provider = FakeCloudProvider(corpus.generate(3), clock=clock)
+        it = provider.get_instance_types(None)[0]
+        o = next(o for o in it.offerings if o.available)
+        provider.mark_insufficient_capacity(
+            it.name, o.zone(), o.capacity_type()
+        )
+        masked = next(
+            t for t in provider.get_instance_types(None) if t.name == it.name
+        )
+        assert any(
+            not m.available
+            for m in masked.offerings
+            if m.zone() == o.zone() and m.capacity_type() == o.capacity_type()
+        )
+        clock.step(1000.0)
+        fresh = next(
+            t for t in provider.get_instance_types(None) if t.name == it.name
+        )
+        assert all(
+            m.available
+            for m in fresh.offerings
+            if m.zone() == o.zone() and m.capacity_type() == o.capacity_type()
+        )
+
+    def test_kwok_registration_fault_defers(self):
+        client = Client(TestClock())
+        provider = KwokCloudProvider(client, corpus.generate(4))
+        claim = self._pool_and_claim(client)
+        provider.create(claim)
+        faults.install(
+            faults.FaultInjector(
+                [faults.FaultRule(faults.PROVIDER_REGISTER, times=2)]
+            )
+        )
+        try:
+            assert provider.process_registrations() == []
+            client.clock.step(2.0)
+            assert provider.process_registrations() == []
+            client.clock.step(2.0)
+            created = provider.process_registrations()
+        finally:
+            faults.uninstall()
+        assert [n.name for n in created] == ["c1"]

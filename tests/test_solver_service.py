@@ -397,3 +397,183 @@ class TestOperatorSidecarSplit:
         monkeypatch.setenv("KARPENTER_SOLVER_ADDRESS", "solver:50099")
         opts = parse_options([])
         assert opts.solver_address == "solver:50099"
+
+
+class TestRemoteRobustness:
+    """The gRPC seam's degradation ladder: deadline on every dispatch, one
+    bounded retry on UNAVAILABLE/DEADLINE_EXCEEDED, then an in-process
+    solve of the same shipped cluster view; the sidecar maps decode/solve
+    failures to proper status codes instead of crashing the stream."""
+
+    def _remote(self, sidecar, pods=None, **kw):
+        pools = [make_nodepool(name="default")]
+        types = {"default": corpus.generate(8)}
+        return RemoteSolver(sidecar, pools, types, **kw), pools, types
+
+    def test_config_deadline_used(self, sidecar):
+        from karpenter_tpu.solver.driver import SolverConfig
+
+        remote, _, _ = self._remote(
+            sidecar, config=SolverConfig(solve_deadline=7.5)
+        )
+        assert remote.timeout == 7.5
+        remote.close()
+
+    def test_transient_unavailable_retried_once(self, sidecar):
+        import grpc
+
+        from karpenter_tpu import faults
+        from karpenter_tpu.solver.service import InjectedRpcError
+
+        remote, _, _ = self._remote(sidecar)
+        faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        faults.REMOTE_SOLVE,
+                        error=lambda: InjectedRpcError(
+                            grpc.StatusCode.UNAVAILABLE
+                        ),
+                        times=1,
+                    )
+                ]
+            )
+        )
+        try:
+            results = remote.solve(make_pods(6, cpu="1", memory="1Gi"))
+        finally:
+            faults.uninstall()
+        assert not results.pod_errors
+        assert results.new_node_claims
+        assert remote.fallback_solves == 0  # the retry reached the sidecar
+        remote.close()
+
+    def test_outage_falls_back_in_process(self, sidecar):
+        import grpc
+
+        from karpenter_tpu import faults
+        from karpenter_tpu.solver.service import InjectedRpcError
+
+        pods = make_pods(10, cpu="1", memory="2Gi")
+        remote, pools, types = self._remote(sidecar)
+        faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        faults.REMOTE_SOLVE,
+                        error=lambda: InjectedRpcError(
+                            grpc.StatusCode.DEADLINE_EXCEEDED
+                        ),
+                    )
+                ]
+            )
+        )
+        try:
+            got = remote.solve(pods)
+        finally:
+            faults.uninstall()
+        assert remote.fallback_solves == 1
+        assert not got.pod_errors
+        want = self._local_results_like(pods, pools, types)
+        assert sorted(len(c.pods) for c in got.new_node_claims) == sorted(
+            len(c.pods) for c in want.new_node_claims
+        )
+        remote.close()
+
+    def _local_results_like(self, pods, pools, types):
+        import copy
+
+        client = Client(TestClock())
+        pods = copy.deepcopy(pods)
+        topology = Topology(client, [], pools, types, pods)
+        return Scheduler(pools, types, topology).solve(pods)
+
+    def test_fallback_does_not_bump_live_resource_versions(self, sidecar):
+        import grpc
+
+        from karpenter_tpu import faults
+        from karpenter_tpu.solver.service import InjectedRpcError
+
+        pods = make_pods(4)
+        rv_before = [p.metadata.resource_version for p in pods]
+        remote, _, _ = self._remote(sidecar)
+        faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        faults.REMOTE_SOLVE,
+                        error=lambda: InjectedRpcError(
+                            grpc.StatusCode.UNAVAILABLE
+                        ),
+                    )
+                ]
+            )
+        )
+        try:
+            remote.solve(pods)
+        finally:
+            faults.uninstall()
+        assert [p.metadata.resource_version for p in pods] == rv_before
+        remote.close()
+
+    def test_non_retriable_status_propagates(self, sidecar):
+        import grpc
+
+        from karpenter_tpu import faults
+        from karpenter_tpu.solver.service import InjectedRpcError
+
+        remote, _, _ = self._remote(sidecar)
+        faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        faults.REMOTE_SOLVE,
+                        error=lambda: InjectedRpcError(
+                            grpc.StatusCode.INVALID_ARGUMENT
+                        ),
+                    )
+                ]
+            )
+        )
+        try:
+            with pytest.raises(grpc.RpcError):
+                remote.solve(make_pods(2))
+        finally:
+            faults.uninstall()
+        assert remote.fallback_solves == 0
+        remote.close()
+
+    def test_malformed_request_maps_to_invalid_argument(self, sidecar):
+        import grpc
+
+        from karpenter_tpu.solver.service import SOLVE_METHOD
+
+        channel = grpc.insecure_channel(sidecar)
+        call = channel.unary_unary(SOLVE_METHOD)
+        with pytest.raises(grpc.RpcError) as exc_info:
+            call(b"\x00not-msgpack-garbage", timeout=10.0)
+        assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        channel.close()
+
+    def test_solve_crash_maps_to_internal(self, monkeypatch):
+        import grpc
+
+        from karpenter_tpu.solver import service as service_mod
+
+        def boom(snap, config):
+            raise RuntimeError("kernel exploded")
+
+        monkeypatch.setattr(service_mod, "_solve_decoded", boom)
+        server = service_mod.serve("127.0.0.1:0")
+        try:
+            pools = [make_nodepool(name="default")]
+            types = {"default": corpus.generate(4)}
+            remote = RemoteSolver(
+                f"127.0.0.1:{server._bound_port}", pools, types
+            )
+            with pytest.raises(grpc.RpcError) as exc_info:
+                remote.solve(make_pods(2))
+            assert exc_info.value.code() == grpc.StatusCode.INTERNAL
+            remote.close()
+        finally:
+            server.stop(0)

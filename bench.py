@@ -174,6 +174,33 @@ def _build(config: str, n_pods: int, n_types: int):
     return make_solver, pods
 
 
+def _phase_columns(run_fn) -> Dict:
+    """Per-phase wall-time columns from ONE traced pass of ``run_fn`` —
+    run OUTSIDE the timed trials, so the bench numbers stay untraced and
+    the acceptance no-regression bound applies to the production path.
+    The columns split the end-to-end decision the way the ROADMAP's
+    delta-encode item needs: host-side encode, host→device transfer,
+    kernel dispatch (compute + readback), and decode."""
+    from karpenter_tpu import obs
+
+    tracer = obs.install(obs.Tracer(obs.PerfClock()))
+    try:
+        run_fn()
+    finally:
+        obs.uninstall()
+    totals = tracer.phase_totals()
+
+    def ms(phase: str) -> float:
+        return round(totals.get(phase, 0.0) * 1000, 2)
+
+    return {
+        "encode_ms": ms("solve.encode"),
+        "transfer_ms": ms("solve.transfer"),
+        "kernel_ms": ms("solve.dispatch"),
+        "decode_ms": ms("solve.decode"),
+    }
+
+
 def _routed_fraction(solver, pods) -> float:
     from karpenter_tpu.solver import encode as enc
 
@@ -227,6 +254,9 @@ def run_config(
         "cost": round(tpu_results.total_price(), 4),
         "tpu_routed_fraction": round(routed, 4),
     }
+    # phase attribution from one extra traced solve (compiled shapes are
+    # already warm, so this costs one execution, not a compile)
+    entry.update(_phase_columns(lambda: make_solver().solve(pods)))
 
     if with_oracle and n_pods <= ORACLE_POD_BUDGET:
         t0 = time.perf_counter()
@@ -285,6 +315,12 @@ def _run_consolidation_method(config: str, build_env, n_nodes: int) -> Dict:
                 "probe_ms": getattr(method, "last_probe_ms", []),
                 "dispatches": getattr(method, "last_dispatches", 0),
             }
+    # phase attribution: one traced decision over a fresh env (the whole
+    # probe set's encode/transfer/kernel/decode, summed across dispatches)
+    ctx, method, candidates, budgets = build_env(n_nodes)
+    phases = _phase_columns(
+        lambda: method.compute_command(candidates, budgets)
+    )
     return {
         "config": config,
         "nodes": n_nodes,
@@ -292,6 +328,7 @@ def _run_consolidation_method(config: str, build_env, n_nodes: int) -> Dict:
         "pods_per_sec": None,
         "p99_ms": round(best * 1000, 1),
         **stats,
+        **phases,
     }
 
 

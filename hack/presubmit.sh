@@ -46,6 +46,13 @@ assert int(out[2]) > 0
 g.dryrun_multichip(8)
 PY
 
+# bench smoke with tracing enabled: the emitted Chrome trace must
+# validate against the checked-in minimal schema (hack/trace_schema.json
+# — no dangling span ids, monotonic timestamps), the decision-path phases
+# must be present, and the audit trail must have recorded the solve
+echo "== trace smoke (bench smoke with tracing) =="
+python hack/trace_smoke.py
+
 echo "== test suite =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,94 @@
+"""Presubmit trace smoke: the bench smoke with tracing enabled.
+
+Runs a small solver config (bench.py's workload builders) under an
+installed tracer, then asserts:
+
+- the emitted Chrome trace validates against the checked-in minimal
+  schema (hack/trace_schema.json): required keys, no dangling span ids,
+  non-negative durations, monotonic timestamps;
+- the decision-path phases the ROADMAP's delta-encode item needs
+  (encode / dispatch / decode) actually appear, so a refactor can't
+  silently unthread the tracer from the solve path;
+- the decision audit trail recorded the solve with a kernel-rung verdict.
+
+Exit nonzero on any violation (hack/presubmit.sh runs this).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from bench import _build  # noqa: E402
+from karpenter_tpu import obs  # noqa: E402
+
+SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "trace_schema.json")
+
+
+def main() -> int:
+    with open(SCHEMA_PATH, encoding="utf-8") as fh:
+        schema = json.load(fh)
+
+    make_solver, pods = _build("identical", 200, 10)
+    make_solver().solve(pods)  # warm the compile cache untraced
+
+    audit_before = len(obs.AUDIT.query(kind="solve"))
+    tracer = obs.install(obs.Tracer(obs.PerfClock(), seed=0))
+    try:
+        results = make_solver().solve(pods)
+    finally:
+        obs.uninstall()
+
+    assert not results.pod_errors, "smoke workload must schedule fully"
+
+    doc = tracer.export_chrome()
+    problems = obs.validate_chrome_trace(doc, schema)
+    if problems:
+        for p in problems:
+            print(f"trace-smoke: INVALID: {p}", file=sys.stderr)
+        return 1
+
+    totals = tracer.phase_totals()
+    for phase in ("solve", "solve.encode", "solve.dispatch", "solve.decode"):
+        if phase not in totals:
+            print(
+                f"trace-smoke: phase {phase!r} missing from the trace "
+                f"(got {sorted(totals)})",
+                file=sys.stderr,
+            )
+            return 1
+
+    records = obs.AUDIT.query(kind="solve")[audit_before:]
+    if not records:
+        print("trace-smoke: no decision audit record emitted", file=sys.stderr)
+        return 1
+    rec = records[-1]
+    if rec.rung != "kernel" or rec.guard != "ok" or not rec.encode_hash:
+        print(
+            f"trace-smoke: malformed audit record: rung={rec.rung}"
+            f" guard={rec.guard} encode_hash={rec.encode_hash!r}",
+            file=sys.stderr,
+        )
+        return 1
+
+    n_events = len(doc["traceEvents"])
+    print(
+        f"trace-smoke OK: {n_events} events, phases "
+        + " ".join(
+            f"{k.split('.')[-1]}={v * 1000:.1f}ms"
+            for k, v in sorted(totals.items())
+            if k.startswith("solve.")
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

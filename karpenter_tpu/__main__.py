@@ -40,7 +40,7 @@ def serve_metrics(port: int) -> ThreadingHTTPServer:
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = REGISTRY.exposition().encode()
+            body = REGISTRY.render().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
@@ -121,6 +121,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     metrics_server.shutdown()
     health_server.shutdown()
+    # flush observability artifacts (metrics exposition + Chrome trace)
+    operator.shutdown()
     print(json.dumps({"msg": "operator stopped"}), flush=True)
     return 0
 

@@ -36,10 +36,14 @@ def all_rules() -> Dict[str, str]:
     """Every shipped rule id -> one-line description, aggregated from the
     pass modules. The meta-test in tests/test_analysis.py asserts each has
     a seeded-bad fixture; the SARIF writer uses it for rule metadata."""
-    from . import blocking, locks, parity, retry, schema_drift, shapes, tracer
+    from . import (
+        blocking, locks, obs, parity, retry, schema_drift, shapes, tracer,
+    )
 
     out: Dict[str, str] = {}
-    for mod in (tracer, locks, blocking, schema_drift, parity, shapes, retry):
+    for mod in (
+        tracer, locks, blocking, schema_drift, parity, shapes, retry, obs,
+    ):
         out.update(getattr(mod, "RULES", {}))
     return out
 

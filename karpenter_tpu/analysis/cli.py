@@ -33,6 +33,7 @@ from . import (
     all_rules,
     blocking,
     locks,
+    obs,
     parity,
     retry,
     schema_drift,
@@ -81,6 +82,9 @@ PASS_TARGETS = {
         "karpenter_tpu/solver",
         "karpenter_tpu/operator.py",
     ],
+    # observability hygiene: span leaks and per-call metric construction
+    # anywhere in the package (the obs seams thread through everything)
+    "obs": ["karpenter_tpu"],
 }
 
 
@@ -108,6 +112,8 @@ def _run_pass(name: str, targets: List[str]):
         return shapes.check_paths(targets)
     if name == "retry":
         return retry.check_paths(targets)
+    if name == "obs":
+        return obs.check_paths(targets)
     raise ValueError(f"unknown pass {name!r}")
 
 

@@ -39,6 +39,9 @@ class FakeCloudProvider(CloudProvider):
         clock=None,
     ):
         self._instance_types = list(types if types is not None else instance_types())
+        # public, possibly None: MetricsCloudProvider reads the injected
+        # clock when present (same contract as kwok)
+        self.clock = clock
         self.created: Dict[str, NodeClaim] = {}
         self.create_calls: List[NodeClaim] = []
         self.delete_calls: List[NodeClaim] = []

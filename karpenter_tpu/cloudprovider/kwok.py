@@ -52,6 +52,9 @@ class KwokCloudProvider(CloudProvider):
         registration_delay: float = 0.0,
     ):
         self._client = client
+        # public: MetricsCloudProvider reads the injected clock off the
+        # wrapped provider so its duration histograms replay-deterministic
+        self.clock = client.clock
         self._instance_types = list(instance_types if instance_types is not None else corpus.generate())
         self._by_name = {it.name: it for it in self._instance_types}
         self._instances: Dict[str, KwokInstance] = {}

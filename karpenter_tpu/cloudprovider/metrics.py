@@ -10,6 +10,7 @@ from __future__ import annotations
 import time
 from typing import List
 
+from ..kube import RealClock
 from ..metrics import Counter, Histogram
 from .types import (
     CloudProvider,
@@ -38,10 +39,23 @@ class MetricsCloudProvider(CloudProvider):
 
     def __init__(self, inner: CloudProvider):
         self.inner = inner
+        # durations read the inner provider's injected clock when it
+        # carries a SIMULATED one (kwok/fake expose .clock), so chaos-soak
+        # latency histograms are deterministic under replay: an injected-
+        # latency rule advances the TestClock by exactly its configured
+        # delay, and the same seed reproduces the same histogram. A
+        # RealClock is wall time (time.time) — an NTP step would record
+        # negative durations — so production keeps monotonic perf_counter,
+        # as do providers without a clock (a real cloud SDK).
+        clock = getattr(inner, "clock", None)
+        if clock is not None and not isinstance(clock, RealClock):
+            self._now = clock.now
+        else:
+            self._now = time.perf_counter
 
     def _timed(self, method: str, fn, *args, **kwargs):
         labels = {"method": method, "provider": self.inner.name()}
-        t0 = time.perf_counter()
+        t0 = self._now()
         try:
             return fn(*args, **kwargs)
         except Exception as e:
@@ -54,7 +68,7 @@ class MetricsCloudProvider(CloudProvider):
                 )
             raise
         finally:
-            METHOD_DURATION.observe(time.perf_counter() - t0, labels)
+            METHOD_DURATION.observe(self._now() - t0, labels)
 
     def create(self, node_claim):
         return self._timed("Create", self.inner.create, node_claim)

@@ -11,6 +11,7 @@ import copy
 import math
 from typing import Dict, List, Optional, Sequence
 
+from ... import obs
 from ...api import labels as labels_mod
 from ...api.objects import (
     Budget,
@@ -244,11 +245,16 @@ class ScenarioSimulator:
             # shared encoding cannot carry per-scenario copies
             self.available = False
             return
-        self._solver = _build_simulation_solver(
-            client, cluster, cloud_provider, state_nodes,
-            union_pods + self._pending,
-            solver_config=solver_config, encode_cache=encode_cache,
-        )
+        with obs.span(
+            "scenario.build",
+            nodes=len(state_nodes),
+            candidates=len(universe),
+        ):
+            self._solver = _build_simulation_solver(
+                client, cluster, cloud_provider, state_nodes,
+                union_pods + self._pending,
+                solver_config=solver_config, encode_cache=encode_cache,
+            )
 
     def solve(
         self, subsets: Sequence[Sequence[Candidate]]

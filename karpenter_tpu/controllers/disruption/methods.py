@@ -15,6 +15,7 @@ import os
 import time as _time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ... import obs
 from ...api import labels as labels_mod
 from ...api.objects import (
     COND_CONSOLIDATABLE,
@@ -73,6 +74,51 @@ def _bsearch_tree_mids(n: int, budget: int) -> List[int]:
             for iv in ((lo, (lo + hi) // 2 - 1), ((lo + hi) // 2 + 1, hi))
         ]
     return out
+
+
+_RUNG_RANK = {"batched": 0, "kernel": 1, "oracle": 2, "dropped": 3}
+
+
+def _audit_consolidation(method, kind: str, sp, cmd: Command) -> None:
+    """Decision-level audit record for a consolidation search, correlated
+    with the per-solve records its probes emitted: with tracing on, the
+    search's rung/guard aggregate the SAME-TRACE solve records (worst
+    rung used, first non-ok guard verdict), so a mid-search quarantine is
+    visible at decision level too. Untraced searches can't correlate and
+    report "untracked" rather than claim a verdict."""
+    trace_id = getattr(sp, "trace_id", "")
+    solve_recs = obs.AUDIT.query(trace_id=trace_id) if trace_id else []
+    if solve_recs:
+        rung = max(
+            (r.rung for r in solve_recs),
+            key=lambda r: _RUNG_RANK.get(r, 0),
+        )
+        guard = next(
+            (r.guard for r in solve_recs if r.guard != "ok"), "ok"
+        )
+    else:
+        health = getattr(method.ctx.solver_config, "health", None)
+        rung = (
+            ("batched", "kernel", "oracle")[health.level()]
+            if health is not None
+            else "untracked"
+        )
+        guard = "untracked"
+    obs.AUDIT.record(
+        kind=kind,
+        trace_id=trace_id,
+        duration_ms=round(getattr(sp, "duration", 0.0) * 1000, 3),
+        encode_hash=getattr(method.ctx.encode_cache, "content_hash", ""),
+        pods=sum(len(c.reschedulable_pods) for c in cmd.candidates),
+        claims=len(cmd.replacements),
+        errors=0,
+        scenario_count=method.last_probes,
+        dispatches=method.last_dispatches,
+        rung=rung,
+        guard=guard,
+        cost=sum(c.price for c in cmd.candidates),
+        attrs={"decision": cmd.decision, "disrupted": len(cmd.candidates)},
+    )
 
 
 class Method:
@@ -331,6 +377,14 @@ class MultiNodeConsolidation(ConsolidationBase):
     consolidation_type = "multi"
 
     def compute_command(self, candidates, budgets) -> Command:
+        with obs.span(
+            "consolidate.multi", candidates=len(candidates)
+        ) as sp:
+            cmd = self._compute_command(candidates, budgets)
+        _audit_consolidation(self, "consolidation-multi", sp, cmd)
+        return cmd
+
+    def _compute_command(self, candidates, budgets) -> Command:
         # probe/dispatch telemetry for the bench's consolidation entry;
         # reset BEFORE any early return so a no-probe decision never
         # reports the previous decision's timings
@@ -478,6 +532,14 @@ class SingleNodeConsolidation(ConsolidationBase):
 
     consolidation_type = "single"
 
+    def compute_command(self, candidates, budgets) -> Command:
+        with obs.span(
+            "consolidate.single", candidates=len(candidates)
+        ) as sp:
+            cmd = self._compute_command(candidates, budgets)
+        _audit_consolidation(self, "consolidation-single", sp, cmd)
+        return cmd
+
     def __init__(self, ctx):
         super().__init__(ctx)
         self.previously_unseen_node_pools: set = set()
@@ -501,7 +563,7 @@ class SingleNodeConsolidation(ConsolidationBase):
                     out.append(by_pool[pool][i])
         return out
 
-    def compute_command(self, candidates, budgets) -> Command:
+    def _compute_command(self, candidates, budgets) -> Command:
         self.suppress_memoization = False
         self.last_probe_ms: List[float] = []
         self.last_probes = 0

@@ -14,6 +14,7 @@ import copy
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+from .. import obs
 from ..api import labels as labels_mod
 from ..api.objects import DaemonSet, Node, NodeClaim, NodePool, Pod
 from ..api.requirements import Requirements, pod_requirements
@@ -168,8 +169,13 @@ class Provisioner:
         self.cluster.mark_pod_scheduling_decisions(
             results.pod_errors, *scheduled_uids
         )
-        self.create_node_claims(results)
-        self.nominate(results)
+        # the commit phase (store writes + nominations) gets its own span
+        # so a trace splits decision time from apply time
+        with obs.span(
+            "provision.commit", claims=len(results.new_node_claims)
+        ):
+            self.create_node_claims(results)
+            self.nominate(results)
         return results
 
     def get_pending_pods(self) -> List[Pod]:
@@ -209,6 +215,10 @@ class Provisioner:
     # -- scheduling (provisioner.go:216-359) ------------------------------
 
     def schedule(self, pods: List[Pod]) -> Results:
+        with obs.span("provision.schedule", pods=len(pods)):
+            return self._schedule(pods)
+
+    def _schedule(self, pods: List[Pod]) -> Results:
         t0 = self.clock.now()
         # zonal-volume requirement injection (volumetopology.go:42-78); copy
         # volume-bearing pods so the store objects stay unmutated

@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .. import obs
+
 DEDUPE_TTL = 120.0
 RATE_LIMIT_QPS = 10.0
 RATE_LIMIT_BURST = 25
@@ -45,6 +47,15 @@ class Recorder:
             return False
         self._seen[key] = now
         self.events.append(event)
+        # correlate the event stream with the decision trace: a published
+        # event lands as an instant event on whatever span is open (the
+        # reconcile pass, a solve phase); no-op without an active tracer
+        obs.event(
+            "k8s.event",
+            reason=event.reason,
+            type=event.type,
+            object_uid=event.object_uid,
+        )
         return True
 
     def _take_token(self, reason: str, now: float) -> bool:

@@ -136,6 +136,12 @@ class FaultInjector:
             return False
         rule.fired += 1
         self.log.append((rule.site, idx, n))
+        # chaos ↔ trace correlation: a firing leaves an instant event on
+        # the open span, so a Perfetto view of a chaos replay shows WHERE
+        # in the decision path each fault landed (no-op without a tracer)
+        from .. import obs
+
+        obs.event("fault.fired", site=rule.site, rule=idx, call=n)
         return True
 
     def hit(self, site: str, **ctx) -> None:

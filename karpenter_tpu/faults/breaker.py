@@ -28,6 +28,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Sequence
 
+from .. import obs
 from ..events import (
     REASON_SOLVER_DEGRADED,
     REASON_SOLVER_QUARANTINED,
@@ -186,6 +187,7 @@ class SolverHealth:
         """Integrity violation: trip the rung NOW and drop to the oracle
         (the violating solve is discarded by the caller, never committed)."""
         self.quarantines += 1
+        obs.event("solver.quarantine", rung=rung, reason=reason)
         QUARANTINES.inc()
         self.ladder.trip(rung)
         BREAKER_TRIPS.inc(labels={"rung": rung})
@@ -200,6 +202,9 @@ class SolverHealth:
         trips_before = breaker.trips
         self.ladder.record(rung, ok)
         if breaker.trips > trips_before:
+            # breaker trips land on the open span so a trace of a degraded
+            # decision shows exactly which phase tripped which rung
+            obs.event("solver.breaker_trip", rung=rung, reason=reason)
             BREAKER_TRIPS.inc(labels={"rung": rung})
             self._publish(
                 REASON_SOLVER_DEGRADED,
@@ -207,6 +212,12 @@ class SolverHealth:
                 + (f": {reason}" if reason else ""),
             )
         self._observe(probe_succeeded=ok)
+
+    def level(self) -> int:
+        """Effective rung index (0=batched, 1=kernel, 2=oracle) from the
+        composite gates — what the NEXT solve will try. Public: the
+        decision audit trail (obs/audit.py) records it per decision."""
+        return self._level()
 
     def _level(self) -> int:
         """Effective rung index from the composite gates (a quarantined

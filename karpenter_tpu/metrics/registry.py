@@ -121,6 +121,30 @@ class Histogram(Metric):
         ]
 
 
+def _escape_label(v) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+# a single metric should never explode into unbounded label series (pod
+# uids, node names as labels, ...): the guard test in tests/test_obs.py
+# fails any metric whose series count crosses this after a full sim run
+MAX_LABEL_SERIES = 64
+
+
 class Registry:
     def __init__(self):
         self._metrics: List[Metric] = []
@@ -148,6 +172,88 @@ class Registry:
             else:
                 lines.append(f"{name}{label_str} {value}")
         return "\n".join(lines) + "\n"
+
+    def render(self) -> str:
+        """Full Prometheus text exposition (format 0.0.4): ``# HELP`` /
+        ``# TYPE`` headers per metric family, histogram series expanded
+        into cumulative ``_bucket{le=...}`` rows (``+Inf`` included) plus
+        ``_sum``/``_count`` — the form real scrapers and promtool expect,
+        unlike the test-oriented ``exposition()`` summary above."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            samples = m.collect()
+            if not samples:
+                continue
+            kind = (
+                "counter" if isinstance(m, Counter)
+                else "gauge" if isinstance(m, Gauge)
+                else "histogram"
+            )
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {kind}")
+            if isinstance(m, Histogram):
+                with m._lock:
+                    keys = list(m._totals)
+                    counts = {k: list(m._counts[k]) for k in keys}
+                    sums = dict(m._sums)
+                    totals = dict(m._totals)
+                for key in keys:
+                    labels = dict(key)
+                    for le, cum in zip(m.buckets, counts[key]):
+                        le_pair = 'le="%s"' % le
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_label_str(labels, le_pair)} {cum}"
+                        )
+                    inf_pair = 'le="+Inf"'
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_label_str(labels, inf_pair)} {totals[key]}"
+                    )
+                    lines.append(
+                        f"{m.name}_sum{_label_str(labels)} {sums[key]}"
+                    )
+                    lines.append(
+                        f"{m.name}_count{_label_str(labels)} {totals[key]}"
+                    )
+            else:
+                for _kind, name, labels, value in samples:
+                    lines.append(f"{name}{_label_str(labels)} {value}")
+        return "\n".join(lines) + "\n"
+
+    def dump(self, path: str) -> None:
+        """Write the full text exposition to ``path`` (operator shutdown
+        and the sim harness flush final metric state through this)."""
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(self.render())
+
+    def series_counts(self) -> Dict[str, int]:
+        """{metric name: live label-series count} — the input to the
+        cardinality guard."""
+        out: Dict[str, int] = {}
+        for _kind, name, _labels, _value in self.collect():
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    def check_cardinality(
+        self,
+        bound: int = MAX_LABEL_SERIES,
+        exempt: Tuple[str, ...] = (),
+    ) -> Dict[str, int]:
+        """Metrics whose series count exceeds ``bound`` (empty = healthy).
+        A nonempty result means some label carries unbounded identity
+        (pod uid, node name) and would blow up a real scrape. ``exempt``
+        lists name prefixes excluded from the check — the per-node/per-pod
+        gauges mirror the reference's identity-labeled metrics and scale
+        with cluster size BY DESIGN; everything else must stay bounded."""
+        return {
+            name: n
+            for name, n in self.series_counts().items()
+            if n > bound and not any(name.startswith(p) for p in exempt)
+        }
 
 
 REGISTRY = Registry()

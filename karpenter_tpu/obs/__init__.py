@@ -1,0 +1,101 @@
+"""Observability: decision-path span tracing + the decision audit trail.
+
+Two instruments over the solve path, both designed to cost nothing when
+idle (the faults/ zero-overhead discipline):
+
+- ``trace.Tracer`` — clock-injected, seeded-deterministic span tracer
+  threaded through reconcile → encode → transfer → dispatch → decode →
+  guard → commit (and across the RemoteSolver gRPC hop via metadata).
+  Installed process-globally like the fault injector; call sites use the
+  module-level ``span()``/``event()`` helpers, which are a single global
+  ``None`` check when no tracer is installed.
+- ``audit.AuditLog`` — ring-buffer decision trail; the module-global
+  ``AUDIT`` receives one record per completed solve from
+  solver/driver.py.
+
+See README "Observability" for the span taxonomy and the audit-record
+schema.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .audit import AuditLog, AuditRecord
+from .trace import (
+    NOOP_SPAN,
+    PARENT_ID_METADATA_KEY,
+    PHASE_DURATION,
+    TRACE_ID_METADATA_KEY,
+    PerfClock,
+    Span,
+    Tracer,
+    validate_chrome_trace,
+)
+
+# -- process-global installation seam (mirrors faults.install) ---------------
+
+_TRACER: Optional[Tracer] = None
+
+
+def _audit_now() -> float:
+    """One timebase for every audit record in the log: the installed
+    tracer's clock when tracing is on, wall time otherwise — never a mix
+    WITHIN a record source, so ``AUDIT.query(since=...)`` is coherent."""
+    if _TRACER is not None:
+        return _TRACER.clock.now()
+    import time
+
+    return time.time()
+
+
+# the process-wide decision trail; always on (records never influence
+# decisions, and one small append per solve is noise next to the solve)
+AUDIT = AuditLog(clock=_audit_now)
+
+
+def install(tracer: Tracer) -> Tracer:
+    global _TRACER
+    _TRACER = tracer
+    return tracer
+
+
+def uninstall() -> None:
+    global _TRACER
+    _TRACER = None
+
+
+def active() -> Optional[Tracer]:
+    return _TRACER
+
+
+def span(name: str, **attrs):
+    """A context-managed span on the installed tracer; the shared no-op
+    span (one global read, no allocation) when tracing is off."""
+    if _TRACER is None:
+        return NOOP_SPAN
+    return _TRACER.span(name, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Attach an instant event to the installed tracer's current span;
+    no-op (one global read) when tracing is off or no span is open."""
+    if _TRACER is not None:
+        _TRACER.event(name, **attrs)
+
+
+def current_span():
+    """The calling thread's open span, or None (also when tracing is
+    off) — what the RemoteSolver reads to propagate trace context."""
+    if _TRACER is None:
+        return None
+    return _TRACER.current()
+
+
+__all__ = [
+    "Span", "Tracer", "PerfClock", "NOOP_SPAN", "PHASE_DURATION",
+    "AuditLog", "AuditRecord", "AUDIT",
+    "TRACE_ID_METADATA_KEY", "PARENT_ID_METADATA_KEY",
+    "install", "uninstall", "active", "span", "event", "current_span",
+    "validate_chrome_trace",
+]

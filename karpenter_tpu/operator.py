@@ -33,6 +33,7 @@ from .controllers.nodeclaim_disruption import (
 from .controllers.provisioning import Provisioner
 from .controllers.state import Cluster
 from .controllers.termination import TerminationController
+from . import obs
 from .events import Event, REASON_RECONCILE_ERROR, Recorder
 from .faults.backoff import RetryTracker
 from .faults.breaker import SolverHealth
@@ -68,6 +69,15 @@ class OperatorOptions:
     # consumable by TensorBoard/XProf (SURVEY.md §5)
     enable_profiling: bool = False
     profiling_port: int = 9999
+    # decision-path span tracing (obs/): off by default (the no-op seam
+    # costs one global check per call site); the seed makes replayed
+    # chaos runs produce identical traces
+    enable_tracing: bool = False
+    trace_seed: int = 0
+    # shutdown artifacts: Chrome trace-event JSON (Perfetto-loadable) and
+    # the Prometheus text exposition of metrics.REGISTRY; "" skips
+    trace_path: str = ""
+    metrics_dump_path: str = ""
 
     @classmethod
     def from_options(cls, opts: "Options") -> "OperatorOptions":
@@ -91,6 +101,10 @@ class OperatorOptions:
             enable_profiling=opts.enable_profiling,
             solver_config=solver_config,
             solver_address=opts.solver_address,
+            enable_tracing=opts.enable_tracing,
+            trace_seed=opts.trace_seed,
+            trace_path=opts.trace_path,
+            metrics_dump_path=opts.metrics_dump_path,
         )
 
 
@@ -105,6 +119,15 @@ class Operator:
         self.client = client
         self.clock = client.clock
         self.cloud_provider = cloud_provider
+        # decision-path tracing: one operator-scoped tracer on the
+        # injected clock, installed process-globally so the solver seams
+        # (driver/ops/service/wire) pick it up without explicit threading
+        # — the same installation pattern the fault injector uses
+        self.tracer = None
+        if self.options.enable_tracing:
+            self.tracer = obs.install(
+                obs.Tracer(self.clock, seed=self.options.trace_seed)
+            )
         self.recorder = Recorder(self.clock)
         self.cluster = Cluster(client)
         # the solver degradation ladder is operator-scoped: one SolverHealth
@@ -195,7 +218,8 @@ class Operator:
         if not self._requeue.ready(name):
             return
         try:
-            fn(*args, **kwargs)
+            with obs.span(f"reconcile.{name}"):
+                fn(*args, **kwargs)
         except Exception as exc:
             self._requeue.failure(name)
             RECONCILE_ERRORS.inc(
@@ -251,3 +275,18 @@ class Operator:
         while self.clock.now() < end:
             self.step()
             self.clock.sleep(tick)
+
+    def shutdown(self) -> None:
+        """Flush observability artifacts (the reference dumps final metric
+        state on SIGTERM the same way): the Prometheus exposition of
+        metrics.REGISTRY and, with tracing on, the Chrome trace. Then
+        release the process-global tracer installation."""
+        from .metrics import REGISTRY
+
+        if self.options.metrics_dump_path:
+            REGISTRY.dump(self.options.metrics_dump_path)
+        if self.tracer is not None:
+            if self.options.trace_path:
+                self.tracer.dump(self.options.trace_path)
+            if obs.active() is self.tracer:
+                obs.uninstall()

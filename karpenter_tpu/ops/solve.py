@@ -100,45 +100,50 @@ def _solve_with(
     wf_iters: int,
     **packer_statics,
 ):
-    compat_pg, type_ok, n_fit, cap_ng = _feasibility_tables(
-        g_count, g_def, g_neg, g_mask, g_req,
-        p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
-        t_def, t_mask, t_alloc,
-        o_avail, o_zone, o_ct,
-        n_def, n_mask, n_avail, n_base, n_tol,
-        well_known,
-        zone_kid=zone_kid,
-        ct_kid=ct_kid,
-        tile_feasibility=tile_feasibility,
-    )
-    state, exist_fills, claim_fills, unplaced = packer(
-        g_count, g_req, g_def, g_neg, g_mask,
-        g_hcap, g_haff,
-        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
-        g_hstg, g_hscap, g_dtg,
-        g_hself, g_hcontrib, g_dcontrib,
-        compat_pg, type_ok, n_fit,
-        cap_ng,
-        t_alloc, t_cap,
-        a_tzc, res_cap0, a_res,
-        p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
-        p_titype_ok,
-        t_def, t_mask,
-        o_avail, o_zone, o_ct,
-        n_def, n_mask, n_avail, n_base, n_tol,
-        n_hcnt,
-        n_dzone, n_dct,
-        nh_cnt0, dd0, dtg_key,
-        well_known,
-        *extra_args,
-        zone_kid=zone_kid,
-        ct_kid=ct_kid,
-        has_domains=has_domains,
-        has_contrib=has_contrib,
-        tile_feasibility=tile_feasibility,
-        wf_iters=wf_iters,
-        **packer_statics,
-    )
+    # named scopes ride into the lowered HLO metadata so XProf/TensorBoard
+    # device traces attribute time to the feasibility tables vs the packing
+    # scan (SURVEY §5's pprof analog); zero runtime cost post-compile
+    with jax.named_scope("ktpu.feasibility"):
+        compat_pg, type_ok, n_fit, cap_ng = _feasibility_tables(
+            g_count, g_def, g_neg, g_mask, g_req,
+            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+            t_def, t_mask, t_alloc,
+            o_avail, o_zone, o_ct,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            well_known,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+            tile_feasibility=tile_feasibility,
+        )
+    with jax.named_scope("ktpu.pack"):
+        state, exist_fills, claim_fills, unplaced = packer(
+            g_count, g_req, g_def, g_neg, g_mask,
+            g_hcap, g_haff,
+            g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+            g_hstg, g_hscap, g_dtg,
+            g_hself, g_hcontrib, g_dcontrib,
+            compat_pg, type_ok, n_fit,
+            cap_ng,
+            t_alloc, t_cap,
+            a_tzc, res_cap0, a_res,
+            p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
+            p_titype_ok,
+            t_def, t_mask,
+            o_avail, o_zone, o_ct,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            n_hcnt,
+            n_dzone, n_dct,
+            nh_cnt0, dd0, dtg_key,
+            well_known,
+            *extra_args,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+            has_domains=has_domains,
+            has_contrib=has_contrib,
+            tile_feasibility=tile_feasibility,
+            wf_iters=wf_iters,
+            **packer_statics,
+        )
     return _pack_results(state, exist_fills, claim_fills, unplaced)
 
 
@@ -308,28 +313,43 @@ solve_all_scenarios_packed = jax.jit(
 # pinned by tests/test_faults.py).
 
 from .. import faults  # noqa: E402  (after the jitted kernels they wrap)
+from .. import obs  # noqa: E402
+
+
+def _device_annotation(kernel: str):
+    """jax.profiler.TraceAnnotation around the dispatch when tracing is on
+    (so device time is attributable in an XProf capture under the
+    ``ktpu.<kernel>`` annotation), the free nullcontext otherwise — the
+    dispatch hot path pays one global check, like the fault seam."""
+    if obs.active() is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return jax.profiler.TraceAnnotation(f"ktpu.{kernel}")
 
 
 def dispatch_packed(*args, **kw):
     faults.hit(faults.SOLVER_DISPATCH, kernel="pack")
-    return faults.mutate(
-        faults.SOLVER_OUTPUT, solve_all_packed(*args, **kw), kernel="pack"
-    )
+    with obs.span("kernel.dispatch", kernel="pack"), _device_annotation(
+        "pack"
+    ):
+        out = solve_all_packed(*args, **kw)
+    return faults.mutate(faults.SOLVER_OUTPUT, out, kernel="pack")
 
 
 def dispatch_classed_packed(*args, **kw):
     faults.hit(faults.SOLVER_DISPATCH, kernel="pack_classed")
-    return faults.mutate(
-        faults.SOLVER_OUTPUT,
-        solve_all_classed_packed(*args, **kw),
-        kernel="pack_classed",
-    )
+    with obs.span(
+        "kernel.dispatch", kernel="pack_classed"
+    ), _device_annotation("pack_classed"):
+        out = solve_all_classed_packed(*args, **kw)
+    return faults.mutate(faults.SOLVER_OUTPUT, out, kernel="pack_classed")
 
 
 def dispatch_scenarios_packed(*args, **kw):
     faults.hit(faults.SOLVER_SCENARIOS, kernel="scenarios")
-    return faults.mutate(
-        faults.SOLVER_OUTPUT,
-        solve_all_scenarios_packed(*args, **kw),
-        kernel="scenarios",
-    )
+    with obs.span("kernel.dispatch", kernel="scenarios"), _device_annotation(
+        "scenarios"
+    ):
+        out = solve_all_scenarios_packed(*args, **kw)
+    return faults.mutate(faults.SOLVER_OUTPUT, out, kernel="scenarios")

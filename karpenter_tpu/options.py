@@ -141,6 +141,14 @@ class Options:
     solver_mesh: str = ""
     # gRPC solver-sidecar target (host:port); "" = solve in-process
     solver_address: str = ""
+    # decision-path span tracing (obs/): off by default; the seed keeps
+    # replayed chaos runs producing identical traces
+    enable_tracing: bool = False
+    trace_seed: int = 0
+    # shutdown artifact paths ("" skips): Chrome trace-event JSON and the
+    # Prometheus text exposition of the metrics registry
+    trace_path: str = ""
+    metrics_dump_path: str = ""
 
     def validate(self) -> None:
         if self.log_level not in VALID_LOG_LEVELS:
@@ -219,6 +227,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--solver-address", dest="solver_address",
                    default=_env_str(
                        "KARPENTER_SOLVER_ADDRESS", d.solver_address))
+    p.add_argument("--enable-tracing", dest="enable_tracing",
+                   choices=("true", "false"),
+                   default=str(_env_bool(
+                       "ENABLE_TRACING", d.enable_tracing)).lower())
+    p.add_argument("--trace-seed", dest="trace_seed", type=int,
+                   default=_env_int("TRACE_SEED", d.trace_seed))
+    p.add_argument("--trace-path", dest="trace_path",
+                   default=_env_str("TRACE_PATH", d.trace_path))
+    p.add_argument("--metrics-dump-path", dest="metrics_dump_path",
+                   default=_env_str(
+                       "METRICS_DUMP_PATH", d.metrics_dump_path))
     return p
 
 
@@ -247,6 +266,10 @@ def parse_options(argv: Optional[List[str]] = None) -> Options:
         solver_backend=ns.solver_backend,
         solver_mesh=ns.solver_mesh,
         solver_address=ns.solver_address,
+        enable_tracing=ns.enable_tracing == "true",
+        trace_seed=ns.trace_seed,
+        trace_path=ns.trace_path,
+        metrics_dump_path=ns.metrics_dump_path,
     )
     opts.validate()
     return opts

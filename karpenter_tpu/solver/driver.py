@@ -18,11 +18,13 @@ from __future__ import annotations
 
 
 import logging
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..api import labels as labels_mod
 from ..api import resources as res
 from ..api.objects import NodePool, Pod
@@ -62,6 +64,12 @@ class EncodeCache:
         import threading
 
         self._fingerprint = None
+        # short content hash of the current catalog fingerprint — the
+        # encode_hash every decision audit record carries. Computed once
+        # per catalog change (repr of the full fingerprint is megabytes on
+        # an 800-type catalog; per-solve hashing would eat the <2% bench
+        # budget), read per solve.
+        self.content_hash = ""
         self.vocab = enc.Vocab()
         self.cache: dict = {}
         # pure per-node scheduler model inputs (taints, daemon remainder,
@@ -121,7 +129,12 @@ class EncodeCache:
         """Vocab + cache dict for this catalog; resets on fingerprint change."""
         fp = self.fingerprint(templates, its_by_pool, daemon_overhead, pool_limits)
         if fp != self._fingerprint:
+            import hashlib
+
             self._fingerprint = fp
+            self.content_hash = hashlib.blake2b(
+                repr(fp).encode(), digest_size=8
+            ).hexdigest()
             self.vocab = enc.Vocab()
             self.cache = {}
         return self.vocab, self.cache
@@ -232,16 +245,79 @@ class TpuSolver:
         # kernel dispatch count of the last solve_scenarios call (bench
         # telemetry: the whole probe set should cost <= 2 dispatches)
         self.last_scenario_dispatches = 0
+        # per-solve audit state (obs/audit.py): which rung produced the
+        # committed answer, what the invariant guard said, and any crash
+        # that made the scenario batch decline
+        self.last_dispatches = 0
+        self._audit_rung = "kernel"
+        self._audit_guard = "ok"
+        self._audit_error = ""
 
     # -- solve ------------------------------------------------------------
 
     def solve(self, pods: Sequence[Pod]) -> Results:
+        """One committed decision: the routed solve (below) inside a span,
+        followed by the decision audit record. Neither instrument touches
+        the decision itself (byte-identical-decisions contract,
+        tests/test_obs.py)."""
+        self.last_dispatches = 0
+        self._audit_rung = "kernel"
+        self._audit_guard = "ok"
+        fault_mark = self._fault_log_mark()
+        t0 = _time.perf_counter()
+        with obs.span("solve", pods=len(pods)) as sp:
+            results = self._solve_routed(pods)
+        self._emit_audit(
+            "solve", sp, t0, fault_mark,
+            pods=len(pods),
+            claims=len(results.new_node_claims),
+            errors=len(results.pod_errors),
+            scenario_count=0,
+            dispatches=self.last_dispatches,
+            # cost enrichment only under tracing: total_price walks every
+            # claim's options, and the untraced audit path must stay O(1)
+            cost=(
+                results.total_price() if obs.active() is not None else None
+            ),
+        )
+        return results
+
+    @staticmethod
+    def _fault_log_mark() -> int:
+        from .. import faults
+
+        inj = faults.active()
+        return len(inj.log) if inj is not None else 0
+
+    def _emit_audit(self, kind, sp, t0, fault_mark, **fields) -> None:
+        from .. import faults
+
+        inj = faults.active()
+        fired = (
+            sorted({s for s, _, _ in inj.log[fault_mark:]})
+            if inj is not None
+            else []
+        )
+        obs.AUDIT.record(
+            kind=kind,
+            trace_id=getattr(sp, "trace_id", ""),
+            duration_ms=round((_time.perf_counter() - t0) * 1000, 3),
+            encode_hash=self._shared_cache.content_hash,
+            rung=self._audit_rung,
+            guard=self._audit_guard,
+            fault_sites=fired,
+            **fields,
+        )
+
+    def _solve_routed(self, pods: Sequence[Pod]) -> Results:
         if self.config.force_oracle:
+            self._audit_rung = "oracle"
             return self.oracle.solve(pods)
         health = self.config.health
         if health is not None and not health.allow_kernel():
             # kernel rung is open (tripped breaker / quarantine cool-down):
             # the oracle rung is always available and exact
+            self._audit_rung = "oracle"
             return self.oracle.solve(pods)
         if (
             self.oracle.reserved_capacity_enabled
@@ -251,6 +327,7 @@ class TpuSolver:
             # strict reservation policy raises mid-Add and blocks pool
             # fallback (scheduler.py:244-258) — inherently sequential;
             # the kernel ledger covers the default fallback mode
+            self._audit_rung = "oracle"
             return self.oracle.solve(pods)
         mv_templates = [
             nct
@@ -263,6 +340,7 @@ class TpuSolver:
             # may narrow the claim's distinct values below the floor. The
             # kernel's bulk fills narrow options the same way but never
             # count distinct values, so minValues pools serialize host-side.
+            self._audit_rung = "oracle"
             return self.oracle.solve(pods)
         groups, rest = enc.partition_and_group(
             pods,
@@ -290,6 +368,8 @@ class TpuSolver:
                 # the invariant guard runs on the RAW kernel outputs, before
                 # any decode — nothing was committed, so the whole batch
                 # re-solves host-side while the kernel rung sits quarantined
+                self._audit_guard = f"quarantined: {exc}"
+                self._audit_rung = "oracle"
                 if health is None:
                     raise
                 health.quarantine("kernel", str(exc))
@@ -299,6 +379,8 @@ class TpuSolver:
                 # models: an oracle re-solve HERE would double-count them,
                 # so drop the whole batch — pods stay pending and the next
                 # cycle re-solves on a fresh solver with clean models
+                self._audit_guard = f"quarantined: {exc}"
+                self._audit_rung = "dropped"
                 if health is None:
                     raise
                 health.quarantine("kernel", str(exc))
@@ -313,6 +395,7 @@ class TpuSolver:
             except Exception as exc:
                 # dispatch/backend failure (XLA error, native load failure,
                 # injected fault): count toward the breaker and degrade
+                self._audit_rung = "oracle"
                 if health is None:
                     raise
                 health.record_kernel(
@@ -329,6 +412,9 @@ class TpuSolver:
                 for o in claim.reserved_offerings:
                     rm.reserve(f"tpu-claim-{i}", o)
 
+        if not groups:
+            # nothing rode the kernel: the oracle rung made this decision
+            self._audit_rung = "oracle"
         results = self.oracle.solve(rest) if rest else Results(
             new_node_claims=[], existing_nodes=self.oracle.existing_nodes, pod_errors={}
         )
@@ -388,6 +474,48 @@ class TpuSolver:
         oracle-routed pods need the host loop) — in which case the caller
         falls back to per-scenario solve()s. ``last_scenario_dispatches``
         records the kernel dispatch count of the last successful call."""
+        self._audit_rung = "batched"
+        self._audit_guard = "ok"
+        self._audit_error = ""
+        fault_mark = self._fault_log_mark()
+        t0 = _time.perf_counter()
+        with obs.span("scenarios", scenarios=len(scenarios)) as sp:
+            results = self._solve_scenarios_impl(scenarios)
+        if (
+            results is not None
+            or self._audit_guard != "ok"
+            or self._audit_error
+        ):
+            # completed batched decisions, quarantined ones, AND crashed
+            # dispatch/decode attempts — the audit trail must show WHY the
+            # caller replayed per-probe in every failure shape;
+            # representability declines solved nothing and stay silent
+            obs_claims = sum(
+                len(r.new_node_claims) for r in (results or [])
+            )
+            self._emit_audit(
+                "scenarios", sp, t0, fault_mark,
+                pods=sum(len(s.pods) for s in scenarios),
+                claims=obs_claims,
+                errors=sum(len(r.pod_errors) for r in (results or [])),
+                scenario_count=len(scenarios),
+                dispatches=self.last_scenario_dispatches,
+                cost=(
+                    sum(r.total_price() for r in (results or []))
+                    if obs.active() is not None
+                    else None
+                ),
+                attrs=(
+                    {"error": self._audit_error}
+                    if self._audit_error
+                    else {}
+                ),
+            )
+        return results
+
+    def _solve_scenarios_impl(
+        self, scenarios: Sequence[Scenario]
+    ) -> Optional[List[Results]]:
         self.last_scenario_dispatches = 0
         if not scenarios:
             return []
@@ -440,7 +568,8 @@ class TpuSolver:
                 for _ in scenarios
             ]
 
-        snap, avail, nmax_hint, lease_cache = self._encode_batch(groups)
+        with obs.span("solve.encode", groups=len(groups)):
+            snap, avail, nmax_hint, lease_cache = self._encode_batch(groups)
         a_tzc, res_cap0, a_res = avail
         if res_cap0.shape[0]:
             return None
@@ -505,15 +634,21 @@ class TpuSolver:
         fills_dtype = (
             jnp.int16 if self._fill_bound(snap, fit) < 2**15 else jnp.int32
         )
+        if obs.active() is not None:
+            # staged transfer as a measured phase, as in _solve_fast
+            with obs.span("solve.transfer"):
+                args = jax.device_put(list(args))
+                jax.block_until_ready(args)
         dispatches = 0
         try:
             while True:
-                out = dispatch_scenarios_packed(
-                    *args, nmax=nmax, fills_dtype=fills_dtype, **statics
-                )
-                (c_pool, packed, n_open, overflow,
-                 exist_fills, claim_fills, unplaced, c_dzone, c_dct,
-                 c_resv) = [np.asarray(x) for x in jax.device_get(out)]
+                with obs.span("solve.dispatch", nmax=nmax, scenarios=S_real):
+                    out = dispatch_scenarios_packed(
+                        *args, nmax=nmax, fills_dtype=fills_dtype, **statics
+                    )
+                    (c_pool, packed, n_open, overflow,
+                     exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                     c_resv) = [np.asarray(x) for x in jax.device_get(out)]
                 dispatches += 1
                 if not overflow.any():
                     break
@@ -521,7 +656,9 @@ class TpuSolver:
         except Exception as exc:
             # batched dispatch failed mid-search: nothing decoded, nothing
             # committed — record the rung failure and decline, so the
-            # caller replays per-probe (the documented fallback contract)
+            # caller replays per-probe (the documented fallback contract);
+            # the crash still lands in the audit trail (wrapper above)
+            self._audit_error = f"{type(exc).__name__}: {exc}"
             if health is None:
                 raise
             health.record_batched(
@@ -532,14 +669,17 @@ class TpuSolver:
         # invariant guard per scenario, still pre-decode: one corrupt
         # scenario poisons the whole batch (they share one dispatch)
         try:
-            for si in range(S_real):
-                self._verify_solution(
-                    snap, snap_run, c_pool[si], packed[si], int(n_open[si]),
-                    exist_fills[si], claim_fills[si], unplaced[si], nmax,
-                    g_count=g_count_s[si],
-                    c_dzone=c_dzone[si], c_dct=c_dct[si],
-                )
+            with obs.span("solve.guard", scenarios=S_real):
+                for si in range(S_real):
+                    self._verify_solution(
+                        snap, snap_run, c_pool[si], packed[si],
+                        int(n_open[si]),
+                        exist_fills[si], claim_fills[si], unplaced[si], nmax,
+                        g_count=g_count_s[si],
+                        c_dzone=c_dzone[si], c_dct=c_dct[si],
+                    )
         except SolverIntegrityError as exc:
+            self._audit_guard = f"quarantined: {exc}"
             if health is None:
                 raise
             health.quarantine("batched", str(exc))
@@ -555,39 +695,42 @@ class TpuSolver:
 
         results: List[Results] = []
         try:
-            for si in range(S_real):
-                # fills commit onto per-scenario node clones so scenarios
-                # never observe each other's placements (only touched nodes
-                # clone; the rest share the untouched oracle models)
-                nodes = list(self.oracle.existing_nodes)
-                for ni in np.nonzero(exist_fills[si].any(axis=0))[0]:
-                    if ni < len(nodes):
-                        nodes[ni] = _clone_existing_node(nodes[ni])
-                claims, errors = self._decode(
-                    snap,
-                    c_pool[si].astype(np.int32),
-                    packed[si],
-                    int(n_open[si]),
-                    exist_fills[si].astype(np.int32),
-                    claim_fills[si].astype(np.int32),
-                    unplaced[si],
-                    c_dzone[si].astype(np.int32),
-                    c_dct[si].astype(np.int32),
-                    c_resv[si].astype(bool),
-                    group_pods=scen_group_pods[si],
-                    existing_nodes=nodes,
-                )
-                results.append(
-                    Results(
-                        new_node_claims=claims,
+            with obs.span("solve.decode", scenarios=S_real):
+                for si in range(S_real):
+                    # fills commit onto per-scenario node clones so
+                    # scenarios never observe each other's placements (only
+                    # touched nodes clone; the rest share the untouched
+                    # oracle models)
+                    nodes = list(self.oracle.existing_nodes)
+                    for ni in np.nonzero(exist_fills[si].any(axis=0))[0]:
+                        if ni < len(nodes):
+                            nodes[ni] = _clone_existing_node(nodes[ni])
+                    claims, errors = self._decode(
+                        snap,
+                        c_pool[si].astype(np.int32),
+                        packed[si],
+                        int(n_open[si]),
+                        exist_fills[si].astype(np.int32),
+                        claim_fills[si].astype(np.int32),
+                        unplaced[si],
+                        c_dzone[si].astype(np.int32),
+                        c_dct[si].astype(np.int32),
+                        c_resv[si].astype(bool),
+                        group_pods=scen_group_pods[si],
                         existing_nodes=nodes,
-                        pod_errors=errors,
-                    ).truncate_instance_types()
-                )
+                    )
+                    results.append(
+                        Results(
+                            new_node_claims=claims,
+                            existing_nodes=nodes,
+                            pod_errors=errors,
+                        ).truncate_instance_types()
+                    )
         except Exception as exc:
             # scenario decode commits onto clones, so a crash pollutes
             # nothing shared — decline the batch and let the caller replay
             # per-probe (which re-guards and re-decodes independently)
+            self._audit_error = f"{type(exc).__name__}: {exc}"
             if health is None:
                 raise
             health.record_batched(
@@ -608,7 +751,8 @@ class TpuSolver:
                 for g in groups
                 for p in g.pods
             }
-        snap, avail, nmax_hint, lease_cache = self._encode_batch(groups)
+        with obs.span("solve.encode", groups=len(groups)):
+            snap, avail, nmax_hint, lease_cache = self._encode_batch(groups)
         a_tzc, res_cap0, a_res = avail
         fit = self._fit_matrix(snap)
         # adaptive sizing inside _select_nmax: the a-priori estimate sums
@@ -636,6 +780,22 @@ class TpuSolver:
         else:
             snap_run = snap
             args = snap.solve_args(a_tzc, res_cap0, a_res)
+
+        if (
+            obs.active() is not None
+            and self.config.backend == "tpu"
+            and self._resolve_mesh() is None
+        ):
+            # with tracing on, stage the snapshot onto the device as its
+            # own measured phase so transfer time is attributable apart
+            # from kernel time (untraced solves keep the fused
+            # transfer+dispatch jit call — jit accepts the staged arrays
+            # identically, so decisions don't change either way)
+            import jax
+
+            with obs.span("solve.transfer"):
+                args = jax.device_put(list(args))
+                jax.block_until_ready(args)
 
         if self.config.backend == "native":
             from .. import native
@@ -728,9 +888,11 @@ class TpuSolver:
             )
 
         while True:
-            (c_pool, c_tmask, n_open, overflow,
-             exist_fills, claim_fills, unplaced, c_dzone, c_dct,
-             c_resv) = call(nmax)
+            with obs.span("solve.dispatch", nmax=nmax):
+                (c_pool, c_tmask, n_open, overflow,
+                 exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                 c_resv) = call(nmax)
+            self.last_dispatches += 1
             if not overflow:
                 break
             nmax *= 2
@@ -738,21 +900,23 @@ class TpuSolver:
         # with zero state mutated (faults/guard.py — conservation,
         # capacity, pool limits, domain-pin ranges), so the oracle
         # fallback is exact
-        self._verify_solution(
-            snap, snap_run, c_pool, c_tmask, int(n_open),
-            exist_fills, claim_fills, unplaced, nmax,
-            c_dzone=c_dzone, c_dct=c_dct,
-        )
+        with obs.span("solve.guard"):
+            self._verify_solution(
+                snap, snap_run, c_pool, c_tmask, int(n_open),
+                exist_fills, claim_fills, unplaced, nmax,
+                c_dzone=c_dzone, c_dct=c_dct,
+            )
         if self.config.max_claims is None:
             with self._shared_cache.lock:
                 lease_cache["nmax_hint"] = max(
                     lease_cache.get("nmax_hint", 0), int(n_open)
                 )
         try:
-            return self._decode(
-                snap, c_pool, c_tmask, int(n_open), exist_fills,
-                claim_fills, unplaced, c_dzone, c_dct, c_resv,
-            )
+            with obs.span("solve.decode", claims=int(n_open)):
+                return self._decode(
+                    snap, c_pool, c_tmask, int(n_open), exist_fills,
+                    claim_fills, unplaced, c_dzone, c_dct, c_resv,
+                )
         except Exception as exc:
             # decode mutates the live existing-node models as it walks
             # (driver._decode); a crash here may have HALF-committed —

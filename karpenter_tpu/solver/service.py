@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import grpc
 
-from .. import faults
+from .. import faults, obs
 from ..api.objects import NodePool, Pod
 from ..cloudprovider import types as cp
 from ..kube import Client, TestClock
@@ -169,21 +169,31 @@ class SolverService(grpc.GenericRpcHandler):
         self.config = config
 
     def _handle(self, request, context):
-        try:
-            snap = wire.decode_solve_request(request)
-        except Exception as exc:
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"malformed solve request: {type(exc).__name__}: {exc}",
-            )
-        try:
-            return _solve_decoded(snap, self.config)
-        except Exception as exc:
-            _LOG.exception("solve failed")
-            context.abort(
-                grpc.StatusCode.INTERNAL,
-                f"solve failed: {type(exc).__name__}: {exc}",
-            )
+        # trace context rides the gRPC metadata (obs/trace.py): when the
+        # caller sent one, the sidecar's spans adopt the caller's trace id
+        # and parent on the caller's span — so the stitched trace shows
+        # the RemoteSolver hop as one tree across both processes
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        with obs.span(
+            "sidecar.solve",
+            trace_id=md.get(obs.TRACE_ID_METADATA_KEY),
+            parent_id=md.get(obs.PARENT_ID_METADATA_KEY),
+        ):
+            try:
+                snap = wire.decode_solve_request(request)
+            except Exception as exc:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"malformed solve request: {type(exc).__name__}: {exc}",
+                )
+            try:
+                return _solve_decoded(snap, self.config)
+            except Exception as exc:
+                _LOG.exception("solve failed")
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"solve failed: {type(exc).__name__}: {exc}",
+                )
 
     def service(self, handler_call_details):
         if handler_call_details.method != SOLVE_METHOD:
@@ -280,12 +290,24 @@ class RemoteSolver:
     def _dispatch(self, request: bytes) -> Optional[bytes]:
         """The raw RPC with one bounded retry on retriable status codes;
         None when the sidecar is out (callers degrade in-process)."""
+        # propagate trace context so the sidecar's spans stitch into the
+        # caller's trace (obs/trace.py; SolverService._handle reads these)
+        metadata = None
+        cur = obs.current_span()
+        if cur is not None:
+            metadata = (
+                (obs.TRACE_ID_METADATA_KEY, cur.trace_id),
+                (obs.PARENT_ID_METADATA_KEY, cur.span_id),
+            )
         for attempt in range(2):
             try:
                 # chaos seam: plans raise InjectedRpcError here to model
                 # channel outages and deadline blowouts
                 faults.hit(faults.REMOTE_SOLVE, attempt=attempt)
-                return self._solve(request, timeout=self.timeout)
+                with obs.span("remote.dispatch", attempt=attempt):
+                    return self._solve(
+                        request, timeout=self.timeout, metadata=metadata
+                    )
             except grpc.RpcError as exc:
                 code = _status_name(exc)
                 if code not in RETRIABLE_CODES:
@@ -300,6 +322,10 @@ class RemoteSolver:
         """Degraded rung: the sidecar is unreachable, so run the identical
         solve locally from the parts the request was built from."""
         self.fallback_solves += 1
+        with obs.span("remote.fallback", pods=len(pods)):
+            return self._build_and_solve(pods)
+
+    def _build_and_solve(self, pods: Sequence[Pod]) -> Results:
         solver = build_solver(
             pods,
             self.node_pools,
@@ -316,6 +342,10 @@ class RemoteSolver:
         return solver.solve(pods)
 
     def solve(self, pods: Sequence[Pod]) -> Results:
+        with obs.span("remote.solve", pods=len(pods)):
+            return self._solve_remote(pods)
+
+    def _solve_remote(self, pods: Sequence[Pod]) -> Results:
         from ..scheduling.template import NodeClaimTemplate
 
         request = wire.encode_solve_request(

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 import msgpack
 
+from .. import obs
 from ..api import objects as obj
 from ..api import resources as res
 from ..api.requirements import Requirement, Requirements
@@ -190,24 +191,30 @@ def encode_solve_request(
     ``volume_objects`` are the PVC/PV/StorageClass objects pending pods
     reference, so the sidecar's VolumeResolver answers identically to the
     controller's (volumeusage.go resolveDriver/VolumeName)."""
-    return msgpack.packb(
-        {
-            "pods": [to_wire(p) for p in pods],
-            "node_pools": [to_wire(np_) for np_ in node_pools],
-            "instance_types": {
-                pool: [to_wire(it) for it in its]
-                for pool, its in instance_types.items()
+    with obs.span("wire.encode_request", pods=len(pods)):
+        return msgpack.packb(
+            {
+                "pods": [to_wire(p) for p in pods],
+                "node_pools": [to_wire(np_) for np_ in node_pools],
+                "instance_types": {
+                    pool: [to_wire(it) for it in its]
+                    for pool, its in instance_types.items()
+                },
+                "daemonset_pods": [to_wire(p) for p in daemonset_pods],
+                "solver_options": dict(solver_options or {}),
+                "state_nodes": [encode_state_node(sn) for sn in state_nodes],
+                "volume_objects": [to_wire(o) for o in volume_objects],
             },
-            "daemonset_pods": [to_wire(p) for p in daemonset_pods],
-            "solver_options": dict(solver_options or {}),
-            "state_nodes": [encode_state_node(sn) for sn in state_nodes],
-            "volume_objects": [to_wire(o) for o in volume_objects],
-        },
-        use_bin_type=True,
-    )
+            use_bin_type=True,
+        )
 
 
 def decode_solve_request(data: bytes) -> Dict[str, Any]:
+    with obs.span("wire.decode_request", bytes=len(data)):
+        return _decode_solve_request(data)
+
+
+def _decode_solve_request(data: bytes) -> Dict[str, Any]:
     raw = msgpack.unpackb(data, raw=False)
     return {
         "pods": [from_wire(p) for p in raw["pods"]],

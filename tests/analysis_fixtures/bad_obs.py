@@ -1,0 +1,38 @@
+"""Seeded-bad fixture for the OBS8xx observability-hygiene pass: span
+leaks (OBS801) and per-call metric construction (OBS802). Never imported;
+parsed by tests/test_analysis.py."""
+
+from karpenter_tpu import obs
+from karpenter_tpu.metrics import Counter, Gauge, Histogram
+
+
+def leaks_plain_call(tracer):
+    tracer.span("encode")  # OBS801: opened and dropped on the floor
+
+
+def leaks_assigned_span(tracer):
+    sp = tracer.span("dispatch")  # OBS801: assigned, never closed
+    do_work()
+    sp.annotate(done=True)
+
+
+def leaks_module_helper():
+    sp = obs.span("decode")  # OBS801: no with, no finally
+    do_work()
+    return 1
+
+
+def churns_counter():
+    # OBS802: a new metric registered in the global registry per call
+    c = Counter("per_call_counter", "churn")
+    c.inc()
+
+
+def churns_gauge_and_histogram(value):
+    Gauge("per_call_gauge", "churn").set(value)  # OBS802
+    h = Histogram("per_call_histogram", "churn")  # OBS802
+    h.observe(value)
+
+
+def do_work():
+    pass

@@ -1,0 +1,50 @@
+"""Clean fixture for the OBS8xx pass: every allowed span-closing shape and
+the sanctioned metric-construction shapes. Must produce zero findings."""
+
+import contextlib
+
+from karpenter_tpu import obs
+from karpenter_tpu.metrics import Counter, Gauge, Registry
+
+# OBS802-clean: metrics constructed once, at module scope
+REQUESTS = Counter("fixture_requests_total", "module-scope construction")
+DEPTH = Gauge("fixture_depth", "module-scope construction")
+
+
+def context_managed(tracer):
+    with tracer.span("encode"):
+        REQUESTS.inc()
+
+
+def context_managed_with_as(tracer):
+    with obs.span("dispatch", kernel="pack") as sp:
+        sp.annotate(ok=True)
+
+
+def returns_span_to_caller(tracer):
+    # a factory handing the context manager up for the caller's `with`
+    return tracer.span("decode")
+
+
+def exit_stack(tracer):
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(tracer.span("guard"))
+        REQUESTS.inc()
+
+
+def finally_closed(tracer):
+    sp = tracer.span("commit")
+    sp.__enter__()
+    try:
+        REQUESTS.inc()
+    finally:
+        sp.__exit__(None, None, None)
+
+
+def scoped_registry_metric():
+    # OBS802-exempt: an explicit scoped registry is the designed way to
+    # build metrics dynamically (tests, sandboxed dumps)
+    reg = Registry()
+    c = Counter("fixture_scoped_total", "scoped", registry=reg)
+    c.inc()
+    return reg

@@ -242,7 +242,10 @@ _ARTIFACT = os.path.join(os.path.dirname(__file__), "last_run.json")
 
 
 def record(scenario_name: str, timer: PhaseTimer, **extra) -> None:
-    """Append this scenario's phases to the artifact file."""
+    """Append this scenario's phases to the artifact file, and flush the
+    metrics registry's Prometheus exposition next to it (the sim-harness
+    side of the Operator.shutdown dump — scenario runs leave a scrapeable
+    snapshot of every counter/gauge/histogram)."""
     data = {}
     if os.path.exists(_ARTIFACT):
         try:
@@ -255,3 +258,6 @@ def record(scenario_name: str, timer: PhaseTimer, **extra) -> None:
     data[scenario_name] = entry
     with open(_ARTIFACT, "w") as fh:
         json.dump(data, fh, indent=1)
+    from karpenter_tpu.metrics import REGISTRY
+
+    REGISTRY.dump(os.path.join(os.path.dirname(_ARTIFACT), "metrics.prom"))

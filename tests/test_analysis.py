@@ -12,6 +12,7 @@ from karpenter_tpu.analysis import (
     all_rules,
     blocking,
     locks,
+    obs,
     parity,
     retry,
     schema_drift,
@@ -540,6 +541,54 @@ class TestRetryPass:
         assert remaining == [], [f.render() for f in remaining]
 
 
+class TestObsPass:
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = obs.check_paths([fixture("bad_obs.py")])
+        assert rules_of(findings) == {"OBS801", "OBS802"}
+        # three leak shapes (dropped call, assigned-never-closed, module
+        # helper) and three per-call metric constructions
+        assert sum(1 for f in findings if f.rule == "OBS801") == 3
+        assert sum(1 for f in findings if f.rule == "OBS802") == 3
+
+    def test_clean_fixture_silent(self):
+        findings, _ = obs.check_paths([fixture("good_obs.py")])
+        assert findings == []
+
+    def test_with_statement_and_factory_return_allowed(self, tmp_path):
+        (tmp_path / "ok.py").write_text(
+            "def f(t):\n"
+            "    with t.span('a'):\n"
+            "        pass\n"
+            "def g(t):\n"
+            "    return t.span('b')\n"
+        )
+        findings, _ = obs.check_paths([str(tmp_path)])
+        assert findings == []
+
+    def test_scoped_registry_exempt(self, tmp_path):
+        (tmp_path / "scoped.py").write_text(
+            "from karpenter_tpu.metrics import Counter, Registry\n"
+            "def f():\n"
+            "    return Counter('x', registry=Registry())\n"
+        )
+        findings, _ = obs.check_paths([str(tmp_path)])
+        assert findings == []
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = obs.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"OBS800"}
+
+    def test_real_tree_clean(self):
+        """Dogfood: every span in the package is context-managed and every
+        metric is module-scoped (or scoped-registry)."""
+        findings, sources = obs.check_paths(
+            [os.path.join(REPO, "karpenter_tpu")]
+        )
+        remaining = filter_suppressed(findings, sources)
+        assert remaining == [], [f.render() for f in remaining]
+
+
 class TestRuleRegistry:
     """The meta-contract: every shipped rule id has at least one seeded-bad
     fixture. Parse-failure rules (x00) are seeded at runtime because a
@@ -548,7 +597,7 @@ class TestRuleRegistry:
     def test_registry_covers_every_pass(self):
         rules = all_rules()
         for prefix in (
-            "TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6", "RTY7",
+            "TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6", "RTY7", "OBS8",
         ):
             assert any(r.startswith(prefix) for r in rules), prefix
 
@@ -582,6 +631,7 @@ class TestRuleRegistry:
             parity.check_parity(str(broken), fixture("parity_good.cc")),
             shapes.check_paths([fixture("bad_shapes.py"), str(broken)]),
             retry.check_paths([fixture("bad_retry.py"), str(broken)]),
+            obs.check_paths([fixture("bad_obs.py"), str(broken)]),
         ]
         for findings, _sources in runs:
             produced |= {f.rule for f in findings}
@@ -648,6 +698,7 @@ class TestCli:
             ("tracer", "bad_tracer.py"),
             ("locks", "bad_locks.py"),
             ("blocking", "bad_blocking.py"),
+            ("obs", "bad_obs.py"),
         ],
     )
     def test_cli_nonzero_on_seeded_violation(self, pass_name, target):

@@ -20,16 +20,37 @@ Plus two cheaper contract checks: blocking calls in reconcile paths that
 must go through the injectable kube/clock.py (blocking.py), and structural
 drift between api/schema.py and the checked-in CRD YAML (schema_drift.py).
 
+Since the dataflow core landed (analysis/core/: intraprocedural CFG +
+forward fixpoint + one-level same-module helper summaries), the
+flow-shaped families ride it: tracer.py and retry.py are migrated, and
+two new families guard the delta-encode roadmap — device.py (DTX9xx:
+device values tracked from jnp/device_put/kernel-dispatch origins to
+host-sync sinks, with ``jax.device_get`` as the explicitly sanctioned
+decode boundary) and clock.py (CLK10xx: every timestamp in
+controllers/faults/obs/solver must flow from an injected clock or the
+documented RealClock seams — the replay-determinism contract,
+machine-checked).
+
 Run ``python -m karpenter_tpu.analysis`` (or hack/analyze.py); it exits
 nonzero on any new finding. Suppress with an inline
 ``# analysis: ignore[RULE] reason`` on the flagged line (or the line
 above; ``//`` in C++ sources), or a baseline entry in
-hack/analysis_baseline.txt.
+hack/analysis_baseline.txt. Documented boundary crossings (the decode
+readback, real-wall-time diagnostics) carry
+``# analysis: sanctioned[RULE] reason`` instead — counted separately,
+never lumped in with suppressions, audited for staleness all the same
+(STALE001, ``--prune-baseline``).
 """
 
 from typing import Dict
 
-from .findings import Finding, Severity, load_baseline, filter_suppressed
+from .findings import (
+    Finding,
+    Severity,
+    filter_suppressed,
+    load_baseline,
+    partition_findings,
+)
 
 
 def all_rules() -> Dict[str, str]:
@@ -37,17 +58,20 @@ def all_rules() -> Dict[str, str]:
     pass modules. The meta-test in tests/test_analysis.py asserts each has
     a seeded-bad fixture; the SARIF writer uses it for rule metadata."""
     from . import (
-        blocking, locks, obs, parity, retry, schema_drift, shapes, tracer,
+        blocking, clock, device, locks, obs, parity, retry, schema_drift,
+        shapes, stale, tracer,
     )
 
     out: Dict[str, str] = {}
     for mod in (
         tracer, locks, blocking, schema_drift, parity, shapes, retry, obs,
+        device, clock, stale,
     ):
         out.update(getattr(mod, "RULES", {}))
     return out
 
 
 __all__ = [
-    "Finding", "Severity", "load_baseline", "filter_suppressed", "all_rules",
+    "Finding", "Severity", "load_baseline", "filter_suppressed",
+    "partition_findings", "all_rules",
 ]

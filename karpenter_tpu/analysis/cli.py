@@ -12,13 +12,27 @@ Default targets mirror the hazards each pass exists for:
 - shapes:   karpenter_tpu/ops, karpenter_tpu/solver (axis/dtype walker)
 - retry:    karpenter_tpu/controllers, karpenter_tpu/solver, operator.py
             (swallowed exceptions, unbounded retry loops)
+- device:   karpenter_tpu/ops, solver/driver.py, faults/guard.py
+            (DTX9xx device-residency dataflow)
+- clock:    karpenter_tpu/controllers, faults/, obs/, solver/
+            (CLK10xx clock-discipline dataflow)
 
 Positional paths (with ``--pass``) override a pass's default targets so
 fixture suites can point a single pass at seeded-bad files. Exit status is
 the number of unsuppressed findings capped at 1 — suitable for presubmit.
-``--format sarif`` emits SARIF 2.1.0 for code-review UIs;
-``--write-baseline`` regenerates hack/analysis_baseline.txt so bulk
-grandfathering is a designed workflow instead of a hand-edit.
+
+``--changed-only`` scopes file discovery to ``git diff --name-only
+<--base>`` plus untracked files — the presubmit fast lane; the full run
+(default, or explicit ``--all``) is the slow-lane gate and the only mode
+that runs the stale-suppression audit (STALE001) — staleness can only be
+judged when every producing pass ran. ``--prune-baseline`` rewrites
+hack/analysis_baseline.txt with stale entries dropped.
+
+``--format sarif`` emits SARIF 2.1.0 with the analyzer's own runtime in
+the run properties (per-pass seconds — the BENCH-adjacent artifact that
+makes analyzer-speed regressions visible); ``--write-baseline``
+regenerates hack/analysis_baseline.txt so bulk grandfathering is a
+designed workflow instead of a hand-edit.
 """
 
 from __future__ import annotations
@@ -26,26 +40,32 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import Dict, List
+import time
+from typing import Dict, List, Optional, Set
 
 from . import (
     all_rules,
     blocking,
+    clock,
+    device,
     locks,
     obs,
     parity,
     retry,
     schema_drift,
     shapes,
+    stale,
     tracer,
 )
+from .astutil import iter_py_files
 from .findings import (
     Finding,
     Severity,
     SourceFile,
-    filter_suppressed,
     load_baseline,
+    partition_findings,
     write_baseline,
 )
 
@@ -85,7 +105,26 @@ PASS_TARGETS = {
     # observability hygiene: span leaks and per-call metric construction
     # anywhere in the package (the obs seams thread through everything)
     "obs": ["karpenter_tpu"],
+    # device-residency dataflow over the solve path: where device values
+    # are born (ops/), routed (driver), and guarded (faults/guard.py)
+    "device": [
+        "karpenter_tpu/ops",
+        "karpenter_tpu/solver/driver.py",
+        "karpenter_tpu/faults/guard.py",
+    ],
+    # clock discipline over the determinism surface: every timestamp in
+    # these trees must flow from an injected clock or a RealClock seam
+    "clock": [
+        "karpenter_tpu/controllers",
+        "karpenter_tpu/faults",
+        "karpenter_tpu/obs",
+        "karpenter_tpu/solver",
+    ],
 }
+
+# passes whose targets are a comparison pair, not a scanned file set:
+# --changed-only runs them when ANY of their targets changed
+_PAIR_PASSES = {"schema", "parity"}
 
 
 def _run_pass(name: str, targets: List[str]):
@@ -114,71 +153,124 @@ def _run_pass(name: str, targets: List[str]):
         return retry.check_paths(targets)
     if name == "obs":
         return obs.check_paths(targets)
+    if name == "device":
+        return device.check_paths(targets)
+    if name == "clock":
+        return clock.check_paths(targets)
     raise ValueError(f"unknown pass {name!r}")
 
 
-def _sarif(findings: List[Finding]) -> dict:
+def _changed_files(root: str, base: str) -> Optional[Set[str]]:
+    """Absolute paths changed vs ``base`` (diff + untracked), or None when
+    git is unavailable (callers fall back to the full run)."""
+    changed: Set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", base],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, capture_output=True, text=True, cwd=root, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line:
+                changed.add(os.path.abspath(os.path.join(root, line)))
+    return changed
+
+
+def _scope_targets(
+    name: str, targets: List[str], changed: Set[str]
+) -> List[str]:
+    """The subset of a pass's targets --changed-only should run."""
+    if name in _PAIR_PASSES:
+        hit = False
+        for t in targets:
+            if os.path.isdir(t):
+                hit = hit or any(c.startswith(t + os.sep) for c in changed)
+            else:
+                hit = hit or os.path.abspath(t) in changed
+        return targets if hit else []
+    out: List[str] = []
+    for t in targets:
+        for path in iter_py_files([t]):
+            if os.path.abspath(path) in changed:
+                out.append(path)
+    return out
+
+
+def _sarif(findings: List[Finding], properties: Optional[dict] = None) -> dict:
     """Minimal SARIF 2.1.0 document for the given (unsuppressed) findings."""
     rules_meta = all_rules()
     used = sorted({f.rule for f in findings})
+    run = {
+        "tool": {
+            "driver": {
+                # informationUri omitted: SARIF 2.1.0 requires an
+                # absolute URI and this tool has no canonical URL
+                "name": "karpenter-tpu-analysis",
+                "rules": [
+                    {
+                        "id": rule,
+                        "shortDescription": {
+                            "text": rules_meta.get(rule, rule)
+                        },
+                    }
+                    for rule in used
+                ],
+            }
+        },
+        "results": [
+            {
+                "ruleId": f.rule,
+                "level": (
+                    "error" if f.severity == Severity.ERROR
+                    else "warning"
+                ),
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": max(f.line, 1)
+                            },
+                        }
+                    }
+                ],
+            }
+            for f in sorted(
+                findings, key=lambda f: (f.path, f.line, f.rule)
+            )
+        ],
+    }
+    if properties:
+        # analyzer self-runtime rides in the run properties: the SARIF
+        # artifact doubles as the BENCH-adjacent record that makes
+        # analyzer-speed regressions visible across PRs
+        run["properties"] = properties
     return {
         "$schema": (
             "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
             "master/Schemata/sarif-schema-2.1.0.json"
         ),
         "version": "2.1.0",
-        "runs": [
-            {
-                "tool": {
-                    "driver": {
-                        # informationUri omitted: SARIF 2.1.0 requires an
-                        # absolute URI and this tool has no canonical URL
-                        "name": "karpenter-tpu-analysis",
-                        "rules": [
-                            {
-                                "id": rule,
-                                "shortDescription": {
-                                    "text": rules_meta.get(rule, rule)
-                                },
-                            }
-                            for rule in used
-                        ],
-                    }
-                },
-                "results": [
-                    {
-                        "ruleId": f.rule,
-                        "level": (
-                            "error" if f.severity == Severity.ERROR
-                            else "warning"
-                        ),
-                        "message": {"text": f.message},
-                        "locations": [
-                            {
-                                "physicalLocation": {
-                                    "artifactLocation": {"uri": f.path},
-                                    "region": {
-                                        "startLine": max(f.line, 1)
-                                    },
-                                }
-                            }
-                        ],
-                    }
-                    for f in sorted(
-                        findings, key=lambda f: (f.path, f.line, f.rule)
-                    )
-                ],
-            }
-        ],
+        "runs": [run],
     }
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m karpenter_tpu.analysis",
-        description="AST static analysis: tracer-safety, lock ordering, "
-        "blocking calls, schema drift, kernel-twin parity, axis/dtype "
-        "shape discipline",
+        description="Static analysis on the shared dataflow core: "
+        "tracer-safety, lock ordering, blocking calls, schema drift, "
+        "kernel-twin parity, axis/dtype shape discipline, retry hygiene, "
+        "observability hygiene, device-residency (DTX9xx), and clock "
+        "discipline (CLK10xx)",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -208,6 +300,25 @@ def main(argv=None) -> int:
         help="write current findings to the baseline file and exit 0",
     )
     parser.add_argument(
+        "--changed-only", action="store_true",
+        help="scope file discovery to `git diff --name-only <--base>` "
+        "plus untracked files (the presubmit fast lane); skips the "
+        "stale-suppression audit",
+    )
+    parser.add_argument(
+        "--base", default="HEAD",
+        help="git ref --changed-only diffs against (default: HEAD)",
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="force the full run (the default; overrides --changed-only)",
+    )
+    parser.add_argument(
+        "--prune-baseline", action="store_true",
+        help="run the full analysis, drop baseline entries matching no "
+        "finding, rewrite the baseline, and exit 0",
+    )
+    parser.add_argument(
         "--format", choices=("text", "sarif"), default="text",
         help="finding output format (sarif: SARIF 2.1.0 JSON on stdout)",
     )
@@ -216,21 +327,60 @@ def main(argv=None) -> int:
     selected = args.passes or sorted(PASS_TARGETS)
     if args.paths and len(selected) != 1:
         parser.error("explicit paths require exactly one --pass")
+    if args.prune_baseline:
+        # pruning needs the FULL finding set to judge staleness, and a
+        # loaded baseline to prune — partial runs would silently prune
+        # nothing, and --no-baseline would truncate every entry
+        if args.no_baseline:
+            parser.error("--prune-baseline conflicts with --no-baseline")
+        if args.passes or args.paths or args.write_baseline:
+            parser.error(
+                "--prune-baseline requires the full run (no --pass, "
+                "paths, or --write-baseline)"
+            )
+        args.changed_only = False  # force the full file set
 
     root = os.path.abspath(args.root)
+    changed: Optional[Set[str]] = None
+    if args.changed_only and not args.all and not args.paths:
+        changed = _changed_files(root, args.base)
+        if changed is None:
+            print(
+                "analysis: --changed-only needs git; running the full set",
+                file=sys.stderr,
+            )
+
+    t_start = time.perf_counter()
+    pass_seconds: Dict[str, float] = {}
     all_findings: List[Finding] = []
     all_sources: Dict[str, SourceFile] = {}
+    # rule id -> abs paths its pass scanned (stale-audit accuracy gate)
+    scanned_by_rule: Dict[str, Set[str]] = {}
     for name in selected:
         if args.paths:
             targets = args.paths
         else:
             targets = [os.path.join(root, t) for t in PASS_TARGETS[name]]
             targets = [t for t in targets if os.path.exists(t)]
+            if changed is not None:
+                targets = _scope_targets(name, targets, changed)
             if not targets:
                 continue
+        t0 = time.perf_counter()
         findings, sources = _run_pass(name, targets)
+        pass_seconds[name] = round(time.perf_counter() - t0, 4)
         all_findings.extend(findings)
         all_sources.update(sources)
+        rules = getattr(
+            {
+                "tracer": tracer, "locks": locks, "blocking": blocking,
+                "schema": schema_drift, "parity": parity, "shapes": shapes,
+                "retry": retry, "obs": obs, "device": device, "clock": clock,
+            }[name],
+            "RULES", {},
+        )
+        for rule in rules:
+            scanned_by_rule.setdefault(rule, set()).update(sources)
 
     # repo-relative paths in output and baseline keys
     def relativize(f: Finding) -> Finding:
@@ -240,9 +390,17 @@ def main(argv=None) -> int:
         return Finding(f.rule, f.severity, rel, f.line, f.message)
 
     rel_sources = {}
+    rel_scanned: Dict[str, Set[str]] = {}
     for path, src in all_sources.items():
         rel = os.path.relpath(path, root)
         rel_sources[rel if not rel.startswith("..") else path] = src
+    for rule, paths in scanned_by_rule.items():
+        rel_scanned[rule] = {
+            os.path.relpath(p, root)
+            if not os.path.relpath(p, root).startswith("..")
+            else p
+            for p in paths
+        }
     all_findings = [relativize(f) for f in all_findings]
 
     baseline_path = (
@@ -251,13 +409,64 @@ def main(argv=None) -> int:
         else os.path.join(root, args.baseline)
     )
     baseline = None if args.no_baseline else load_baseline(baseline_path)
-    remaining = filter_suppressed(all_findings, rel_sources, baseline)
+
+    # stale-suppression audit: full runs only — staleness can only be
+    # judged when every pass that could match a marker actually ran
+    full_run = (
+        not args.paths
+        and changed is None
+        and not args.passes
+        and not args.write_baseline
+    )
+    stale_findings: List[Finding] = []
+    stale_entries: Set = set()
+    if full_run and not args.no_baseline:
+        stale_findings, stale_entries = stale.audit(
+            all_findings, rel_sources, baseline,
+            os.path.relpath(baseline_path, root),
+            scanned_by_rule=rel_scanned,
+        )
+
+    if args.prune_baseline:
+        live = sorted((baseline or set()) - stale_entries)
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            fh.write(
+                "# Static-analysis baseline: known findings tolerated by\n"
+                "# `python -m karpenter_tpu.analysis`. One per line,\n"
+                "# RULE<TAB>path<TAB>message. Regenerate with "
+                "--write-baseline;\n"
+                "# prefer inline `# analysis: ignore[RULE] reason` for "
+                "findings\n"
+                "# that are intentionally safe.\n"
+            )
+            if not live:
+                fh.write(
+                    "#\n# Currently empty: every tolerated finding carries "
+                    "an inline\n# suppression next to the code it "
+                    "describes.\n"
+                )
+            for rule, fpath, message in live:
+                fh.write(f"{rule}\t{fpath}\t{message}\n")
+        print(
+            f"analysis: pruned {len(stale_entries)} stale baseline "
+            f"entr{'y' if len(stale_entries) == 1 else 'ies'}; "
+            f"{len(live)} kept"
+        )
+        for f in stale_findings:
+            if f.path != os.path.relpath(baseline_path, root):
+                print(f.render())
+        return 0
+
+    remaining, suppressed_fs, sanctioned_fs = partition_findings(
+        all_findings, rel_sources, baseline
+    )
+    remaining = remaining + stale_findings
 
     if args.write_baseline:
         # regenerate from the inline-filtered set only: filtering through
         # the existing baseline would drop still-needed grandfathered
         # entries from the rewritten file
-        grandfather = filter_suppressed(all_findings, rel_sources, None)
+        grandfather, _, _ = partition_findings(all_findings, rel_sources, None)
         write_baseline(baseline_path, grandfather)
         print(
             f"analysis: wrote {len(grandfather)} finding(s) to "
@@ -265,19 +474,32 @@ def main(argv=None) -> int:
         )
         return 0
 
+    total_seconds = round(time.perf_counter() - t_start, 4)
     if args.format == "sarif":
-        json.dump(_sarif(remaining), sys.stdout, indent=2)
+        properties = {
+            "analysisSeconds": total_seconds,
+            "passSeconds": pass_seconds,
+            "sanctionedSites": len(sanctioned_fs),
+            "suppressedFindings": len(suppressed_fs),
+            "changedOnly": changed is not None,
+        }
+        json.dump(_sarif(remaining, properties), sys.stdout, indent=2)
         print()
     else:
         for f in sorted(remaining, key=lambda f: (f.path, f.line, f.rule)):
             print(f.render())
-    suppressed = len(all_findings) - len(remaining)
     errors = [f for f in remaining if f.severity == Severity.ERROR]
     summary = f"analysis: {len(remaining)} finding(s)"
     if len(remaining) != len(errors):
         summary += f" ({len(remaining) - len(errors)} warning-only)"
-    if suppressed:
-        summary += f" ({suppressed} suppressed)"
+    if suppressed_fs:
+        summary += f" ({len(suppressed_fs)} suppressed)"
+    if sanctioned_fs:
+        summary += f" ({len(sanctioned_fs)} sanctioned boundary site(s))"
+    summary += f" [{total_seconds:.2f}s"
+    if changed is not None:
+        summary += f", changed-only over {len(changed)} file(s)"
+    summary += "]"
     print(summary, file=sys.stderr)
     # warnings (e.g. "pass skipped: PyYAML unavailable") inform but don't
     # fail presubmit; only error-severity findings gate

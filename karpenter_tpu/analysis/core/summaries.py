@@ -1,0 +1,111 @@
+"""Module loading and one-level call-graph summaries.
+
+``load_modules`` parses a file set once into ``ModuleInfo`` handles
+(source, tree, import aliases, function index) shared by every rule
+family on the core — the same one-read-per-file discipline findings.py's
+``SourceFile`` established.
+
+``resolve_local`` resolves a bare callee name used in one module to a
+function def anywhere in the scanned set — locally, or through a
+``from .x import name`` alias — mirroring how PAR5xx resolves shared
+constants across the kernel twins.
+
+``ReturnSummaries`` memoizes per-function return summaries with a
+recursion guard: summaries reach exactly ONE level of same-module
+helpers (a helper's own summary is computed with nested helper calls
+unresolved), which keeps the interprocedural step predictable and the
+fixpoint trivial. Clients supply the compute thunk; the guard hands
+back the lattice default on self/mutual recursion.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..astutil import FunctionIndex, import_aliases, iter_py_files, parse_file
+from ..findings import SourceFile
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed module, shared by the core-hosted passes."""
+
+    path: str
+    src: SourceFile
+    tree: ast.Module
+    aliases: Dict[str, str] = field(default_factory=dict)
+    index: FunctionIndex = None
+
+    def __post_init__(self):
+        if not self.aliases:
+            self.aliases = import_aliases(self.tree)
+        if self.index is None:
+            self.index = FunctionIndex(self.tree)
+
+
+def load_modules(
+    paths: List[str],
+) -> Tuple[Dict[str, ModuleInfo], Dict[str, SourceFile], List[Tuple[str, Exception]]]:
+    """Parse a file set once: (modules by path, sources by path,
+    [(path, error)] for unparsable files — each pass maps those onto its
+    own x00 rule)."""
+    modules: Dict[str, ModuleInfo] = {}
+    sources: Dict[str, SourceFile] = {}
+    errors: List[Tuple[str, Exception]] = []
+    for path in iter_py_files(paths):
+        try:
+            src, tree = parse_file(path)
+        except (OSError, SyntaxError) as exc:
+            errors.append((path, exc))
+            continue
+        modules[path] = ModuleInfo(path=path, src=src, tree=tree)
+        sources[path] = src
+    return modules, sources, errors
+
+
+def resolve_local(
+    mod: ModuleInfo, name: str, modules: Dict[str, ModuleInfo]
+) -> Optional[Tuple[ModuleInfo, ast.FunctionDef]]:
+    """Resolve a bare name used in ``mod`` to a function def in the
+    scanned set — locally, or through a ``from .x import name`` alias."""
+    if name in mod.index.functions:
+        return mod, mod.index.functions[name]
+    origin = mod.aliases.get(name)
+    if not origin or "." not in origin:
+        return None
+    mod_part, _, fn_name = origin.rpartition(".")
+    base = mod_part.lstrip(".") or ""
+    tail = base.rpartition(".")[2] if base else ""
+    for other in modules.values():
+        stem = os.path.splitext(os.path.basename(other.path))[0]
+        if stem == tail and fn_name in other.index.functions:
+            return other, other.index.functions[fn_name]
+    return None
+
+
+class ReturnSummaries:
+    """Memoized one-level function summaries with a recursion guard."""
+
+    def __init__(self, default: int):
+        self.default = default
+        self._memo: Dict[tuple, int] = {}
+        self._busy: set = set()
+
+    def get(self, key: tuple, compute: Callable[[], int]) -> int:
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._busy:
+            return self.default  # recursion: one level only
+        self._busy.add(key)
+        try:
+            out = compute()
+        finally:
+            self._busy.discard(key)
+        self._memo[key] = out
+        return out
+
+
+__all__ = ["ModuleInfo", "ReturnSummaries", "load_modules", "resolve_local"]

@@ -1,0 +1,573 @@
+"""Pass 9: device-residency discipline (DTX9xx) for the solve path.
+
+The ROADMAP's device-resident-tensors + delta-encode refactor only pays
+off if the formulation genuinely stays on device: one stray host sync —
+a truthiness test on a device array, ``float()``/``.item()``, an
+``np.asarray`` on a device value, iteration, a print — silently reads
+the array back, serializing the dispatch pipeline the async
+double-buffering is supposed to hide. This pass machine-checks the
+boundary.
+
+Hosted on the dataflow core: values originating from ``jnp.*`` /
+``jax.device_put`` / kernel-dispatch returns (``dispatch_*`` /
+``solve_all*`` by the ops/solve.py naming convention) are tracked as
+DEVICE through assignments, attributes, tuple unpacks, and one level of
+same-module helper calls; everything the analysis loses track of joins
+to UNKNOWN and never flags (poison-to-unknown). Host-sync sinks flag
+only on *definite* device values:
+
+- DTX901: truthiness — ``if``/``while``/``assert``/ternary/``not``/
+  ``bool()`` on a device value
+- DTX902: host materialization — ``float()``/``int()``/``complex()``,
+  ``.item()``/``.tolist()``/``.tobytes()``
+- DTX903: host-numpy call (``np.asarray``/``np.array``/any ``numpy.*``)
+  on a device value — an implicit ``device_get``
+- DTX904: Python iteration over a device value (``for``, unpacking,
+  ``list()``/``sorted()``/``min()``/...)
+- DTX905: ``print``/f-string/``str()`` interpolation of a device value
+- DTX906: explicit host readback — every ``jax.device_get`` call. This
+  one is not an error to *have*; it is an error to have UNSANCTIONED:
+  the blessed decode/guard boundary carries
+  ``# analysis: sanctioned[DTX906] reason`` annotations, PARITY.md's
+  device-residency contract lists them, and the delta-encode PR must
+  not widen the set. (A sanction is an audited boundary marker, not a
+  suppression — see findings.py.)
+
+``jax.device_get`` and sanctioned sinks yield HOST downstream, so the
+decode path (all host numpy after the readback) stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import call_name, dotted_name
+from .core.cfg import Atom, build_cfg
+from .core.dataflow import Env, run_forward, sweep
+from .core.lattice import Lattice
+from .core.summaries import (
+    ModuleInfo,
+    ReturnSummaries,
+    load_modules,
+    resolve_local,
+)
+from .findings import Finding, Severity, SourceFile
+
+RULES = {
+    "DTX900": "unparsable file (device-residency pass)",
+    "DTX901": "truthiness/branch on a device value (host sync)",
+    "DTX902": "host materialization of a device value",
+    "DTX903": "host-numpy call on a device value (implicit device_get)",
+    "DTX904": "python iteration over a device value (host sync)",
+    "DTX905": "print/f-string interpolation of a device value",
+    "DTX906": "device->host readback outside a sanctioned boundary",
+}
+
+HOST = 0
+DEVICE = 1
+UNKNOWN = 2  # poison: lost track -> never flag
+
+LATTICE = Lattice(top=UNKNOWN, default=HOST)
+
+_DEVICE_ORIGINS = ("jax.numpy", "jax.lax", "jax.nn", "jax.scipy")
+# jax APIs that return host/python values (or are control surface)
+_HOST_JAX = (
+    "jax.device_get", "jax.devices", "jax.local_devices",
+    "jax.device_count", "jax.local_device_count", "jax.default_backend",
+    "jax.named_scope", "jax.config", "jax.profiler", "jax.debug",
+    "jax.tree_util", "jax.eval_shape",
+)
+# kernel-dispatch naming convention (ops/solve.py): these return device
+# arrays by contract even through the fault-seam wrappers
+_DISPATCH_PREFIXES = ("dispatch_", "solve_all")
+
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
+_MATERIALIZERS = {"float", "int", "complex"}
+_MATERIALIZER_METHODS = {"item", "tolist", "tobytes"}
+_ITERATORS = {"list", "tuple", "set", "sorted", "sum", "min", "max",
+              "any", "all", "iter", "enumerate", "zip", "map", "filter",
+              "frozenset"}
+_STRINGIFIERS = {"str", "repr", "format", "print"}
+_HOST_BUILTINS = {"len", "isinstance", "issubclass", "getattr", "hasattr",
+                  "type", "range", "id", "callable"}
+
+
+class _DeviceAnalysis:
+    """One function (or module body) under the device-residency lattice."""
+
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        modules: Dict[str, ModuleInfo],
+        findings: List[Finding],
+        summaries: Optional[ReturnSummaries],
+    ):
+        self.mod = mod
+        self.modules = modules
+        self.findings = findings
+        self.summaries = summaries
+        self._flagged: Set[Tuple[int, str]] = set()
+        # return-kind summaries of nested defs seen in this scope, joined
+        # across conditional re-definitions
+        self._local_ret: Dict[str, int] = {}
+
+    # -- reporting --------------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if (line, rule) in self._flagged:
+            return
+        self._flagged.add((line, rule))
+        self.findings.append(
+            Finding(rule, Severity.ERROR, self.mod.path, line, message)
+        )
+
+    # -- classification ---------------------------------------------------
+
+    def kind(self, node: ast.AST, env: Env) -> int:
+        if isinstance(node, ast.Constant):
+            return HOST
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return HOST
+            return self.kind(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self.kind(node.value, env)
+        if isinstance(node, ast.Call):
+            return self._call_kind(node, env)
+        if isinstance(node, ast.NamedExpr):
+            return self.kind(node.value, env)
+        if isinstance(node, ast.BinOp):
+            return max(self.kind(node.left, env), self.kind(node.right, env))
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.Not):
+                return HOST  # truthiness flagged as a sink, result is bool
+            return self.kind(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            return max((self.kind(v, env) for v in node.values), default=HOST)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return HOST
+            return max(
+                self.kind(node.left, env),
+                max((self.kind(c, env) for c in node.comparators),
+                    default=HOST),
+            )
+        if isinstance(node, ast.IfExp):
+            return max(self.kind(node.body, env), self.kind(node.orelse, env))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return max((self.kind(e, env) for e in node.elts), default=HOST)
+        if isinstance(node, ast.Starred):
+            return self.kind(node.value, env)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return max(
+                (self.kind(g.iter, env) for g in node.generators),
+                default=HOST,
+            )
+        if isinstance(node, ast.JoinedStr):
+            return HOST
+        if isinstance(node, (ast.Dict, ast.DictComp)):
+            return UNKNOWN
+        if isinstance(node, ast.Lambda):
+            return UNKNOWN
+        if isinstance(node, ast.Slice):
+            return HOST
+        return UNKNOWN
+
+    def _call_kind(self, node: ast.Call, env: Env) -> int:
+        cname = call_name(node, self.mod.aliases)
+        if cname:
+            last = cname.rpartition(".")[2]
+            if cname == "jax.device_get":
+                return HOST  # the readback itself is checked as DTX906
+            if cname == "jax.block_until_ready" and node.args:
+                return self.kind(node.args[0], env)
+            if any(cname == h or cname.startswith(h + ".") for h in _HOST_JAX):
+                return HOST
+            if any(cname == o or cname.startswith(o + ".")
+                   for o in _DEVICE_ORIGINS):
+                return DEVICE
+            if cname == "jax.device_put":
+                return DEVICE
+            if cname in ("jax.jit", "jax.vmap", "jax.pmap", "jax.grad"):
+                return UNKNOWN  # a callable, not an array
+            if cname.startswith("jax."):
+                return DEVICE
+            if last.startswith(_DISPATCH_PREFIXES):
+                return DEVICE
+            head = cname.partition(".")[0]
+            origin = self.mod.aliases.get(head, head)
+            if origin == "numpy" or cname.startswith("numpy."):
+                return HOST  # numpy returns host arrays (sink checked)
+            if cname in _MATERIALIZERS or cname in _STRINGIFIERS:
+                return HOST
+            if cname in _HOST_BUILTINS:
+                return HOST
+            if cname in ("bool",):
+                return HOST
+            if cname in _ITERATORS:
+                return UNKNOWN
+        raw = dotted_name(node.func)
+        if raw is not None and "." not in raw:
+            if raw in self._local_ret:
+                return self._local_ret[raw]
+            if self.summaries is not None and not env.has(raw):
+                hit = resolve_local(self.mod, raw, self.modules)
+                if hit is not None:
+                    return _return_kind(
+                        hit[0], hit[1], self.modules, self.summaries
+                    )
+        if isinstance(node.func, ast.Attribute):
+            recv = self.kind(node.func.value, env)
+            if recv == DEVICE:
+                if node.func.attr in _MATERIALIZER_METHODS:
+                    return HOST  # flagged as DTX902 at the check
+                return DEVICE  # .astype/.sum/.reshape/... stay on device
+            if recv == HOST:
+                return HOST
+        return UNKNOWN
+
+    def _device_names(self, node: ast.AST, env: Env) -> str:
+        out = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and env.get(sub.id) == DEVICE:
+                if sub.id not in out:
+                    out.append(sub.id)
+        return ", ".join(out) or "a device value"
+
+    # -- transfer ---------------------------------------------------------
+
+    def _bind_target(self, target: ast.AST, value: Optional[ast.AST],
+                     kind: int, env: Env) -> None:
+        if isinstance(target, ast.Name):
+            env.set(target.id, kind)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self._bind_target(t, v, self.kind(v, env), env)
+                return
+            # tuple returns from jax calls (lax.scan, kernel outputs)
+            # unpack without host iteration: elements inherit the kind
+            for elt in target.elts:
+                self._bind_target(elt, None, kind, env)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, None, kind, env)
+
+    def _bind_walrus(self, node: ast.AST, env: Env) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.NamedExpr) and isinstance(
+                sub.target, ast.Name
+            ):
+                env.set(sub.target.id, self.kind(sub.value, env))
+
+    def transfer(self, atom: Atom, env: Env) -> None:
+        node = atom.node
+        if atom.kind == "stmt":
+            self._bind_walrus(node, env)
+            if isinstance(node, ast.Assign):
+                kind = self.kind(node.value, env)
+                for target in node.targets:
+                    self._bind_target(target, node.value, kind, env)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._bind_target(
+                    node.target, node.value, self.kind(node.value, env), env
+                )
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    env.set(
+                        node.target.id,
+                        max(env.get(node.target.id),
+                            self.kind(node.value, env)),
+                    )
+        elif atom.kind == "test":
+            self._bind_walrus(node, env)
+        elif atom.kind == "for":
+            self._bind_walrus(node.iter, env)
+            iter_kind = self.kind(node.iter, env)
+            elem = UNKNOWN if iter_kind != HOST else HOST
+            self._bind_target(node.target, None, elem, env)
+        elif atom.kind == "with":
+            self._bind_walrus(node.context_expr, env)
+            if node.optional_vars is not None:
+                self._bind_target(
+                    node.optional_vars, None, UNKNOWN, env
+                )
+        elif atom.kind == "except":
+            if node.name:
+                env.set(node.name, HOST)
+        elif atom.kind == "def":
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ret = self._nested_return_kind(node, env)
+                prior = self._local_ret.get(node.name)
+                self._local_ret[node.name] = (
+                    ret if prior is None else max(prior, ret)
+                )
+
+    def _nested_return_kind(self, fn: ast.AST, env: Env) -> int:
+        """Return-kind summary of a nested def against a snapshot of the
+        enclosing scope (closures over device values resolve)."""
+        sub = _DeviceAnalysis(self.mod, self.modules, [], self.summaries)
+        init = _param_env(fn, Env(LATTICE, dict(env.kinds)))
+        cfg = build_cfg(fn.body)
+        envs = run_forward(cfg, init, sub.transfer)
+        out = [HOST]
+
+        def collect(atom: Atom, e: Env) -> None:
+            if (
+                atom.kind == "stmt"
+                and isinstance(atom.node, ast.Return)
+                and atom.node.value is not None
+            ):
+                out.append(sub.kind(atom.node.value, e))
+
+        sweep(cfg, envs, init, sub.transfer, collect)
+        return max(out)
+
+    # -- checks -----------------------------------------------------------
+
+    def check(self, atom: Atom, env: Env) -> None:
+        node = atom.node
+        if atom.kind == "stmt":
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._check_expr(child, env)
+        elif atom.kind == "test":
+            if atom.label in ("if", "while", "assert"):
+                self._check_truthiness(node, atom.label, env)
+            self._check_expr(node, env)
+        elif atom.kind == "for":
+            if self.kind(node.iter, env) == DEVICE:
+                self._flag(
+                    "DTX904", node,
+                    f"python loop over device value(s) "
+                    f"({self._device_names(node.iter, env)}) syncs once "
+                    "per element; keep the loop on device or read back "
+                    "at the decode boundary",
+                )
+            self._check_expr(node.iter, env)
+        elif atom.kind == "with":
+            self._check_expr(node.context_expr, env)
+        elif atom.kind == "def":
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _check_function(
+                    self.mod, node, self.findings, self.modules,
+                    self.summaries, parent_env=env, shared_flags=self._flagged,
+                )
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        _check_function(
+                            self.mod, item, self.findings, self.modules,
+                            self.summaries, parent_env=env,
+                            shared_flags=self._flagged,
+                        )
+
+    def _check_truthiness(self, test: ast.AST, what: str, env: Env) -> None:
+        nodes = (
+            list(test.values) if isinstance(test, ast.BoolOp) else [test]
+        )
+        for n in nodes:
+            target = n.operand if (
+                isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not)
+            ) else n
+            if self.kind(target, env) == DEVICE:
+                self._flag(
+                    "DTX901", test,
+                    f"python {what} on device value(s) "
+                    f"({self._device_names(target, env)}) forces a host "
+                    "sync; branch on host metadata or use jnp.where",
+                )
+
+    def _check_expr(self, node: ast.AST, env: Env) -> None:
+        if isinstance(node, ast.Call):
+            self._check_call(node, env)
+        elif isinstance(node, ast.IfExp):
+            self._check_truthiness(node.test, "ternary", env)
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            if self.kind(node.operand, env) == DEVICE:
+                self._flag(
+                    "DTX901", node,
+                    "`not` on a device value forces a host sync; compare "
+                    "on host metadata or keep the predicate on device",
+                )
+        elif isinstance(node, ast.FormattedValue):
+            if self.kind(node.value, env) == DEVICE:
+                self._flag(
+                    "DTX905", node,
+                    "f-string interpolation of a device value syncs it to "
+                    "host; log host metadata or defer to the decode "
+                    "boundary",
+                )
+        elif isinstance(node, ast.NamedExpr):
+            self._check_expr(node.value, env)
+            if isinstance(node.target, ast.Name):
+                env.set(node.target.id, self.kind(node.value, env))
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension, ast.keyword,
+                                  ast.FormattedValue)):
+                self._check_expr(child, env)
+
+    def _check_call(self, node: ast.Call, env: Env) -> None:
+        cname = call_name(node, self.mod.aliases)
+        arg_kinds = [self.kind(a, env) for a in node.args]
+        # sanctioned sites still emit: partition_findings routes them into
+        # the sanctioned channel, which is how the CLI counts the blessed
+        # boundary and how the stale audit sees a marker is live
+        if cname == "jax.device_get":
+            self._flag(
+                "DTX906", node,
+                "jax.device_get is a device->host readback; the "
+                "blessed decode/guard boundary must carry an "
+                "`# analysis: sanctioned[DTX906]` annotation "
+                "(PARITY.md device-residency contract)",
+            )
+        elif cname in _MATERIALIZERS and DEVICE in arg_kinds:
+            self._flag(
+                "DTX902", node,
+                f"{cname}() materializes a device value on host "
+                "(one blocking sync per call)",
+            )
+        elif cname == "bool" and DEVICE in arg_kinds:
+            self._flag(
+                "DTX901", node,
+                "bool() on a device value forces a host sync",
+            )
+        elif cname in _ITERATORS and DEVICE in arg_kinds:
+            self._flag(
+                "DTX904", node,
+                f"{cname}() iterates a device value on host (one "
+                "sync per element)",
+            )
+        elif cname in _STRINGIFIERS and DEVICE in arg_kinds:
+            self._flag(
+                "DTX905", node,
+                f"{cname}() renders a device value on host (blocking "
+                "sync); print host metadata instead",
+            )
+        else:
+            head = cname.partition(".")[0] if cname else ""
+            origin = self.mod.aliases.get(head, head)
+            if (origin == "numpy" or cname.startswith("numpy.")) and (
+                DEVICE in arg_kinds
+                or any(
+                    self.kind(kw.value, env) == DEVICE
+                    for kw in node.keywords
+                )
+            ):
+                self._flag(
+                    "DTX903", node,
+                    f"{cname} on a device value is an implicit "
+                    "device_get; read back once at the sanctioned "
+                    "decode boundary instead",
+                )
+        if isinstance(node.func, ast.Attribute):
+            if (
+                node.func.attr in _MATERIALIZER_METHODS
+                and self.kind(node.func.value, env) == DEVICE
+            ):
+                self._flag(
+                    "DTX902", node,
+                    f".{node.func.attr}() materializes a device value on "
+                    "host (one blocking sync per call)",
+                )
+
+
+def _param_env(fn: ast.AST, base: Env) -> Env:
+    """Parameters are UNKNOWN: the pass only tracks values whose device
+    origin it can see (poison-to-unknown keeps helper params silent)."""
+    env = base
+    args = fn.args
+    for arg in args.posonlyargs + args.args + args.kwonlyargs:
+        env.set(arg.arg, UNKNOWN)
+    if args.vararg is not None:
+        env.set(args.vararg.arg, UNKNOWN)
+    if args.kwarg is not None:
+        env.set(args.kwarg.arg, UNKNOWN)
+    return env
+
+
+def _return_kind(
+    mod: ModuleInfo,
+    fn: ast.FunctionDef,
+    modules: Dict[str, ModuleInfo],
+    summaries: ReturnSummaries,
+) -> int:
+    """One-level helper summary: nested helper calls unresolved."""
+
+    def compute() -> int:
+        analysis = _DeviceAnalysis(mod, modules, [], summaries=None)
+        init = _param_env(fn, Env(LATTICE))
+        cfg = build_cfg(fn.body)
+        envs = run_forward(cfg, init, analysis.transfer)
+        out = [HOST]
+
+        def collect(atom: Atom, env: Env) -> None:
+            if (
+                atom.kind == "stmt"
+                and isinstance(atom.node, ast.Return)
+                and atom.node.value is not None
+            ):
+                out.append(analysis.kind(atom.node.value, env))
+
+        sweep(cfg, envs, init, analysis.transfer, collect)
+        return max(out)
+
+    return summaries.get((mod.path, fn.name), compute)
+
+
+def _check_function(
+    mod: ModuleInfo,
+    fn: ast.FunctionDef,
+    findings: List[Finding],
+    modules: Dict[str, ModuleInfo],
+    summaries: Optional[ReturnSummaries],
+    parent_env: Optional[Env] = None,
+    shared_flags: Optional[Set[Tuple[int, str]]] = None,
+) -> None:
+    analysis = _DeviceAnalysis(mod, modules, findings, summaries)
+    if shared_flags is not None:
+        analysis._flagged = shared_flags
+    base = Env(LATTICE, dict(parent_env.kinds)) if parent_env else Env(LATTICE)
+    init = _param_env(fn, base)
+    cfg = build_cfg(fn.body)
+    envs = run_forward(cfg, init, analysis.transfer)
+    sweep(cfg, envs, init, analysis.transfer, analysis.check)
+
+
+def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
+    """Run the device-residency pass; returns (findings, sources)."""
+    findings: List[Finding] = []
+    modules, sources, errors = load_modules(paths)
+    for path, exc in errors:
+        findings.append(
+            Finding("DTX900", Severity.ERROR, path, 0, f"unparsable: {exc}")
+        )
+    summaries = ReturnSummaries(default=UNKNOWN)
+    for mod in modules.values():
+        # module body first (a top-level `_TABLE = jnp.arange(8)` fed
+        # into list()/print()/np.asarray is a host sync like any other);
+        # def statements are excluded here — every function and method
+        # is analyzed separately below, and the device contract gives
+        # module globals no flow into them (fresh UNKNOWN-param envs)
+        analysis = _DeviceAnalysis(mod, modules, findings, summaries)
+        init = Env(LATTICE)
+        cfg = build_cfg(
+            [s for s in mod.tree.body
+             if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))]
+        )
+        envs = run_forward(cfg, init, analysis.transfer)
+        sweep(cfg, envs, init, analysis.transfer, analysis.check)
+        for fn in mod.index.functions.values():
+            _check_function(mod, fn, findings, modules, summaries)
+        for cls, table in mod.index.methods.items():
+            for fn in table.values():
+                _check_function(mod, fn, findings, modules, summaries)
+    return findings, sources

@@ -21,7 +21,15 @@ visible:
   loop spins the reconcile thread forever; ``Backoff.call`` is the
   bounded replacement.
 
-The bound detection is deliberately permissive (any attempt-counter-ish
+Hosted on the dataflow core's module layer (analysis/core/summaries):
+the bound detection reaches through ONE level of same-module helpers —
+a loop whose handler calls ``self._pause()`` or a module-level
+``_backoff_step()`` that itself touches a Backoff/clock/attempt bound is
+bounded, where the first-generation AST matcher only saw the loop's own
+text and flagged it (those false positives are why the reach exists;
+suppressions they used to require are deleted, not kept).
+
+The bound detection stays deliberately permissive (any attempt-counter-ish
 name comparison, any backoff/clock reference, any escape statement in the
 handler counts): the rule exists to catch the *structurally* unbounded
 shape, not to lint retry style.
@@ -30,9 +38,10 @@ shape, not to lint retry style.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from .astutil import iter_py_files, parse_file
+from .astutil import dotted_name
+from .core.summaries import ModuleInfo, ReturnSummaries, load_modules, resolve_local
 from .findings import Finding, Severity, SourceFile
 
 RULES = {
@@ -45,6 +54,10 @@ _BROAD = {"Exception", "BaseException"}
 _SWALLOW_BODY = (ast.Pass, ast.Continue)
 _BOUND_NAME_HINTS = ("backoff", "attempt", "retries", "tries", "deadline")
 _BOUND_CALL_ATTRS = {"sleep", "delay", "ready", "failure", "call", "retry"}
+
+# summary values for the one-level helper reach
+_NO_BOUND = 0
+_HAS_BOUND = 1
 
 
 def _is_broad(handler: ast.ExceptHandler) -> bool:
@@ -81,15 +94,64 @@ def _ident_chain(node: ast.AST) -> str:
     return ".".join(reversed(parts)).lower()
 
 
-def _has_bound(loop: ast.While) -> bool:
-    """Any structural evidence the loop's retrying is bounded."""
-    for node in ast.walk(loop):
-        if isinstance(node, (ast.Name, ast.Attribute)):
-            ident = _ident_chain(node)
+def _own_bound_evidence(node: ast.AST) -> bool:
+    """Bound evidence in ``node``'s own text (no helper reach)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            ident = _ident_chain(sub)
             if any(h in ident for h in _BOUND_NAME_HINTS):
                 return True
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            if node.func.attr in _BOUND_CALL_ATTRS:
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if sub.func.attr in _BOUND_CALL_ATTRS:
+                return True
+    return False
+
+
+def _helper_bound_summary(
+    mod: ModuleInfo,
+    fn: ast.FunctionDef,
+    summaries: ReturnSummaries,
+) -> int:
+    """Does the helper's own body carry bound evidence? One level: nested
+    helper calls inside the helper are not chased further."""
+    return summaries.get(
+        (mod.path, fn.name),
+        lambda: _HAS_BOUND if _own_bound_evidence(fn) else _NO_BOUND,
+    )
+
+
+def _has_bound(
+    loop: ast.While,
+    mod: Optional[ModuleInfo],
+    modules: Dict[str, ModuleInfo],
+    summaries: Optional[ReturnSummaries],
+) -> bool:
+    """Any structural evidence the loop's retrying is bounded — in the
+    loop's own text, or one call away in a same-module helper."""
+    if _own_bound_evidence(loop):
+        return True
+    if mod is None or summaries is None:
+        return False
+    for sub in ast.walk(loop):
+        if not isinstance(sub, ast.Call):
+            continue
+        raw = dotted_name(sub.func)
+        target: Optional[Tuple[ModuleInfo, ast.FunctionDef]] = None
+        if raw is not None and "." not in raw:
+            target = resolve_local(mod, raw, modules)
+        elif (
+            isinstance(sub.func, ast.Attribute)
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == "self"
+        ):
+            # self._helper(): resolve against every class method table in
+            # the module (conservative: any method of that name counts)
+            for table in mod.index.methods.values():
+                if sub.func.attr in table:
+                    target = (mod, table[sub.func.attr])
+                    break
+        if target is not None:
+            if _helper_bound_summary(target[0], target[1], summaries):
                 return True
     return False
 
@@ -108,17 +170,14 @@ def _loops_forever(test: ast.expr) -> bool:
 
 def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
     findings: List[Finding] = []
-    sources: Dict[str, SourceFile] = {}
-    for path in iter_py_files(paths):
-        try:
-            src, tree = parse_file(path)
-        except (OSError, SyntaxError) as exc:
-            findings.append(
-                Finding("RTY700", Severity.ERROR, path, 0, f"unparsable: {exc}")
-            )
-            continue
-        sources[path] = src
-        for node in ast.walk(tree):
+    modules, sources, errors = load_modules(paths)
+    for path, exc in errors:
+        findings.append(
+            Finding("RTY700", Severity.ERROR, path, 0, f"unparsable: {exc}")
+        )
+    summaries = ReturnSummaries(default=_NO_BOUND)
+    for path, mod in modules.items():
+        for node in ast.walk(mod.tree):
             if isinstance(node, ast.ExceptHandler):
                 if _is_broad(node) and _swallows(node):
                     findings.append(
@@ -137,7 +196,7 @@ def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]
                     for h in t.handlers
                     if not _handler_escapes(h)
                 ]
-                if retrying and not _has_bound(node):
+                if retrying and not _has_bound(node, mod, modules, summaries):
                     findings.append(
                         Finding(
                             "RTY702", Severity.ERROR, path, node.lineno,

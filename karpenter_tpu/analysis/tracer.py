@@ -8,7 +8,14 @@ The kernels avoid all of this by construction — branching only on static
 Python scalars (shape components, ``static_argnames``) — and this pass
 pins that convention.
 
-Traced-function discovery:
+Hosted on the shared dataflow core (analysis/core/): each traced function
+is analyzed over its CFG with a forward fixpoint, so value kinds merge
+correctly at branch joins and survive loop back-edges, and bare-name
+calls to same-module helpers resolve through one level of return-kind
+summaries (``core.summaries``) instead of defaulting to static — a
+helper that hands back a ``jnp`` result is traced at the call site too.
+
+Traced-function discovery (unchanged from the AST-walker generation):
 - decorated with ``jax.jit`` (directly or via ``partial(jax.jit, ...)``);
 - named ``solve_core*`` (the kernel entry naming convention);
 - wrapped at module level (``solve_all = jax.jit(solve_core, ...)``);
@@ -20,7 +27,7 @@ Value classification inside a traced function: unannotated positional
 parameters are traced arrays; parameters with scalar annotations
 (``int``/``bool``/``float``/``str``) or keyword-only parameters are trace-time
 statics, as are ``.shape``/``.ndim``/``.size``/``.dtype``/``len()`` projections.
-Locals inherit from their right-hand sides.
+Locals inherit from their right-hand sides; at a branch join, traced wins.
 
 Rules:
 - TRC101: ``if``/``while``/ternary on a traced value
@@ -32,16 +39,17 @@ Rules:
 from __future__ import annotations
 
 import ast
-import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from .astutil import (
-    FunctionIndex,
-    call_name,
-    dotted_name,
-    import_aliases,
-    iter_py_files,
-    parse_file,
+from .astutil import call_name, dotted_name
+from .core.cfg import Atom, build_cfg
+from .core.dataflow import Env, run_forward, sweep
+from .core.lattice import Lattice
+from .core.summaries import (
+    ModuleInfo,
+    ReturnSummaries,
+    load_modules,
+    resolve_local,
 )
 from .findings import Finding, Severity, SourceFile
 
@@ -56,6 +64,9 @@ RULES = {
 TRACED = 2
 STATIC = 0
 
+# taint-style lattice: traced is top, unbound names read as static
+LATTICE = Lattice(top=TRACED, default=STATIC)
+
 _STATIC_ANNOTATIONS = {"int", "bool", "float", "str"}
 _SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
 _STATIC_BUILTINS = {"len", "isinstance", "issubclass", "getattr", "hasattr",
@@ -67,33 +78,6 @@ _MATERIALIZERS = {"float", "int", "bool", "complex"}
 _MATERIALIZER_METHODS = {"item", "tolist"}
 _TRACED_ORIGINS = ("jax.numpy", "jax.lax", "jax.nn", "jax.scipy")
 _HOST_ORIGINS = ("numpy", "random", "time")
-
-
-class _Env:
-    def __init__(self, parent: Optional["_Env"] = None):
-        self.parent = parent
-        self.kinds: Dict[str, int] = {}
-
-    def get(self, name: str) -> Optional[int]:
-        env: Optional[_Env] = self
-        while env is not None:
-            if name in env.kinds:
-                return env.kinds[name]
-            env = env.parent
-        return None
-
-    def set(self, name: str, kind: int) -> None:
-        self.kinds[name] = kind
-
-
-class _Module:
-    def __init__(self, path: str, src: SourceFile, tree: ast.Module):
-        self.path = path
-        self.src = src
-        self.tree = tree
-        self.aliases = import_aliases(tree)
-        self.index = FunctionIndex(tree)
-        self.static_names: Set[str] = _collect_static_argnames(tree)
 
 
 def _collect_static_argnames(tree: ast.Module) -> Set[str]:
@@ -128,27 +112,7 @@ def _canonical(name: str, aliases: Dict[str, str]) -> str:
     return origin + ("." + rest if rest else "")
 
 
-def _resolve_function(
-    mod: _Module, name: str, modules: Dict[str, _Module]
-) -> Optional[Tuple[_Module, ast.FunctionDef]]:
-    """Resolve a bare name used in ``mod`` to a function def in the scanned
-    set — locally, or through a ``from .x import name`` alias."""
-    if name in mod.index.functions:
-        return mod, mod.index.functions[name]
-    origin = mod.aliases.get(name)
-    if not origin or "." not in origin:
-        return None
-    mod_part, _, fn_name = origin.rpartition(".")
-    base = mod_part.lstrip(".") or ""
-    tail = base.rpartition(".")[2] if base else ""
-    for other in modules.values():
-        stem = os.path.splitext(os.path.basename(other.path))[0]
-        if stem == tail and fn_name in other.index.functions:
-            return other, other.index.functions[fn_name]
-    return None
-
-
-def _traced_functions(modules: Dict[str, _Module]) -> Set[Tuple[str, str]]:
+def _traced_functions(modules: Dict[str, ModuleInfo]) -> Set[Tuple[str, str]]:
     """Fixpoint of (module_path, function_name) trace roots + references."""
     traced: Set[Tuple[str, str]] = set()
     for mod in modules.values():
@@ -174,7 +138,7 @@ def _traced_functions(modules: Dict[str, _Module]) -> Set[Tuple[str, str]]:
                     continue
                 for node in ast.walk(fn):
                     if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-                        hit = _resolve_function(mod, node.id, modules)
+                        hit = resolve_local(mod, node.id, modules)
                         if hit is not None:
                             key = (hit[0].path, hit[1].name)
                             if key not in traced:
@@ -183,13 +147,21 @@ def _traced_functions(modules: Dict[str, _Module]) -> Set[Tuple[str, str]]:
     return traced
 
 
-class _FunctionChecker(ast.NodeVisitor):
-    """Sequentially walks one traced function, tracking value kinds."""
+class _FunctionAnalysis:
+    """One traced function on the dataflow core: CFG fixpoint for the
+    name->kind environment, then a deterministic check sweep."""
 
-    def __init__(self, mod: _Module, findings: List[Finding], env: _Env):
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        modules: Dict[str, ModuleInfo],
+        findings: List[Finding],
+        summaries: Optional[ReturnSummaries],
+    ):
         self.mod = mod
+        self.modules = modules
         self.findings = findings
-        self.env = env
+        self.summaries = summaries
         self._flagged_lines: Set[Tuple[int, str]] = set()
 
     # -- reporting --------------------------------------------------------
@@ -205,53 +177,56 @@ class _FunctionChecker(ast.NodeVisitor):
 
     # -- classification ---------------------------------------------------
 
-    def kind(self, node: ast.AST) -> int:
+    def kind(self, node: ast.AST, env: Env) -> int:
         if isinstance(node, ast.Constant):
             return STATIC
         if isinstance(node, ast.Name):
-            known = self.env.get(node.id)
-            return known if known is not None else STATIC
+            return env.get(node.id)
         if isinstance(node, ast.Attribute):
             if node.attr in _SHAPE_ATTRS:
                 return STATIC
-            return self.kind(node.value)
+            return self.kind(node.value, env)
         if isinstance(node, ast.Subscript):
-            return max(self.kind(node.value), self.kind(node.slice))
+            return max(self.kind(node.value, env), self.kind(node.slice, env))
         if isinstance(node, ast.Call):
-            return self._call_kind(node)
+            return self._call_kind(node, env)
+        if isinstance(node, ast.NamedExpr):
+            return self.kind(node.value, env)
         if isinstance(node, (ast.BinOp,)):
-            return max(self.kind(node.left), self.kind(node.right))
+            return max(self.kind(node.left, env), self.kind(node.right, env))
         if isinstance(node, ast.UnaryOp):
-            return self.kind(node.operand)
+            return self.kind(node.operand, env)
         if isinstance(node, ast.BoolOp):
-            return max((self.kind(v) for v in node.values), default=STATIC)
+            return max((self.kind(v, env) for v in node.values), default=STATIC)
         if isinstance(node, ast.Compare):
             # `is None` / `is not None` inspect the python value, not data
             if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
                 return STATIC
             return max(
-                self.kind(node.left),
-                max((self.kind(c) for c in node.comparators), default=STATIC),
+                self.kind(node.left, env),
+                max((self.kind(c, env) for c in node.comparators),
+                    default=STATIC),
             )
         if isinstance(node, ast.IfExp):
-            return max(self.kind(node.body), self.kind(node.orelse))
+            return max(self.kind(node.body, env), self.kind(node.orelse, env))
         if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
-            return max((self.kind(e) for e in node.elts), default=STATIC)
+            return max((self.kind(e, env) for e in node.elts), default=STATIC)
         if isinstance(node, ast.Starred):
-            return self.kind(node.value)
+            return self.kind(node.value, env)
         if isinstance(node, ast.Slice):
             parts = [p for p in (node.lower, node.upper, node.step) if p]
-            return max((self.kind(p) for p in parts), default=STATIC)
+            return max((self.kind(p, env) for p in parts), default=STATIC)
         if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
             return max(
-                (self.kind(g.iter) for g in node.generators), default=STATIC
+                (self.kind(g.iter, env) for g in node.generators),
+                default=STATIC,
             )
         return STATIC
 
-    def _call_kind(self, node: ast.Call) -> int:
+    def _call_kind(self, node: ast.Call, env: Env) -> int:
         cname = call_name(node, self.mod.aliases)
         arg_kind = max(
-            (self.kind(a) for a in list(node.args) +
+            (self.kind(a, env) for a in list(node.args) +
              [kw.value for kw in node.keywords]),
             default=STATIC,
         )
@@ -268,138 +243,202 @@ class _FunctionChecker(ast.NodeVisitor):
                 return arg_kind
         if isinstance(node.func, ast.Attribute):
             # method on a traced value yields a traced value
-            if self.kind(node.func.value) == TRACED:
+            if self.kind(node.func.value, env) == TRACED:
                 return TRACED
+        # one level of interprocedural reach: a bare-name call resolving
+        # to a same-module (or from-import sibling) helper returns the
+        # helper's summarized return kind — `hidden = make_mask(x)` is
+        # traced when make_mask returns a jnp result
+        raw = dotted_name(node.func)
+        if (
+            self.summaries is not None
+            and raw is not None
+            and "." not in raw
+            and not env.has(raw)
+        ):
+            hit = resolve_local(self.mod, raw, self.modules)
+            if hit is not None:
+                ret = _return_kind(hit[0], hit[1], self.modules, self.summaries)
+                return max(ret, arg_kind)
         return arg_kind
 
-    def _traced_names(self, node: ast.AST) -> List[str]:
+    def _traced_names(self, node: ast.AST, env: Env) -> List[str]:
         out = []
         for sub in ast.walk(node):
-            if isinstance(sub, ast.Name) and self.env.get(sub.id) == TRACED:
+            if isinstance(sub, ast.Name) and env.get(sub.id) == TRACED:
                 if sub.id not in out:
                     out.append(sub.id)
         return out
 
-    # -- bindings ---------------------------------------------------------
+    # -- bindings (transfer function) -------------------------------------
 
-    def _bind_target(self, target: ast.AST, kind: int) -> None:
+    def _bind_target(self, target: ast.AST, kind: int, env: Env) -> None:
         if isinstance(target, ast.Name):
-            self.env.set(target.id, kind)
+            env.set(target.id, kind)
         elif isinstance(target, (ast.Tuple, ast.List)):
             for elt in target.elts:
-                self._bind_target(elt, kind)
+                self._bind_target(elt, kind, env)
         elif isinstance(target, ast.Starred):
-            self._bind_target(target.value, kind)
+            self._bind_target(target.value, kind, env)
 
-    # -- statement visitors ----------------------------------------------
+    def _bind_walrus(self, node: ast.AST, env: Env) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.NamedExpr):
+                self._bind_target(sub.target, self.kind(sub.value, env), env)
 
-    def visit_Assign(self, node: ast.Assign) -> None:
-        self.generic_visit(node)
-        kind = self.kind(node.value)
-        for target in node.targets:
-            self._bind_target(target, kind)
+    def transfer(self, atom: Atom, env: Env) -> None:
+        node = atom.node
+        if atom.kind == "stmt":
+            self._bind_walrus(node, env)
+            if isinstance(node, ast.Assign):
+                kind = self.kind(node.value, env)
+                for target in node.targets:
+                    self._bind_target(target, kind, env)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._bind_target(node.target, self.kind(node.value, env), env)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    prior = env.get(node.target.id)
+                    env.set(
+                        node.target.id,
+                        max(prior, self.kind(node.value, env)),
+                    )
+        elif atom.kind == "test":
+            self._bind_walrus(node, env)
+        elif atom.kind == "for":
+            self._bind_walrus(node.iter, env)
+            self._bind_target(node.target, self.kind(node.iter, env), env)
+        elif atom.kind == "with":
+            self._bind_walrus(node.context_expr, env)
+            if node.optional_vars is not None:
+                self._bind_target(
+                    node.optional_vars,
+                    self.kind(node.context_expr, env),
+                    env,
+                )
+        elif atom.kind == "except":
+            if node.name:
+                env.set(node.name, STATIC)
 
-    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
-        self.generic_visit(node)
-        if node.value is not None:
-            self._bind_target(node.target, self.kind(node.value))
+    # -- checks (sweep hook) ----------------------------------------------
 
-    def visit_AugAssign(self, node: ast.AugAssign) -> None:
-        self.generic_visit(node)
-        if isinstance(node.target, ast.Name):
-            prior = self.env.get(node.target.id) or STATIC
-            self.env.set(node.target.id, max(prior, self.kind(node.value)))
+    def check(self, atom: Atom, env: Env) -> None:
+        node = atom.node
+        if atom.kind == "stmt":
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._check_expr(child, env)
+        elif atom.kind == "test":
+            if atom.label in ("if", "while"):
+                self._check_branch(node, atom.label, env)
+            self._check_expr(node, env)
+        elif atom.kind == "for":
+            if self.kind(node.iter, env) == TRACED:
+                names = (
+                    ", ".join(self._traced_names(node.iter, env))
+                    or "a traced value"
+                )
+                self._flag(
+                    "TRC104", node,
+                    f"python loop over traced value(s) ({names}) unrolls "
+                    "with a data-dependent trip count; use "
+                    "lax.scan/fori_loop",
+                )
+            self._check_expr(node.iter, env)
+        elif atom.kind == "with":
+            self._check_expr(node.context_expr, env)
+        elif atom.kind == "def":
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested function (scan/while bodies): params are traced
+                # carries, analyzed against a snapshot of this env
+                check_function(
+                    self.mod, node, self.findings,
+                    modules=self.modules, summaries=self.summaries,
+                    parent_env=env,
+                )
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        check_function(
+                            self.mod, item, self.findings,
+                            modules=self.modules, summaries=self.summaries,
+                            parent_env=env,
+                        )
 
-    def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
-        self.generic_visit(node)
-        self._bind_target(node.target, self.kind(node.value))
-
-    def visit_If(self, node: ast.If) -> None:
-        self._check_branch(node.test, "if")
-        self.generic_visit(node)
-
-    def visit_While(self, node: ast.While) -> None:
-        self._check_branch(node.test, "while")
-        self.generic_visit(node)
-
-    def visit_IfExp(self, node: ast.IfExp) -> None:
-        self._check_branch(node.test, "conditional expression")
-        self.generic_visit(node)
-
-    def _check_branch(self, test: ast.AST, what: str) -> None:
-        if self.kind(test) == TRACED:
-            names = ", ".join(self._traced_names(test)) or "a traced value"
+    def _check_branch(self, test: ast.AST, what: str, env: Env) -> None:
+        label = "conditional expression" if what == "ternary" else what
+        if self.kind(test, env) == TRACED:
+            names = ", ".join(self._traced_names(test, env)) or "a traced value"
             self._flag(
                 "TRC101", test,
-                f"python {what} branches on traced value(s) ({names}); "
+                f"python {label} branches on traced value(s) ({names}); "
                 "use jnp.where/lax.cond or hoist to a static argument",
             )
 
-    def visit_For(self, node: ast.For) -> None:
-        iter_kind = self.kind(node.iter)
-        if iter_kind == TRACED:
-            names = ", ".join(self._traced_names(node.iter)) or "a traced value"
-            self._flag(
-                "TRC104", node,
-                f"python loop over traced value(s) ({names}) unrolls with a "
-                "data-dependent trip count; use lax.scan/fori_loop",
-            )
-        self._bind_target(node.target, iter_kind)
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        cname = call_name(node, self.mod.aliases)
-        if cname in _MATERIALIZERS and node.args:
-            if self.kind(node.args[0]) == TRACED:
+    def _check_expr(self, node: ast.AST, env: Env) -> None:
+        if isinstance(node, ast.Call):
+            cname = call_name(node, self.mod.aliases)
+            if cname in _MATERIALIZERS and node.args:
+                if self.kind(node.args[0], env) == TRACED:
+                    self._flag(
+                        "TRC102", node,
+                        f"{cname}() materializes a traced value on host "
+                        "(forces a device sync per call inside jit)",
+                    )
+            if isinstance(node.func, ast.Attribute):
+                if (
+                    node.func.attr in _MATERIALIZER_METHODS
+                    and self.kind(node.func.value, env) == TRACED
+                ):
+                    self._flag(
+                        "TRC102", node,
+                        f".{node.func.attr}() materializes a traced value "
+                        "on host (forces a device sync per call inside "
+                        "jit)",
+                    )
+        elif isinstance(node, ast.Name):
+            origin = self.mod.aliases.get(node.id, "")
+            if origin in _HOST_ORIGINS and isinstance(node.ctx, ast.Load):
                 self._flag(
-                    "TRC102", node,
-                    f"{cname}() materializes a traced value on host "
-                    "(forces a device sync per call inside jit)",
+                    "TRC103", node,
+                    f"host module '{origin}' used inside a jit region: it "
+                    "runs at trace time, not per execution",
                 )
-        if isinstance(node.func, ast.Attribute):
-            if (
-                node.func.attr in _MATERIALIZER_METHODS
-                and self.kind(node.func.value) == TRACED
-            ):
-                self._flag(
-                    "TRC102", node,
-                    f".{node.func.attr}() materializes a traced value on "
-                    "host (forces a device sync per call inside jit)",
-                )
-        self.generic_visit(node)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        origin = self.mod.aliases.get(node.id, "")
-        if origin in _HOST_ORIGINS and isinstance(node.ctx, ast.Load):
-            self._flag(
-                "TRC103", node,
-                f"host module '{origin}' used inside a jit region: it runs "
-                "at trace time, not per execution",
+        elif isinstance(node, ast.IfExp):
+            self._check_branch(node.test, "ternary", env)
+        elif isinstance(node, ast.NamedExpr):
+            # keep intra-statement ordering: later subexpressions of this
+            # atom see the walrus binding (transfer re-applies it after)
+            self._check_expr(node.value, env)
+            self._bind_target(node.target, self.kind(node.value, env), env)
+            return
+        elif isinstance(node, ast.Lambda):
+            env_l = Env(LATTICE, dict(env.kinds))
+            for arg in node.args.args + node.args.kwonlyargs:
+                env_l.set(arg.arg, TRACED)
+            sub = _FunctionAnalysis(
+                self.mod, self.modules, self.findings, self.summaries
             )
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        # nested function (scan/while bodies): params are traced carries
-        check_function(self.mod, node, self.findings, parent_env=self.env)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Lambda(self, node: ast.Lambda) -> None:
-        env = _Env(parent=self.env)
-        for arg in node.args.args + node.args.kwonlyargs:
-            env.set(arg.arg, TRACED)
-        sub = _FunctionChecker(self.mod, self.findings, env)
-        sub.visit(node.body)
-
+            sub._flagged_lines = self._flagged_lines
+            sub._check_expr(node.body, env_l)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension, ast.keyword)):
+                self._check_expr(child, env)
+            elif isinstance(child, ast.FormattedValue):
+                self._check_expr(child.value, env)
 
 def _param_env(
-    mod: _Module, fn: ast.FunctionDef, parent_env: Optional[_Env]
-) -> _Env:
-    env = _Env(parent=parent_env)
+    mod: ModuleInfo, fn: ast.FunctionDef, parent_env: Optional[Env]
+) -> Env:
+    static_names = getattr(mod, "static_names", set())
+    base = dict(parent_env.kinds) if parent_env is not None else {}
+    env = Env(LATTICE, base)
     for arg in fn.args.posonlyargs + fn.args.args:
         ann = dotted_name(arg.annotation) if arg.annotation is not None else None
         static = (
             (ann in _STATIC_ANNOTATIONS)
-            or arg.arg in mod.static_names
+            or arg.arg in static_names
             or arg.arg == "self"
         )
         env.set(arg.arg, STATIC if static else TRACED)
@@ -412,37 +451,70 @@ def _param_env(
     return env
 
 
+def _return_kind(
+    mod: ModuleInfo,
+    fn: ast.FunctionDef,
+    modules: Dict[str, ModuleInfo],
+    summaries: ReturnSummaries,
+) -> int:
+    """One-level return-kind summary: the helper's own fixpoint with
+    nested helper calls UNRESOLVED (summaries=None), joined over every
+    return expression."""
+
+    def compute() -> int:
+        analysis = _FunctionAnalysis(mod, modules, findings=[], summaries=None)
+        init = _param_env(mod, fn, None)
+        cfg = build_cfg(fn.body)
+        envs = run_forward(cfg, init, analysis.transfer)
+        out = [STATIC]
+
+        def check(atom: Atom, env: Env) -> None:
+            if (
+                atom.kind == "stmt"
+                and isinstance(atom.node, ast.Return)
+                and atom.node.value is not None
+            ):
+                out.append(analysis.kind(atom.node.value, env))
+
+        sweep(cfg, envs, init, analysis.transfer, check)
+        return max(out)
+
+    return summaries.get((mod.path, fn.name), compute)
+
+
 def check_function(
-    mod: _Module,
+    mod: ModuleInfo,
     fn: ast.FunctionDef,
     findings: List[Finding],
-    parent_env: Optional[_Env] = None,
+    modules: Optional[Dict[str, ModuleInfo]] = None,
+    summaries: Optional[ReturnSummaries] = None,
+    parent_env: Optional[Env] = None,
 ) -> None:
-    env = _param_env(mod, fn, parent_env)
-    checker = _FunctionChecker(mod, findings, env)
-    for stmt in fn.body:
-        checker.visit(stmt)
+    modules = modules if modules is not None else {mod.path: mod}
+    analysis = _FunctionAnalysis(mod, modules, findings, summaries)
+    init = _param_env(mod, fn, parent_env)
+    cfg = build_cfg(fn.body)
+    envs = run_forward(cfg, init, analysis.transfer)
+    sweep(cfg, envs, init, analysis.transfer, analysis.check)
 
 
 def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
     """Run the tracer-safety pass; returns (findings, sources-by-path)."""
-    modules: Dict[str, _Module] = {}
-    sources: Dict[str, SourceFile] = {}
     findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        try:
-            src, tree = parse_file(path)
-        except (OSError, SyntaxError) as exc:
-            findings.append(
-                Finding("TRC100", Severity.ERROR, path, 0, f"unparsable: {exc}")
-            )
-            continue
-        modules[path] = _Module(path, src, tree)
-        sources[path] = src
+    modules, sources, errors = load_modules(paths)
+    for path, exc in errors:
+        findings.append(
+            Finding("TRC100", Severity.ERROR, path, 0, f"unparsable: {exc}")
+        )
+    for mod in modules.values():
+        mod.static_names = _collect_static_argnames(mod.tree)
 
+    summaries = ReturnSummaries(default=STATIC)
     traced = _traced_functions(modules)
     for mod in modules.values():
         for fname, fn in mod.index.functions.items():
             if (mod.path, fname) in traced:
-                check_function(mod, fn, findings)
+                check_function(
+                    mod, fn, findings, modules=modules, summaries=summaries
+                )
     return findings, sources

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .audit import AuditLog, AuditRecord
+from .audit import _REAL_CLOCK
 from .trace import (
     NOOP_SPAN,
     PARENT_ID_METADATA_KEY,
@@ -37,16 +38,47 @@ from .trace import (
 
 _TRACER: Optional[Tracer] = None
 
+# _REAL_CLOCK (imported from audit.py so the tier has exactly ONE
+# wall-time fallback object): every "else wall time" stamp routes
+# through that single named kube.clock.RealClock seam, so the
+# clock-discipline analysis (CLK10xx) has one sanctioned source to
+# whitelist and the determinism contract has one seam to replace under
+# replay.
+
+# monotonic fallback for DURATION measurement: wall time (RealClock) may
+# step under NTP, so deltas never ride it — PerfClock is the documented
+# monotonic seam
+_PERF_CLOCK = PerfClock()
+
+
+def now() -> float:
+    """Timestamp for the solve path: the installed tracer's injected
+    clock when tracing is on, the named RealClock seam otherwise. The
+    only way the solve path may read time — raw ``time.*`` reads in
+    controllers/faults/obs/solver are CLK10xx findings."""
+    if _TRACER is not None:
+        return _TRACER.clock.now()
+    return _REAL_CLOCK.now()
+
+
+def duration_clock():
+    """The clock to measure durations with: the installed tracer's
+    injected clock under tracing (replay-deterministic), the monotonic
+    PerfClock seam otherwise (NEVER RealClock: an NTP step between two
+    reads would record negative durations). Callers capture the clock
+    ONCE per measured interval so an install/uninstall racing the
+    interval cannot mix timebases."""
+    if _TRACER is not None:
+        return _TRACER.clock
+    return _PERF_CLOCK
+
 
 def _audit_now() -> float:
     """One timebase for every audit record in the log: the installed
-    tracer's clock when tracing is on, wall time otherwise — never a mix
-    WITHIN a record source, so ``AUDIT.query(since=...)`` is coherent."""
-    if _TRACER is not None:
-        return _TRACER.clock.now()
-    import time
-
-    return time.time()
+    tracer's clock when tracing is on, the RealClock seam otherwise —
+    never a mix WITHIN a record source, so ``AUDIT.query(since=...)``
+    is coherent."""
+    return now()
 
 
 # the process-wide decision trail; always on (records never influence
@@ -97,5 +129,5 @@ __all__ = [
     "AuditLog", "AuditRecord", "AUDIT",
     "TRACE_ID_METADATA_KEY", "PARENT_ID_METADATA_KEY",
     "install", "uninstall", "active", "span", "event", "current_span",
-    "validate_chrome_trace",
+    "now", "duration_clock", "validate_chrome_trace",
 ]

@@ -27,6 +27,14 @@ from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Deque, Dict, List, Optional
 
+from ..kube.clock import RealClock
+
+# THE wall-time fallback for the observability tier — one object, one
+# seam: obs/__init__ imports this same instance for its audit-timestamp
+# fallback (CLK10xx whitelists exactly the RealClock class, nothing
+# else in the tier reads the wall clock)
+_REAL_CLOCK = RealClock()
+
 
 @dataclass
 class AuditRecord:
@@ -79,9 +87,7 @@ class AuditLog:
     def _now(self) -> float:
         if self._clock is not None:
             return self._clock()
-        import time
-
-        return time.time()
+        return _REAL_CLOCK.now()
 
     def record(self, **fields) -> AuditRecord:
         fields.setdefault("timestamp", self._now())

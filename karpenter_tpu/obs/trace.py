@@ -56,7 +56,12 @@ PARENT_ID_METADATA_KEY = "ktpu-parent-id"
 
 class PerfClock:
     """Wall-clock for standalone tracing (bench, the trace smoke): the
-    operator injects its own Clock; this is for callers without one."""
+    operator injects its own Clock; this is for callers without one.
+
+    One of the two documented RealClock seams (with kube.clock.RealClock)
+    that the clock-discipline analysis (CLK10xx) whitelists — the ONLY
+    places in controllers/faults/obs/solver allowed to read ``time.*``
+    directly. Everything else threads an injected clock or obs.now()."""
 
     @staticmethod
     def now() -> float:

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 
 import logging
-import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -264,11 +263,17 @@ class TpuSolver:
         self._audit_rung = "kernel"
         self._audit_guard = "ok"
         fault_mark = self._fault_log_mark()
-        t0 = _time.perf_counter()
+        # one duration clock captured per solve: the tracer's injected
+        # clock under tracing (replay-deterministic), the monotonic
+        # PerfClock seam otherwise — never a raw wall-clock read in the
+        # solve path (CLK10xx), and never RealClock for a DELTA (an NTP
+        # step between the two reads would record a negative duration)
+        dclk = obs.duration_clock()
+        t0 = dclk.now()
         with obs.span("solve", pods=len(pods)) as sp:
             results = self._solve_routed(pods)
         self._emit_audit(
-            "solve", sp, t0, fault_mark,
+            "solve", sp, dclk, t0, fault_mark,
             pods=len(pods),
             claims=len(results.new_node_claims),
             errors=len(results.pod_errors),
@@ -289,7 +294,7 @@ class TpuSolver:
         inj = faults.active()
         return len(inj.log) if inj is not None else 0
 
-    def _emit_audit(self, kind, sp, t0, fault_mark, **fields) -> None:
+    def _emit_audit(self, kind, sp, dclk, t0, fault_mark, **fields) -> None:
         from .. import faults
 
         inj = faults.active()
@@ -301,7 +306,9 @@ class TpuSolver:
         obs.AUDIT.record(
             kind=kind,
             trace_id=getattr(sp, "trace_id", ""),
-            duration_ms=round((_time.perf_counter() - t0) * 1000, 3),
+            # same clock OBJECT as t0: an install/uninstall racing the
+            # solve cannot mix timebases into one delta
+            duration_ms=round((dclk.now() - t0) * 1000, 3),
             encode_hash=self._shared_cache.content_hash,
             rung=self._audit_rung,
             guard=self._audit_guard,
@@ -478,7 +485,8 @@ class TpuSolver:
         self._audit_guard = "ok"
         self._audit_error = ""
         fault_mark = self._fault_log_mark()
-        t0 = _time.perf_counter()
+        dclk = obs.duration_clock()
+        t0 = dclk.now()
         with obs.span("scenarios", scenarios=len(scenarios)) as sp:
             results = self._solve_scenarios_impl(scenarios)
         if (
@@ -494,7 +502,7 @@ class TpuSolver:
                 len(r.new_node_claims) for r in (results or [])
             )
             self._emit_audit(
-                "scenarios", sp, t0, fault_mark,
+                "scenarios", sp, dclk, t0, fault_mark,
                 pods=sum(len(s.pods) for s in scenarios),
                 claims=obs_claims,
                 errors=sum(len(r.pod_errors) for r in (results or [])),
@@ -648,6 +656,7 @@ class TpuSolver:
                     )
                     (c_pool, packed, n_open, overflow,
                      exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                     # analysis: sanctioned[DTX906] blessed decode boundary: one readback per scenario batch (PARITY.md)
                      c_resv) = [np.asarray(x) for x in jax.device_get(out)]
                 dispatches += 1
                 if not overflow.any():
@@ -821,6 +830,7 @@ class TpuSolver:
                     out = fn(*margs)
                 (c_pool, c_tmask, n_open, overflow,
                  exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+                 # analysis: sanctioned[DTX906] blessed decode boundary: one readback per sharded solve (PARITY.md)
                  c_resv) = [np.asarray(x) for x in jax.device_get(out)]
                 return (
                     c_pool.astype(np.int32), c_tmask, n_open, overflow,
@@ -868,6 +878,7 @@ class TpuSolver:
                 (c_pool, packed, n_open, overflow,
                  exist_fills, claim_fills, unplaced, c_dzone, c_dct,
                  c_resv) = [
+                    # analysis: sanctioned[DTX906] blessed decode boundary: one readback per dispatch (PARITY.md)
                     np.asarray(x) for x in jax.device_get(out)
                 ]
                 # the type mask stays bit-packed: _decode unpacks only the

@@ -1,0 +1,33 @@
+"""Seeded clock-discipline violations: every CLK rule fires here."""
+
+import time
+from datetime import datetime
+
+from time import monotonic as mono
+
+
+def stamp_record(record):
+    record["ts"] = time.time()  # CLK1001: direct wall-clock read
+    record["day"] = datetime.now()  # CLK1001: datetime.now read
+    return record
+
+
+def aliased_read():
+    return mono()  # CLK1001 through the from-import alias
+
+
+class Reconciler:
+    def __init__(self):
+        # CLK1002: the callable escapes into instance state — the
+        # injection seams can never replace it
+        self._now = time.perf_counter
+
+    def step(self):
+        start = time.monotonic  # CLK1002: stashed reference
+        t0 = start()  # CLK1001: the stashed reference is called
+        return t0
+
+
+def pass_clock_along(schedule):
+    # CLK1002: a wall-clock callable handed to someone else
+    schedule(time.monotonic)

@@ -1,0 +1,78 @@
+"""Seeded device-residency violations: every DTX rule fires here.
+
+Host-side driver-shaped code (NOT jitted — that's bad_tracer.py's
+territory): device values leak into host sinks outside any sanctioned
+boundary.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def branch_on_device(xs):
+    scores = jnp.cumsum(xs)  # device origin
+    if scores[0] > 0:  # DTX901: truthiness on a device value
+        return scores
+    while scores.sum() > 0:  # DTX901 again (device while-condition)
+        scores = scores - 1
+    flag = bool(scores[0])  # DTX901: bool() materializes the predicate
+    return flag
+
+
+def materialize_device(xs):
+    total = jnp.sum(xs)
+    best = float(total)  # DTX902: host materialization
+    exact = total.item()  # DTX902: .item() sync
+    rows = total.tolist()  # DTX902: .tolist() sync
+    return best, exact, rows
+
+
+def numpy_on_device(xs):
+    staged = jax.device_put(xs)  # device origin via device_put
+    host = np.asarray(staged)  # DTX903: implicit device_get
+    arr = np.array(staged)  # DTX903 again
+    return host, arr
+
+
+def iterate_device(xs):
+    cols = jnp.stack([xs, xs])
+    out = []
+    for row in cols:  # DTX904: python loop over a device value
+        out.append(row)
+    return out, list(cols)  # DTX904: list() iterates on host
+
+
+def print_device(xs):
+    mean = jnp.mean(xs)
+    print("mean was", mean)  # DTX905: print syncs the value
+    return f"mean={mean}"  # DTX905: f-string interpolation
+
+
+def unsanctioned_readback(xs):
+    out = jnp.sort(xs)
+    return jax.device_get(out)  # DTX906: readback without a sanction
+
+
+def helper_launders_device(xs):
+    # one-level interprocedural reach: _hidden_origin returns a jnp
+    # result, so `masked` is a device value at this call site too
+    masked = _hidden_origin(xs)
+    if masked[0] > 0:  # DTX901 through the helper summary
+        return masked
+    return None
+
+
+def _hidden_origin(xs):
+    return jnp.where(xs > 0, xs, 0)
+
+
+def branch_merge_still_device(xs, use_alt):
+    # the CFG join keeps DEVICE through the diamond: both arms bind a
+    # device value, so the sink below must still flag
+    if use_alt:
+        acc = jnp.zeros_like(xs)
+    else:
+        acc = jnp.ones_like(xs)
+    return int(acc[0])  # DTX902 after the join

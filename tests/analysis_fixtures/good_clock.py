@@ -1,0 +1,39 @@
+"""Clean clock-discipline twin: injected clocks and the documented
+RealClock seams only."""
+
+import time
+
+
+class RealClock:
+    """The documented seam (kube/clock.py shape): the ONLY place a wall
+    clock may be read directly."""
+
+    def now(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class PerfClock:
+    """The obs/trace.py seam twin."""
+
+    @staticmethod
+    def now() -> float:
+        return time.perf_counter()
+
+
+def stamp_record(record, clock):
+    record["ts"] = clock.now()  # injected clock: fine
+    return record
+
+
+def duration_of(clock, fn):
+    t0 = clock.now()
+    fn()
+    return clock.since(t0)
+
+
+def sanctioned_diagnostic():
+    # a documented real-wall-time boundary, annotated not suppressed
+    return time.monotonic()  # analysis: sanctioned[CLK1001] fixture wall-time boundary

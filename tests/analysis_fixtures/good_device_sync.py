@@ -1,0 +1,40 @@
+"""Clean device-residency twin: device values stay on device until the
+sanctioned decode boundary; every host decision reads host metadata."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def disciplined_solve(xs, nmax: int):
+    staged = jax.device_put(xs)
+    out = jnp.cumsum(staged)  # device math stays device
+    if nmax > 4:  # host branch on host metadata: fine
+        out = out * 2
+    # analysis: sanctioned[DTX906] fixture decode boundary
+    host = jax.device_get(out)
+    return np.asarray(host)  # host numpy on a host value: fine
+
+
+def shape_projections(xs):
+    arr = jnp.stack([xs, xs])
+    n = arr.shape[0]  # shape/dtype projections are host metadata
+    if n > 1:  # fine: branching on a static projection
+        return arr
+    return arr.T
+
+
+def poison_to_unknown(xs, blob):
+    mixed = jnp.sum(xs) + blob.mystery()  # joins to unknown
+    if mixed > 0:  # unknown, not device: silent by design
+        return mixed
+    for item in blob.rows():  # unknown iterable: silent
+        print(item)  # unknown value: silent
+    return None
+
+
+def host_pipeline(spans):
+    arr = np.asarray(spans, np.int64)  # host end to end
+    total = int(arr.sum())
+    return [float(v) for v in arr if v > 0], total

@@ -11,12 +11,15 @@ import pytest
 from karpenter_tpu.analysis import (
     all_rules,
     blocking,
+    clock,
+    device,
     locks,
     obs,
     parity,
     retry,
     schema_drift,
     shapes,
+    stale,
     tracer,
 )
 from karpenter_tpu.analysis.findings import (
@@ -24,6 +27,7 @@ from karpenter_tpu.analysis.findings import (
     SourceFile,
     filter_suppressed,
     load_baseline,
+    partition_findings,
     write_baseline,
 )
 
@@ -589,6 +593,451 @@ class TestObsPass:
         assert remaining == [], [f.render() for f in remaining]
 
 
+class TestDataflowCore:
+    """The shared CFG + forward-fixpoint engine every flow-shaped family
+    rides (analysis/core/)."""
+
+    def _envs(self, src, init_kinds=None):
+        import ast as ast_mod
+
+        from karpenter_tpu.analysis.core.cfg import build_cfg
+        from karpenter_tpu.analysis.core.dataflow import Env, run_forward
+        from karpenter_tpu.analysis.core.lattice import Lattice
+
+        lattice = Lattice(top=2, default=0)
+        tree = ast_mod.parse(src)
+        fn = tree.body[0]
+        cfg = build_cfg(fn.body)
+
+        def transfer(atom, env):
+            node = atom.node
+            if atom.kind == "stmt" and isinstance(node, ast_mod.Assign):
+                value = node.value
+                kind = 0
+                if isinstance(value, ast_mod.Name):
+                    kind = env.get(value.id)
+                elif isinstance(value, ast_mod.Constant):
+                    kind = 0
+                elif isinstance(value, ast_mod.Call):
+                    kind = 2  # "interesting" origin for the test
+                elif isinstance(value, ast_mod.BinOp):
+                    kinds = [
+                        env.get(n.id)
+                        for n in (value.left, value.right)
+                        if isinstance(n, ast_mod.Name)
+                    ]
+                    kind = max(kinds, default=0)
+                for t in node.targets:
+                    if isinstance(t, ast_mod.Name):
+                        env.set(t.id, kind)
+
+        init = Env(lattice, dict(init_kinds or {}))
+        envs = run_forward(cfg, init, transfer)
+        # env AFTER the whole function = join over terminal block exits;
+        # approximate with the join over every block-entry env
+        final = Env(lattice)
+        for env in envs.values():
+            final.join_from(env)
+        for block in cfg.blocks:
+            env = envs.get(block.id)
+            if env is None:
+                continue
+            env = env.clone()
+            for atom in block.atoms:
+                transfer(atom, env)
+            final.join_from(env)
+        return final
+
+    def test_branch_join_takes_the_max(self):
+        final = self._envs(
+            "def f(c):\n"
+            "    if c:\n"
+            "        x = origin()\n"
+            "    else:\n"
+            "        x = 1\n"
+            "    y = x\n"
+        )
+        assert final.get("x") == 2  # interesting on SOME path -> joined up
+        assert final.get("y") == 2
+
+    def test_loop_carried_kind_reaches_fixpoint(self):
+        # x becomes interesting on iteration 1; the back-edge must carry
+        # it into iteration 2's view of the loop header
+        final = self._envs(
+            "def f(items):\n"
+            "    x = 0\n"
+            "    for i in items:\n"
+            "        y = x\n"
+            "        x = origin()\n"
+        )
+        assert final.get("x") == 2
+        assert final.get("y") == 2  # only visible via the back-edge
+
+    def test_except_edge_sees_partial_body(self):
+        # the exception can fire after `x = origin()`, so the handler's
+        # entry env must include that binding
+        final = self._envs(
+            "def f():\n"
+            "    try:\n"
+            "        x = origin()\n"
+            "        x = 1\n"
+            "    except Exception:\n"
+            "        y = x\n"
+        )
+        assert final.get("y") == 2
+
+
+class TestDevicePass:
+    """DTX9xx: device values tracked from jnp/device_put/dispatch origins
+    to host-sync sinks on the dataflow core."""
+
+    REAL_TARGETS = [
+        os.path.join(REPO, "karpenter_tpu", "ops"),
+        os.path.join(REPO, "karpenter_tpu", "solver", "driver.py"),
+        os.path.join(REPO, "karpenter_tpu", "faults", "guard.py"),
+    ]
+
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = device.check_paths([fixture("bad_device_sync.py")])
+        assert rules_of(findings) == {
+            "DTX901", "DTX902", "DTX903", "DTX904", "DTX905", "DTX906",
+        }
+        # the interprocedural case: a same-module helper returning a jnp
+        # result makes the call site a device value (line 62's branch)
+        assert any(
+            f.rule == "DTX901" and f.line == 62 for f in findings
+        ), "helper-laundered device value not tracked"
+        # the CFG-join case: both arms of the diamond bind device, so
+        # the materialization after the merge still flags
+        assert any(
+            f.rule == "DTX902" and f.line == 78 for f in findings
+        ), "device kind lost at the branch join"
+
+    def test_clean_fixture_silent_with_sanctioned_boundary(self):
+        findings, sources = device.check_paths(
+            [fixture("good_device_sync.py")]
+        )
+        kept, suppressed, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert suppressed == []
+        # the fixture's one device_get carries a sanction: emitted,
+        # classified as a boundary, never gating
+        assert [f.rule for f in sanctioned] == ["DTX906"]
+
+    def test_poison_to_unknown_never_false_positives(self, tmp_path):
+        # a device value joined with something untrackable must go
+        # silent, not flag (the lattice property, not a special case)
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(xs, blob):\n"
+            "    v = jnp.sum(xs)\n"
+            "    v = v + blob.read()\n"
+            "    if v > 0:\n"
+            "        return float(v)\n"
+            "    return None\n"
+        )
+        p = tmp_path / "poison.py"
+        p.write_text(src)
+        findings, _ = device.check_paths([str(p)])
+        assert findings == []
+
+    def test_device_get_boundary_yields_host(self, tmp_path):
+        # after the sanctioned readback the decode side is host numpy
+        # and must be silent
+        src = (
+            "import numpy as np\n"
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def f(xs):\n"
+            "    out = jnp.sort(xs)\n"
+            "    host = jax.device_get(out)  # analysis: sanctioned[DTX906] t\n"
+            "    if host[0] > 0:\n"
+            "        return np.asarray(host)\n"
+            "    return float(host[0])\n"
+        )
+        p = tmp_path / "boundary.py"
+        p.write_text(src)
+        findings, sources = device.check_paths([str(p)])
+        kept, _, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert len(sanctioned) == 1
+
+    def test_real_solve_path_clean_with_three_blessed_readbacks(self):
+        """The device-residency contract (PARITY.md): the ONLY
+        device->host crossings in the solve path are driver.py's three
+        sanctioned decode readbacks — the set the delta-encode PR must
+        not widen."""
+        findings, sources = device.check_paths(self.REAL_TARGETS)
+        kept, suppressed, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert len(sanctioned) == 3
+        assert all(f.rule == "DTX906" for f in sanctioned)
+        assert all(f.path.endswith("driver.py") for f in sanctioned)
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = device.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"DTX900"}
+
+    def test_module_level_sinks_flagged(self, tmp_path):
+        # the pass covers module bodies too: a top-level device table
+        # fed into host sinks must not slip past the residency contract
+        (tmp_path / "toplevel.py").write_text(
+            "import jax.numpy as jnp\n"
+            "_TABLE = jnp.arange(8)\n"
+            "_LIST = list(_TABLE)\n"
+            "print(_TABLE)\n"
+            "if _TABLE[0] > 0:\n"
+            "    _X = float(_TABLE[0])\n"
+        )
+        findings, _ = device.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {
+            "DTX901", "DTX902", "DTX904", "DTX905",
+        }
+
+
+class TestClockPass:
+    """CLK10xx: every timestamp on the determinism surface flows from an
+    injected clock or a documented RealClock seam."""
+
+    REAL_TARGETS = [
+        os.path.join(REPO, "karpenter_tpu", "controllers"),
+        os.path.join(REPO, "karpenter_tpu", "faults"),
+        os.path.join(REPO, "karpenter_tpu", "obs"),
+        os.path.join(REPO, "karpenter_tpu", "solver"),
+    ]
+
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = clock.check_paths([fixture("bad_clock.py")])
+        assert rules_of(findings) == {"CLK1001", "CLK1002"}
+        # the dataflow case: `start = time.monotonic` then `start()` —
+        # the call through the binding is a read (line 27)
+        assert any(
+            f.rule == "CLK1001" and f.line == 27 for f in findings
+        ), "wall-clock read through a tracked binding not flagged"
+
+    def test_clean_fixture_silent(self):
+        findings, sources = clock.check_paths([fixture("good_clock.py")])
+        kept, _, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        # the documented diagnostic boundary is sanctioned, not hidden
+        assert [f.rule for f in sanctioned] == ["CLK1001"]
+
+    def test_seam_classes_exempt(self, tmp_path):
+        src = (
+            "import time\n"
+            "class RealClock:\n"
+            "    def now(self):\n"
+            "        return time.time()\n"
+            "class NotASeam:\n"
+            "    def now(self):\n"
+            "        return time.time()\n"
+        )
+        p = tmp_path / "seams.py"
+        p.write_text(src)
+        findings, _ = clock.check_paths([str(p)])
+        assert [(f.rule, f.line) for f in findings] == [("CLK1001", 7)]
+
+    def test_injected_clock_silent(self, tmp_path):
+        src = (
+            "def reconcile(clock, store):\n"
+            "    t0 = clock.now()\n"
+            "    store.stamp(clock.now)\n"
+            "    return clock.since(t0)\n"
+        )
+        p = tmp_path / "injected.py"
+        p.write_text(src)
+        findings, _ = clock.check_paths([str(p)])
+        assert findings == []
+
+    def test_real_determinism_surface_clean(self):
+        """Controllers/faults/obs/solver carry no unsanctioned wall-clock
+        reads: obs routes its fallbacks through the RealClock seam, the
+        driver's audit durations ride obs.now(), and the wall-time
+        diagnostics in controllers are sanctioned boundaries."""
+        findings, sources = clock.check_paths(self.REAL_TARGETS)
+        kept, suppressed, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert suppressed == []
+        assert len(sanctioned) == 10
+        assert {f.rule for f in sanctioned} == {"CLK1001"}
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = clock.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"CLK1000"}
+
+
+class TestDataflowMigration:
+    """The migration contract: re-hosting TRC/RTY on the dataflow core
+    loses no findings on the fixture corpus. The expected sets below are
+    the AST-walker generation's exact output, captured before the
+    migration — a drift in either direction fails."""
+
+    PRE_MIGRATION_TRACER = [
+        ("TRC101", 13), ("TRC101", 15), ("TRC102", 23), ("TRC102", 24),
+        ("TRC102", 37), ("TRC103", 30), ("TRC103", 31), ("TRC104", 38),
+        ("TRC104", 40),
+    ]
+    PRE_MIGRATION_RETRY = [
+        ("RTY701", 9), ("RTY701", 16), ("RTY701", 24), ("RTY701", 32),
+        ("RTY702", 29), ("RTY702", 37),
+    ]
+
+    def test_tracer_fixture_identical_pre_post_migration(self):
+        findings, _ = tracer.check_paths([fixture("bad_tracer.py")])
+        assert sorted(
+            (f.rule, f.line) for f in findings
+        ) == self.PRE_MIGRATION_TRACER
+
+    def test_retry_fixture_identical_pre_post_migration(self):
+        findings, _ = retry.check_paths([fixture("bad_retry.py")])
+        assert sorted(
+            (f.rule, f.line) for f in findings
+        ) == self.PRE_MIGRATION_RETRY
+
+    def test_tracer_interprocedural_reach_through_helper(self, tmp_path):
+        # what the migration BUYS: a helper returning a jnp result makes
+        # the bare-name call site traced — invisible to the old walker
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def make_mask(x):\n"
+            "    return jnp.where(x > 0, x, 0)\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    hidden = make_mask(x)\n"
+            "    if hidden[0] > 0:\n"
+            "        return hidden\n"
+            "    return x\n"
+        )
+        p = tmp_path / "helper.py"
+        p.write_text(src)
+        findings, _ = tracer.check_paths([str(p)])
+        assert any(f.rule == "TRC101" and f.line == 8 for f in findings)
+
+    def test_retry_bound_reach_through_helper(self, tmp_path):
+        # a loop whose handler path calls a same-module helper that
+        # touches a Backoff is bounded — the old matcher flagged it
+        src = (
+            "def _pause(bk):\n"
+            "    bk.backoff.sleep()\n"
+            "def retry_loop(fn, bk):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return fn()\n"
+            "        except Exception:\n"
+            "            _pause(bk)\n"
+        )
+        p = tmp_path / "reach.py"
+        p.write_text(src)
+        findings, _ = retry.check_paths([str(p)])
+        assert not any(f.rule == "RTY702" for f in findings)
+
+
+class TestSanctionDialect:
+    """`# analysis: sanctioned[RULE]` is a documented boundary marker:
+    classified apart from suppressions, honored by filter_suppressed."""
+
+    def _finding(self, line, rule="DTX906", path="x.py"):
+        return Finding(rule, "error", path, line, "msg")
+
+    def test_partition_separates_the_channels(self):
+        src = SourceFile(
+            path="x.py",
+            text=(
+                "a = sync()  # analysis: sanctioned[DTX906] boundary\n"
+                "pad = 0\n"
+                "b = risky()  # analysis: ignore[DTX906] reason\n"
+                "pad = 1\n"
+                "c = plain()\n"
+            ),
+        )
+        sources = {"x.py": src}
+        kept, suppressed, sanctioned = partition_findings(
+            [self._finding(1), self._finding(3), self._finding(5)],
+            sources,
+        )
+        assert [f.line for f in kept] == [5]
+        assert [f.line for f in suppressed] == [3]
+        assert [f.line for f in sanctioned] == [1]
+
+    def test_filter_suppressed_drops_both_dialects(self):
+        src = SourceFile(
+            path="x.py",
+            text="a = sync()  # analysis: sanctioned[DTX906] boundary\n",
+        )
+        assert filter_suppressed([self._finding(1)], {"x.py": src}) == []
+
+    def test_placeholder_rule_ids_are_not_markers(self):
+        # docstrings write `ignore[RULE]`; a rule id without digits is a
+        # placeholder, never a marker (the stale audit relies on this)
+        src = SourceFile(
+            path="x.py",
+            text="# analysis: ignore[RULE] documentation example\n",
+        )
+        assert src.markers == []
+
+
+class TestStaleAudit:
+    """STALE001: suppressions/sanctions that no longer match anything."""
+
+    def _finding(self, line, rule="TRC101", path="x.py"):
+        return Finding(rule, "error", path, line, "msg")
+
+    def test_stale_baseline_entry_flagged_and_prunable(self):
+        baseline = {
+            ("TRC101", "x.py", "msg"),  # live
+            ("LCK202", "gone.py", "old message"),  # stale
+        }
+        findings, stale_entries = stale.audit(
+            [self._finding(5)], {}, baseline, "hack/analysis_baseline.txt"
+        )
+        assert [f.rule for f in findings] == ["STALE001"]
+        assert "LCK202" in findings[0].message
+        assert stale_entries == {("LCK202", "gone.py", "old message")}
+
+    def test_stale_and_live_inline_markers(self):
+        src = SourceFile(
+            path="x.py",
+            text=(
+                "a = risky()  # analysis: ignore[TRC101] live\n"
+                "b = 2  # analysis: ignore[TRC102] stale\n"
+                "c = sync()  # analysis: sanctioned[DTX906] live\n"
+                "d = 4  # analysis: sanctioned[DTX906] stale\n"
+            ),
+        )
+        produced = [
+            self._finding(1, "TRC101"),
+            self._finding(3, "DTX906"),
+        ]
+        findings, _ = stale.audit(
+            produced, {"x.py": src}, None, "baseline.txt"
+        )
+        assert sorted((f.rule, f.line) for f in findings) == [
+            ("STALE001", 2), ("STALE001", 4),
+        ]
+
+    def test_unscanned_file_rules_not_judged(self):
+        # a BLK302 marker in a file the blocking pass never scanned must
+        # not be called stale (accuracy gate)
+        src = SourceFile(
+            path="x.py",
+            text="t = now()  # analysis: ignore[BLK302] wall gauge\n",
+        )
+        findings, _ = stale.audit(
+            [], {"x.py": src}, None, "baseline.txt",
+            scanned_by_rule={"BLK302": {"other.py"}},
+        )
+        assert findings == []
+        # ...but when the pass DID scan the file, staleness is judged
+        findings, _ = stale.audit(
+            [], {"x.py": src}, None, "baseline.txt",
+            scanned_by_rule={"BLK302": {"x.py"}},
+        )
+        assert [f.rule for f in findings] == ["STALE001"]
+
+
 class TestRuleRegistry:
     """The meta-contract: every shipped rule id has at least one seeded-bad
     fixture. Parse-failure rules (x00) are seeded at runtime because a
@@ -598,6 +1047,7 @@ class TestRuleRegistry:
         rules = all_rules()
         for prefix in (
             "TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6", "RTY7", "OBS8",
+            "DTX9", "CLK10", "STALE",
         ):
             assert any(r.startswith(prefix) for r in rules), prefix
 
@@ -632,6 +1082,22 @@ class TestRuleRegistry:
             shapes.check_paths([fixture("bad_shapes.py"), str(broken)]),
             retry.check_paths([fixture("bad_retry.py"), str(broken)]),
             obs.check_paths([fixture("bad_obs.py"), str(broken)]),
+            device.check_paths(
+                [fixture("bad_device_sync.py"), str(broken)]
+            ),
+            clock.check_paths([fixture("bad_clock.py"), str(broken)]),
+            # STALE001's seeded-bad shape is a marker matching nothing
+            stale.audit(
+                [],
+                {
+                    "stale_fixture.py": SourceFile(
+                        path="stale_fixture.py",
+                        text="x = 1  # analysis: ignore[TRC101] stale\n",
+                    )
+                },
+                {("LCK202", "gone.py", "old")},
+                "baseline.txt",
+            )[:1] + ({},),
         ]
         for findings, _sources in runs:
             produced |= {f.rule for f in findings}
@@ -778,6 +1244,105 @@ class TestCli:
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "suppressed" in proc.stderr
+
+    @pytest.mark.parametrize(
+        "pass_name,target",
+        [
+            ("device", "bad_device_sync.py"),
+            ("clock", "bad_clock.py"),
+        ],
+    )
+    def test_cli_nonzero_on_new_families(self, pass_name, target):
+        proc = self._run("--pass", pass_name, fixture(target))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "error[" in proc.stdout
+
+    def test_changed_only_scopes_discovery(self):
+        from karpenter_tpu.analysis.cli import PASS_TARGETS, _scope_targets
+
+        changed = {
+            os.path.join(REPO, "karpenter_tpu", "ops", "solve.py"),
+        }
+        tracer_targets = [
+            os.path.join(REPO, t) for t in PASS_TARGETS["tracer"]
+        ]
+        scoped = _scope_targets("tracer", tracer_targets, changed)
+        assert scoped == [
+            os.path.join(REPO, "karpenter_tpu", "ops", "solve.py")
+        ]
+        # pair passes run when any half changed, not at all otherwise
+        schema_targets = [
+            os.path.join(REPO, t) for t in PASS_TARGETS["schema"]
+        ]
+        assert _scope_targets("schema", schema_targets, changed) == []
+        assert _scope_targets(
+            "schema", schema_targets,
+            {os.path.join(REPO, "karpenter_tpu", "api", "schema.py")},
+        ) == schema_targets
+
+    def test_changed_only_cli_smoke(self):
+        # fast lane over whatever the working tree has changed: must be
+        # clean (same gate as the full run, smaller file set)
+        proc = self._run("--changed-only")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_sarif_records_analyzer_runtime(self):
+        import json
+
+        proc = self._run(
+            "--format", "sarif", "--pass", "clock", fixture("bad_clock.py")
+        )
+        doc = json.loads(proc.stdout)
+        props = doc["runs"][0]["properties"]
+        assert props["analysisSeconds"] >= 0
+        assert "clock" in props["passSeconds"]
+
+    def test_prune_baseline_drops_stale_entries(self, tmp_path):
+        baseline = tmp_path / "baseline.txt"
+        baseline.write_text(
+            "LCK202\tgone.py\tnever produced anymore\n"
+        )
+        proc = self._run(
+            "--prune-baseline", "--baseline", str(baseline)
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "pruned 1 stale baseline entry" in proc.stdout
+        text = baseline.read_text()
+        assert "gone.py" not in text
+
+    def test_prune_baseline_rejects_partial_runs(self, tmp_path):
+        # pruning on a partial finding set would silently drop live
+        # entries; --no-baseline would truncate the whole file
+        baseline = tmp_path / "baseline.txt"
+        baseline.write_text("TRC101\tx.py\tlive entry\n")
+        for extra in (["--no-baseline"], ["--pass", "tracer"]):
+            proc = self._run(
+                "--prune-baseline", "--baseline", str(baseline), *extra
+            )
+            assert proc.returncode == 2, proc.stdout + proc.stderr
+            assert "prune-baseline" in proc.stderr
+        assert "live entry" in baseline.read_text()  # untouched
+
+    def test_full_run_flags_stale_inline_marker(self, tmp_path):
+        # a stale marker committed into a scanned tree fails the full
+        # run (the STALE001 gate presubmit's slow lane enforces)
+        import shutil
+
+        src_dir = tmp_path / "karpenter_tpu" / "controllers"
+        src_dir.mkdir(parents=True)
+        (tmp_path / "hack").mkdir()
+        (src_dir / "__init__.py").write_text("")
+        (src_dir / "thing.py").write_text(
+            "def f(x):\n"
+            "    return x  # analysis: ignore[BLK301] stale marker\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.analysis",
+             "--root", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "STALE001" in proc.stdout
 
     def test_wrapper_clean_on_final_tree(self):
         proc = subprocess.run(

@@ -78,6 +78,41 @@ def main() -> int:
         )
         return 1
 
+    # one churn tick traced (ISSUE 8): a count-level delta must ride the
+    # device-resident path — the solve.delta_apply span proves the rows
+    # went as an in-place update, and the audit record must carry the
+    # incremental-encode provenance fields
+    churned = pods[:-1]  # one pod gone: same group shapes, new count
+    tracer2 = obs.install(obs.Tracer(obs.PerfClock(), seed=1))
+    try:
+        results2 = make_solver().solve(churned)
+    finally:
+        obs.uninstall()
+    assert not results2.pod_errors, "churn tick must schedule fully"
+    totals2 = tracer2.phase_totals()
+    if "solve.delta_apply" not in totals2:
+        print(
+            "trace-smoke: churn tick missing the solve.delta_apply span "
+            f"(got {sorted(totals2)})",
+            file=sys.stderr,
+        )
+        return 1
+    rec2 = obs.AUDIT.query(kind="solve")[-1]
+    if rec2.encode_reused is None or rec2.delta_rows is None:
+        print(
+            "trace-smoke: audit record missing incremental-encode fields: "
+            f"encode_reused={rec2.encode_reused!r} delta_rows={rec2.delta_rows!r}",
+            file=sys.stderr,
+        )
+        return 1
+    if rec2.delta_rows < 1:
+        print(
+            f"trace-smoke: churn tick reported no delta rows "
+            f"(delta_rows={rec2.delta_rows})",
+            file=sys.stderr,
+        )
+        return 1
+
     n_events = len(doc["traceEvents"])
     print(
         f"trace-smoke OK: {n_events} events, phases "

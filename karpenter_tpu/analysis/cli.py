@@ -106,10 +106,13 @@ PASS_TARGETS = {
     # anywhere in the package (the obs seams thread through everything)
     "obs": ["karpenter_tpu"],
     # device-residency dataflow over the solve path: where device values
-    # are born (ops/), routed (driver), and guarded (faults/guard.py)
+    # are born (ops/), routed (driver), held BETWEEN solves
+    # (solver/residency.py — the dev_*/_dev* resident-attribute
+    # convention), and guarded (faults/guard.py)
     "device": [
         "karpenter_tpu/ops",
         "karpenter_tpu/solver/driver.py",
+        "karpenter_tpu/solver/residency.py",
         "karpenter_tpu/faults/guard.py",
     ],
     # clock discipline over the determinism surface: every timestamp in

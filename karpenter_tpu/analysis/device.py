@@ -33,6 +33,17 @@ only on *definite* device values:
   not widen the set. (A sanction is an audited boundary marker, not a
   suppression — see findings.py.)
 
+**No host crossing between solves** (the delta-encode extension the
+contract table designed for): attributes named by the resident
+convention — ``dev_*`` / ``_dev*`` — hold device values ACROSS solves
+(solver/residency.py's buffer store), so loads from them are DEVICE-born
+no matter what object carries them. A delta path that launders a
+resident buffer through ``np.asarray`` between solves flags DTX903, an
+iteration DTX904, a ``device_get`` outside the sanctioned drain DTX906 —
+the same sinks, now reachable through persistent state the
+poison-to-unknown discipline used to hide. One rule of origin, the
+existing rules of sin.
+
 ``jax.device_get`` and sanctioned sinks yield HOST downstream, so the
 decode path (all host numpy after the readback) stays silent.
 """
@@ -81,6 +92,11 @@ _HOST_JAX = (
 # kernel-dispatch naming convention (ops/solve.py): these return device
 # arrays by contract even through the fault-seam wrappers
 _DISPATCH_PREFIXES = ("dispatch_", "solve_all")
+# device-resident attribute naming convention (solver/residency.py):
+# attributes holding device buffers BETWEEN solves — loads are
+# DEVICE-born, so host sinks on them flag even though the carrying
+# object itself is untracked ("no host crossing between solves")
+_RESIDENT_ATTR_PREFIXES = ("dev_", "_dev")
 
 _SHAPE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
 _MATERIALIZERS = {"float", "int", "complex"}
@@ -133,6 +149,12 @@ class _DeviceAnalysis:
         if isinstance(node, ast.Attribute):
             if node.attr in _SHAPE_ATTRS:
                 return HOST
+            if node.attr.startswith(_RESIDENT_ATTR_PREFIXES):
+                # the device-resident naming convention: dev_*/_dev*
+                # attributes hold device buffers between solves
+                # (PARITY.md device-residency contract), so a load is
+                # DEVICE-born regardless of the carrying object
+                return DEVICE
             return self.kind(node.value, env)
         if isinstance(node, ast.Subscript):
             return self.kind(node.value, env)

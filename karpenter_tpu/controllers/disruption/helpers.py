@@ -213,6 +213,7 @@ class ScenarioSimulator:
     ):
         self.available = True
         self.dispatches = 0
+        self._prefetched = None  # (subset key, submit token) — see prefetch()
         if solver_config is not None and (
             solver_config.force_oracle or solver_config.backend != "tpu"
         ):
@@ -256,15 +257,8 @@ class ScenarioSimulator:
                 solver_config=solver_config, encode_cache=encode_cache,
             )
 
-    def solve(
-        self, subsets: Sequence[Sequence[Candidate]]
-    ) -> Optional[List[Results]]:
-        """Per-subset Results from one batched dispatch, aligned with
-        ``subsets`` — or None (and available=False) when the batch cannot
-        be represented; nothing has been solved in that case."""
-        if not self.available:
-            return None
-        scenarios = [
+    def _scenarios_of(self, subsets: Sequence[Sequence[Candidate]]):
+        return [
             Scenario(
                 pods=[p for c in subset for p in c.reschedulable_pods]
                 + self._pending,
@@ -274,12 +268,53 @@ class ScenarioSimulator:
             )
             for subset in subsets
         ]
-        results = self._solver.solve_scenarios(scenarios)
+
+    @staticmethod
+    def _subset_key(subsets: Sequence[Sequence[Candidate]]) -> tuple:
+        return tuple(
+            tuple(c.provider_id for c in subset) for subset in subsets
+        )
+
+    def solve(
+        self, subsets: Sequence[Sequence[Candidate]]
+    ) -> Optional[List[Results]]:
+        """Per-subset Results from one batched dispatch, aligned with
+        ``subsets`` — or None (and available=False) when the batch cannot
+        be represented; nothing has been solved in that case. A matching
+        prefetch() token is collected instead of re-dispatching."""
+        if not self.available:
+            return None
+        token = None
+        if self._prefetched is not None:
+            key, pending = self._prefetched
+            self._prefetched = None
+            if key == self._subset_key(subsets):
+                token = pending
+        if token is None:
+            token = self._solver.submit_scenarios(self._scenarios_of(subsets))
+        results = self._solver.collect_scenarios(token)
         if results is None:
             self.available = False
             return None
         self.dispatches += self._solver.last_scenario_dispatches
         return results
+
+    def prefetch(self, subsets: Sequence[Sequence[Candidate]]) -> None:
+        """Speculatively submit the NEXT chunk's dispatch into the
+        solver's two-slot queue: the kernel computes while the caller is
+        still turning the current chunk's Results into decisions (the
+        async double-buffering of ISSUE 8). A prefetch that loses the
+        race (early success ends the sweep) is simply never collected —
+        the queue evicts it. Never raises: a prefetch failure must not
+        fail the sweep, the chunk will be solved inline when reached."""
+        if not self.available or self._prefetched is not None:
+            return
+        try:
+            token = self._solver.submit_scenarios(self._scenarios_of(subsets))
+        except Exception:
+            return
+        if token is not None:
+            self._prefetched = (self._subset_key(subsets), token)
 
 
 # -- budgets (nodepool.go:296-367, helpers.go:201-249) ---------------------

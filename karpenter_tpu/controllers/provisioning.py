@@ -42,6 +42,23 @@ UNFINISHED_WORK = Gauge(
     "scheduler_unfinished_work_seconds",
     "Age of the in-flight Solve (scheduling/metrics.go:34-72)",
 )
+# incremental always-warm solving (ISSUE 8): how often the reconcile
+# loop's encode amortized — the warm-path health signals the churn bench
+# rows assert offline
+ENCODE_REUSED = Counter(
+    "scheduler_encode_reused_total",
+    "Solves that reused the prior cluster encoding verbatim "
+    "(content-hash fast path)",
+)
+ENCODE_DELTA_ROWS = Counter(
+    "scheduler_encode_delta_rows_total",
+    "Axis rows transferred as device deltas instead of full snapshots",
+)
+DISPATCH_QUEUE_DEPTH = Gauge(
+    "solver_dispatch_queue_depth",
+    "In-flight kernel dispatches left in the two-slot queue after the "
+    "solve (nonzero = an abandoned speculative prefetch)",
+)
 
 
 class Batcher:
@@ -307,6 +324,17 @@ class Provisioner:
         scheduled = len(pods) - len(results.pod_errors)
         if scheduled:
             PODS_SCHEDULED.inc(value=scheduled)
+        # incremental-encode telemetry (RemoteSolver solves report through
+        # their in-process fallback only; the sidecar's own metrics carry
+        # its warm-path numbers)
+        if getattr(solver, "last_encode_reused", False):
+            ENCODE_REUSED.inc()
+        delta_rows = getattr(solver, "last_delta_rows", 0)
+        if delta_rows:
+            ENCODE_DELTA_ROWS.inc(value=delta_rows)
+        queue = getattr(solver, "_queue", None)
+        if queue is not None:
+            DISPATCH_QUEUE_DEPTH.set(float(queue.depth()))
         return results
 
     def _ready_node_pools(self) -> List[NodePool]:

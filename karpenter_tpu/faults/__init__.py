@@ -46,6 +46,13 @@ PROVIDER_REGISTER = "cloudprovider.register"
 SOLVER_DISPATCH = "solver.dispatch"
 SOLVER_OUTPUT = "solver.output"
 SOLVER_SCENARIOS = "solver.scenarios"
+# incremental-encode seams (ISSUE 8): the delta-encode bookkeeping
+# (hit: every ClusterEncoding reuse/finish; mutate: the gathered delta
+# rows on their way to the device — a corrupt delta must trip the
+# pre-decode invariant guard and fall back to a full re-encode) and the
+# two-slot async dispatch queue (hit: submit and drain)
+ENCODE_DELTA = "solver.encode_delta"
+DISPATCH_QUEUE = "solver.dispatch_queue"
 REMOTE_SOLVE = "remote.solve"
 NATIVE_LOAD = "native.load"
 
@@ -53,6 +60,7 @@ ALL_SITES = (
     STORE_CREATE, STORE_UPDATE, STORE_DELETE,
     PROVIDER_CREATE, PROVIDER_DELETE, PROVIDER_REGISTER,
     SOLVER_DISPATCH, SOLVER_OUTPUT, SOLVER_SCENARIOS,
+    ENCODE_DELTA, DISPATCH_QUEUE,
     REMOTE_SOLVE, NATIVE_LOAD,
 )
 
@@ -223,5 +231,6 @@ __all__ = [
     "STORE_CREATE", "STORE_UPDATE", "STORE_DELETE",
     "PROVIDER_CREATE", "PROVIDER_DELETE", "PROVIDER_REGISTER",
     "SOLVER_DISPATCH", "SOLVER_OUTPUT", "SOLVER_SCENARIOS",
+    "ENCODE_DELTA", "DISPATCH_QUEUE",
     "REMOTE_SOLVE", "NATIVE_LOAD", "ALL_SITES",
 ]

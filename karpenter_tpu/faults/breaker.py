@@ -52,6 +52,12 @@ QUARANTINES = Counter(
     "solver_quarantines_total",
     "Solves discarded by the post-solve invariant guard",
 )
+DELTA_FALLBACKS = Counter(
+    "solver_delta_fallbacks_total",
+    "Guard-rejected incremental solves retried on a full re-encode "
+    "(the degradation ladder's half-step: warm encode state shed, "
+    "no rung tripped)",
+)
 
 
 class CircuitBreaker:
@@ -164,6 +170,7 @@ class SolverHealth:
             clock, self.RUNGS, failure_threshold, cooldown
         )
         self.quarantines = 0
+        self.delta_fallbacks = 0
         self._last_level = 0
         DEGRADATION_RUNG.set(0.0)
 
@@ -182,6 +189,22 @@ class SolverHealth:
 
     def record_kernel(self, ok: bool, reason: str = "") -> None:
         self._record("kernel", ok, reason)
+
+    def delta_fallback(self, reason: str) -> None:
+        """The ladder's half-step (between "incremental kernel" and a
+        quarantine): the invariant guard rejected a solve that ran on a
+        delta-applied / reused encoding, the driver shed the warm state
+        (row banks, prior snapshot, device buffers) and is retrying once
+        on a full re-encode. No breaker trips — if the fresh encoding
+        solves clean the rung keeps its standing; if it trips the guard
+        again, quarantine() follows as usual."""
+        self.delta_fallbacks += 1
+        DELTA_FALLBACKS.inc()
+        obs.event("solver.delta_fallback", reason=reason[:200])
+        self._publish(
+            REASON_SOLVER_DEGRADED,
+            f"incremental encoding shed after guard rejection: {reason}",
+        )
 
     def quarantine(self, rung: str, reason: str) -> None:
         """Integrity violation: trip the rung NOW and drop to the oracle

@@ -66,6 +66,13 @@ class AuditRecord:
     cost: Optional[float] = None
     fault_sites: List[str] = field(default_factory=list)
     oracle_cost: Optional[float] = None
+    # incremental-encode provenance (ISSUE 8): whether the solve reused
+    # the prior cluster encoding verbatim (content-hash fast path) and
+    # how many axis rows rode the device delta instead of a full
+    # transfer. None on records that never touched the encode path
+    # (consolidation decision-level records aggregate their solves).
+    encode_reused: Optional[bool] = None
+    delta_rows: Optional[int] = None
     attrs: Dict[str, object] = field(default_factory=dict)
 
 

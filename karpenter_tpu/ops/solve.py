@@ -302,6 +302,68 @@ solve_all_scenarios_packed = jax.jit(
 )
 
 
+# -- device-resident delta apply --------------------------------------------
+#
+# The incremental-encode layer (solver/encode.py:ClusterEncoding +
+# solver/residency.py) keeps the cluster tensors resident on device between
+# solves; pod/node churn arrives as row-level deltas. The update is a
+# single index-update op. Two twins: the donated variant rewrites the rows
+# in place (no second copy of a 50k-pod encoding on device) but
+# INVALIDATES the old buffer for any later use — an in-flight dispatch-
+# queue token (a speculative prefetch, an overflow resubmit, a concurrent
+# sidecar solve sharing the store) still holding that buffer would
+# dispatch a deleted array. The plain twin allocates the updated buffer
+# fresh (a device-side copy, HBM-bandwidth cheap) and leaves old
+# references valid, so it is the default; KTPU_DONATE_DELTA=1 opts into
+# donation for single-controller deployments where no token can outlive
+# a stage.
+
+
+def _apply_rows_core(arr, idx, rows):
+    return arr.at[idx].set(rows)
+
+
+_apply_rows_donated = jax.jit(_apply_rows_core, donate_argnums=(0,))
+_apply_rows_plain = jax.jit(_apply_rows_core)
+
+
+def delta_apply_rows(arr, idx, rows):
+    """In-place row update on a device-resident buffer: arr[idx] = rows.
+
+    The index length is bucketed to a power of two (padding repeats row 0
+    — rewriting the same value is idempotent, so the update is exact)
+    so churn ticks of nearby delta sizes share one compiled program
+    instead of forking the jit cache per row count. Under
+    KTPU_DONATE_DELTA=1 ``arr`` must not be used after the call — the
+    residency store replaces its reference with the return value, and no
+    queue token may still hold the old buffer (see the module note)."""
+    import os
+    import numpy as _np
+
+    n = len(idx)
+    if not n:
+        return arr
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    if bucket != n:
+        idx = _np.concatenate(
+            [idx, _np.full(bucket - n, idx[0], dtype=idx.dtype)]
+        )
+        rows = _np.concatenate(
+            [rows, _np.repeat(rows[:1], bucket - n, axis=0)]
+        )
+    fn = (
+        _apply_rows_donated
+        if (
+            os.environ.get("KTPU_DONATE_DELTA") == "1"
+            and jax.default_backend() != "cpu"
+        )
+        else _apply_rows_plain
+    )
+    return fn(arr, jnp.asarray(idx, jnp.int32), rows)
+
+
 # -- fault seam -------------------------------------------------------------
 #
 # The jitted kernels stay pure; chaos testing (faults/) hooks the HOST side

@@ -572,6 +572,606 @@ SOLVE_ARG_NAMES = (
 )
 
 
+# -- incremental (delta) encoding -------------------------------------------
+
+
+def _req_content_key(reqs) -> tuple:
+    """Content identity of a Requirements object for cross-solve row
+    reuse: everything vocab.encode reads, order-normalized."""
+    return tuple(
+        sorted(
+            (
+                r.key, r.complement, tuple(sorted(r.values)),
+                r.greater_than, r.less_than,
+            )
+            for r in reqs
+        )
+    )
+
+
+@dataclass
+class EncodeDelta:
+    """What changed between this encode and the previous one, in the
+    shape the device-residency layer (solver/residency.py) consumes.
+
+    ``reused`` means the prior EncodedSnapshot's arrays were returned
+    verbatim (content-hash fast path: nothing relevant changed).
+    ``full`` means no delta information is available (first encode, vocab
+    growth, catalog change, topology in the batch) and every device
+    buffer must be restaged. Otherwise the ``*_rows`` arrays name the
+    ordered axis positions whose rows changed — ``node_rows`` for the
+    pure node-content arrays (n_avail/n_base/n_def/n_mask/n_dzone/n_dct),
+    ``group_rows`` for the g_* group-axis arrays, ``cross_rows`` for the
+    node x group arrays (n_tol/n_hcnt/nh_cnt0). ``v_*`` are monotonic
+    version counters per device-argument class; the residency store
+    reuses a device buffer iff its class version is unchanged."""
+
+    reused: bool = False
+    full: bool = True
+    delta_rows: int = 0
+    node_rows: Optional[np.ndarray] = None
+    group_rows: Optional[np.ndarray] = None
+    count_rows: Optional[np.ndarray] = None
+    cross_rows: Optional[np.ndarray] = None
+    v_static: int = 0
+    v_groups: int = 0
+    v_gcount: int = 0
+    v_nodes: int = 0
+    v_cross: int = 0
+    groups_unchanged: bool = False
+    # group SHAPES (requests/requirements/tolerations/topology-freedom)
+    # unchanged, only per-group counts moved — the steady-state churn
+    # shape: every G-side array except g_count is reusable verbatim
+    groups_shape_unchanged: bool = False
+
+
+class ClusterEncoding:
+    """Persistent incremental encoding of one cluster across solves.
+
+    Owned by EncodeCache (one per control plane / sidecar), consulted by
+    ``encode()`` when passed as ``cluster=``. Three layers, fastest first:
+
+    1. **Content-hash fast path** — a fingerprint over (vocab generation,
+       padded shape, resource axis, per-group content tags, per-node
+       content tags, pool limits / daemon overhead) matches the previous
+       encode's: the prior EncodedSnapshot's arrays are returned verbatim
+       (fresh ``groups``/``existing_names`` metadata so decode binds the
+       NEW pod/node objects), and the delta reports ``reused``.
+    2. **Row banks** — content-keyed caches of the expensive per-row
+       work (vocab.encode masks per requirement content, tolerance rows
+       per taint content, quantized node rows per node content) so churn
+       re-encodes only the changed rows; the assembled arrays are
+       byte-identical to a from-scratch encode because the banks cache
+       exactly what the from-scratch loops compute
+       (tests/test_delta_encode.py pins this over random churn scripts).
+    3. **Full re-encode** — vocab growth (a genuinely new label value),
+       catalog change, or a topology-carrying batch drops the fast paths
+       for that encode; the banks re-warm on the next pass.
+
+    Delta tracking: each encode compares its ordered content-tag lists
+    against the previous encode's and reports the changed axis positions
+    plus per-class version counters (EncodeDelta above) — the device-
+    residency layer transfers only those rows. Banks are periodically
+    compacted: entries unused for ``2 * compact_every`` encodes are
+    evicted every ``compact_every`` encodes, so one-off shapes don't
+    accumulate across days of reconciles.
+
+    Not thread-safe on its own: callers serialize on EncodeCache.lock
+    (the same discipline encode's shared vocab already requires).
+    """
+
+    def __init__(self, compact_every: int = 64):
+        self.compact_every = compact_every
+        self._epoch = None
+        self._tol_epoch = None
+        # content-keyed row banks; values are (last_used_tick, payload)
+        # where the tick is the bank's OWN use clock — advanced only on
+        # encodes that actually consult that bank, so a quiet cluster
+        # (consecutive content-hash reuses, or count-only churn that
+        # skips the group loop) cannot age live entries to eviction
+        self.node_bank: Dict[tuple, tuple] = {}
+        self.group_bank: Dict[tuple, tuple] = {}
+        self.tol_bank: Dict[tuple, np.ndarray] = {}
+        self._encodes = 0
+        self._nuses = 0
+        self._guses = 0
+        # previous encode's state
+        self._prior_snap: Optional[EncodedSnapshot] = None
+        self._prior_gtags: Optional[tuple] = None
+        self._prior_ntags: Optional[tuple] = None
+        self._prior_tkeys: Optional[tuple] = None
+        # per-class device-buffer versions (monotonic)
+        self.v_static = 0
+        self.v_groups = 0
+        self.v_gcount = 0
+        self.v_nodes = 0
+        self.v_cross = 0
+        self.last_delta = EncodeDelta(
+            v_static=0, v_groups=0, v_nodes=0, v_cross=0
+        )
+        # scratch state between begin() and finish()
+        self._gkeys: List[Optional[tuple]] = []
+        self._gtags: Optional[tuple] = None
+        self._ntags: Optional[tuple] = None
+        self._tkeys: Optional[tuple] = None
+        self._banks_on = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def invalidate(self, reason: str = "") -> None:
+        """Drop every bank and the prior snapshot; the next encode is
+        full. Called on catalog changes (EncodeCache.lease reset) and by
+        the driver's corrupt-delta fallback half-step."""
+        self._epoch = None
+        self._tol_epoch = None
+        self.node_bank.clear()
+        self.group_bank.clear()
+        self.tol_bank.clear()
+        self._prior_snap = None
+        self._prior_gtags = None
+        self._prior_ntags = None
+        self._prior_tkeys = None
+        self.v_static += 1
+        self.v_groups += 1
+        self.v_gcount += 1
+        self.v_nodes += 1
+        self.v_cross += 1
+
+    def _vocab_gen(self, vocab: Vocab) -> tuple:
+        # serial pins the instance; the value total pins growth (complement
+        # masks cached at one growth state would be stale after an intern)
+        return (
+            vocab.serial,
+            len(vocab.keys),
+            sum(len(v) for v in vocab.values),
+        )
+
+    def begin(
+        self,
+        vocab: Vocab,
+        K: int,
+        V1: int,
+        resource_names: Sequence[str],
+        groups: Sequence[PodGroup],
+        existing_nodes: Sequence,
+        daemon_overhead,
+        pool_limits,
+        hn_interned: bool,
+    ) -> EncodeDelta:
+        """Compute content tags + decide reuse. Called by encode() after
+        vocab observation; scratch tags feed the bank lookups in the
+        assembly loops and finish()'s delta computation."""
+        self._encodes += 1
+        epoch = (
+            self._vocab_gen(vocab), K, V1, tuple(resource_names),
+            hn_interned,
+            tuple(
+                sorted(
+                    (getattr(nct, "node_pool_name", ""), tuple(sorted(rl.items())))
+                    for nct, rl in (daemon_overhead or {}).items()
+                )
+            ),
+            tuple(
+                sorted(
+                    (pool, tuple(sorted(rl.items())))
+                    for pool, rl in (pool_limits or {}).items()
+                )
+            ),
+        )
+        if epoch != self._epoch:
+            self.node_bank.clear()
+            self.group_bank.clear()
+            self._tol_epoch = None
+            self._prior_snap = None
+            self._prior_gtags = None
+            self._prior_ntags = None
+            self._prior_tkeys = None
+            self._epoch = epoch
+            self.v_static += 1
+        self._banks_on = not hn_interned
+        # per-group content tags; a topology-carrying group gets a fresh
+        # sentinel object so its tag never matches across encodes (the
+        # TopoSpec/shared-carry machinery is deliberately outside the
+        # delta contract — it re-encodes fully, always correctly)
+        gkeys: List[Optional[tuple]] = []
+        gtags = []
+        for g in groups:
+            gk = _req_content_key(g.requirements)
+            gkeys.append(gk)
+            tolk = (
+                tuple(
+                    (t.key, t.operator, t.value, t.effect)
+                    for t in g.pods[0].spec.tolerations
+                )
+                if g.pods[0].spec.tolerations
+                else ()
+            )
+            gtags.append(
+                (
+                    g.count,
+                    frozenset(g.requests.items()),
+                    gk,
+                    tolk,
+                    object() if g.topo is not None else None,
+                )
+            )
+        ntags = []
+        tkeys = []
+        for en in existing_nodes:
+            # the bank-sharing key excludes the hostname VALUE (it encodes
+            # to the overflow slot identically across nodes) — but only
+            # while no hostname value is interned. With one interned (a
+            # pod node-selector naming a node), two nodes differing only
+            # by hostname encode DIFFERENT mask rows, so the identity tag
+            # must carry the full requirement content or a positional
+            # node swap would pass the fast path undetected.
+            ck = tuple(
+                sorted(
+                    (
+                        r.key, r.complement, tuple(sorted(r.values)),
+                        r.greater_than, r.less_than,
+                    )
+                    for r in en.requirements
+                    if hn_interned or r.key != labels_mod.HOSTNAME
+                )
+            ) + (en.requirements.has(labels_mod.HOSTNAME),)
+            ntags.append(
+                (
+                    ck,
+                    tuple(sorted(en.cached_available.items())),
+                    tuple(sorted(en.requests.items())),
+                )
+            )
+            tkeys.append(
+                tuple((t.key, t.value, t.effect) for t in en.cached_taints)
+            )
+        self._gkeys = gkeys
+        self._gtags = tuple(gtags)
+        self._ntags = tuple(ntags)
+        self._tkeys = tuple(tkeys)
+        # tolerance-row bank epoch: rows are [G]-wide and keyed by group
+        # toleration content in order, so any group change re-derives them
+        tol_epoch = (epoch, tuple(t[3] for t in gtags), len(gtags))
+        if tol_epoch != self._tol_epoch:
+            self.tol_bank.clear()
+            self._tol_epoch = tol_epoch
+        groups_unchanged = (
+            self._prior_snap is not None and self._gtags == self._prior_gtags
+        )
+        # count-only churn: same shapes in the same order, different
+        # per-group counts — the common steady-state reconcile shape
+        groups_shape_unchanged = groups_unchanged or (
+            self._prior_snap is not None
+            and tuple(t[1:] for t in self._gtags)
+            == tuple(t[1:] for t in self._prior_gtags)
+        )
+        reused = (
+            groups_unchanged
+            and self._ntags == self._prior_ntags
+            and self._tkeys == self._prior_tkeys
+        )
+        # advance each bank's use clock only when this encode will
+        # consult it (eviction horizons count uses, not encodes)
+        if not reused and self._banks_on:
+            self._nuses += 1
+            if not groups_shape_unchanged:
+                self._guses += 1
+        delta = EncodeDelta(
+            reused=reused,
+            full=not reused,
+            groups_unchanged=groups_unchanged,
+            groups_shape_unchanged=groups_shape_unchanged,
+            v_static=self.v_static,
+            v_groups=self.v_groups,
+            v_gcount=self.v_gcount,
+            v_nodes=self.v_nodes,
+            v_cross=self.v_cross,
+        )
+        self.last_delta = delta
+        return delta
+
+    def reused_snapshot(
+        self, groups, templates, instance_types, existing_nodes
+    ) -> EncodedSnapshot:
+        """The content-hash fast path: prior arrays verbatim, fresh
+        metadata so decode binds this solve's pod/node objects (group i
+        has the same count and content as last time — begin() proved it —
+        so fills map positionally)."""
+        import dataclasses
+
+        from .. import faults
+
+        faults.hit(faults.ENCODE_DELTA, reused=True, rows=0)
+        self._maybe_compact()
+        return dataclasses.replace(
+            self._prior_snap,
+            groups=list(groups),
+            templates=list(templates),
+            instance_types=list(instance_types),
+            existing_names=[en.name for en in existing_nodes],
+        )
+
+    # -- bank accessors (called from encode()'s assembly loops) ----------
+
+    def group_rows(self, i: int, vocab: Vocab, reqs, K: int, V1: int):
+        """(g_def, g_neg, g_mask) rows for group i, bank-cached by
+        requirement content."""
+        if not self._banks_on:
+            return vocab.encode(reqs, K, V1)
+        gk = self._gkeys[i]
+        hit = self.group_bank.get(gk)
+        if hit is not None:
+            self.group_bank[gk] = (self._guses, hit[1])
+            return hit[1]
+        rows = vocab.encode(reqs, K, V1)
+        self.group_bank[gk] = (self._guses, rows)
+        return rows
+
+    def node_mask_rows(self, i: int, compute):
+        """(n_def, n_mask, n_dzone, n_dct) for ordered node i, bank-cached
+        by the node's non-hostname requirement content (the same sharing
+        key the per-call row_cache uses); ``compute`` is the from-scratch
+        fallback. The quantized capacity rows are NOT banked — they are a
+        cheap per-node quantize and their content feeds the node tag, so
+        staleness is impossible either way."""
+        ck = self._ntags[i][0]
+        hit = self.node_bank.get(ck)
+        if hit is not None:
+            self.node_bank[ck] = (self._nuses, hit[1])
+            return hit[1]
+        rows = compute()
+        self.node_bank[ck] = (self._nuses, rows)
+        return rows
+
+    def tol_row(self, i: int, compute) -> np.ndarray:
+        """The [G] tolerance row for ordered node i, keyed by taint
+        content under the current group-toleration epoch."""
+        tkey = self._tkeys[i]
+        row = self.tol_bank.get(tkey)
+        if row is None:
+            row = compute()
+            self.tol_bank[tkey] = row
+        return row
+
+    # -- delta bookkeeping ------------------------------------------------
+
+    @staticmethod
+    def _diff_positions(prev: Optional[tuple], cur: tuple) -> Optional[np.ndarray]:
+        if prev is None:
+            return None
+        m = min(len(prev), len(cur))
+        changed = [i for i in range(m) if prev[i] != cur[i]]
+        changed.extend(range(m, max(len(prev), len(cur))))
+        return np.asarray(changed, dtype=np.int32)
+
+    def finish(self, snap: EncodedSnapshot) -> EncodeDelta:
+        """Record this encode's snapshot as the new prior and derive the
+        delta report (changed axis positions + class versions)."""
+        from .. import faults
+
+        delta = self.last_delta
+        node_rows = self._diff_positions(self._prior_ntags, self._ntags)
+        group_rows = (
+            self._diff_positions(self._prior_gtags, self._gtags)
+            if not any(t[4] is not None for t in self._gtags)
+            else None
+        )
+        count_rows = (
+            self._diff_positions(
+                tuple(t[0] for t in self._prior_gtags),
+                tuple(t[0] for t in self._gtags),
+            )
+            if delta.groups_shape_unchanged and self._prior_gtags is not None
+            else None
+        )
+        tol_rows = self._diff_positions(self._prior_tkeys, self._tkeys)
+        if self._gtags != self._prior_gtags:
+            self.v_gcount += 1
+            if not delta.groups_shape_unchanged:
+                # shapes moved too: every G-side array restages
+                self.v_groups += 1
+        if self._ntags != self._prior_ntags:
+            self.v_nodes += 1
+        prior_tolsig = (
+            tuple(t[3] for t in self._prior_gtags)
+            if self._prior_gtags is not None
+            else None
+        )
+        tolsig = tuple(t[3] for t in self._gtags)
+        # topology batches: n_hcnt/nh_cnt0 derive from TopoSpec priors
+        # (host_counts, shared-constraint counts) that the content tags
+        # deliberately don't model — the cross arrays must restage whole
+        # on EVERY such encode, never ride a version match or a row delta
+        has_topo = any(t[4] is not None for t in self._gtags) or (
+            self._prior_gtags is not None
+            and any(t[4] is not None for t in self._prior_gtags)
+        )
+        cross_changed = (
+            has_topo
+            or self._tkeys != self._prior_tkeys
+            or self._ntags != self._prior_ntags
+            or tolsig != prior_tolsig
+            or (
+                self._prior_gtags is not None
+                and len(self._gtags) != len(self._prior_gtags)
+            )
+        )
+        if cross_changed or self._prior_gtags is None:
+            self.v_cross += 1
+        # cross-row delta only when the group axis kept its shape and
+        # toleration signature (and no topology priors are in play): then
+        # a node x group row changes only via its node's taints or
+        # node-content position
+        cross_rows = None
+        if (
+            not has_topo
+            and tolsig == prior_tolsig
+            and node_rows is not None
+            and tol_rows is not None
+        ):
+            cross_rows = np.union1d(node_rows, tol_rows).astype(np.int32)
+        had_prior = self._prior_snap is not None
+        delta.full = not had_prior
+        delta.node_rows = node_rows if had_prior else None
+        delta.group_rows = group_rows if had_prior else None
+        delta.count_rows = count_rows if had_prior else None
+        delta.cross_rows = cross_rows if had_prior else None
+        delta.delta_rows = int(
+            (len(node_rows) if delta.node_rows is not None else 0)
+            + (
+                len(count_rows)
+                if delta.count_rows is not None
+                else (len(group_rows) if delta.group_rows is not None else 0)
+            )
+            + (len(cross_rows) if delta.cross_rows is not None else 0)
+        )
+        delta.v_static = self.v_static
+        delta.v_groups = self.v_groups
+        delta.v_gcount = self.v_gcount
+        delta.v_nodes = self.v_nodes
+        delta.v_cross = self.v_cross
+        self._prior_snap = snap
+        self._prior_gtags = self._gtags
+        self._prior_ntags = self._ntags
+        self._prior_tkeys = self._tkeys
+        faults.hit(
+            faults.ENCODE_DELTA, reused=False, rows=delta.delta_rows
+        )
+        self._maybe_compact()
+        return delta
+
+    def _maybe_compact(self) -> None:
+        """Periodic compaction: drop bank entries unused for two
+        compaction windows of that bank's OWN use clock, so churn's
+        one-off shapes don't accumulate — and a quiet cluster (whose
+        encodes never consult a bank) can't age live entries out."""
+        for bank, uses in (
+            (self.node_bank, self._nuses),
+            (self.group_bank, self._guses),
+        ):
+            if not uses or uses % self.compact_every:
+                continue
+            horizon = uses - 2 * self.compact_every
+            stale = [k for k, (used, _) in bank.items() if used < horizon]
+            for k in stale:
+                del bank[k]
+
+
+def _encode_groups(
+    groups: List[PodGroup],
+    vocab: Vocab,
+    cluster: Optional[ClusterEncoding],
+    resource_names: Sequence[str],
+    K: int,
+    V1: int,
+    R: int,
+    G: int,
+):
+    """The G-side arrays of one encode (split out of encode() so the
+    delta path can skip it whole when the group tags are unchanged).
+    ``cluster`` provides the cross-solve requirement-mask bank."""
+    g_count = np.array([g.count for g in groups], dtype=np.int32)
+    g_req = np.stack(
+        [quantize_requests(g.requests, resource_names) for g in groups]
+    ) if G else np.zeros((0, R), np.float32)
+    g_def = np.zeros((G, K), bool)
+    g_neg = np.zeros((G, K), bool)
+    g_mask = np.ones((G, K, V1), bool)
+    g_hcap = np.full((G,), HCAP_NONE, np.int32)
+    g_haff = np.zeros((G,), bool)
+    g_dmode = np.zeros((G,), np.int32)
+    g_dkey = np.zeros((G,), np.int32)
+    g_dskew = np.zeros((G,), np.int32)
+    g_dmin0 = np.zeros((G,), bool)
+    g_dprior = np.zeros((G, V1), np.int32)
+    g_dreg = np.zeros((G, V1), bool)
+    g_drank = np.full((G, V1), _DRANK_NONE, np.int32)
+    # shared-constraint carry slots, assigned by descriptor identity
+    g_hstg = np.full((G,), -1, np.int32)
+    g_hscap = np.full((G,), HCAP_NONE, np.int32)
+    g_dtg = np.full((G,), -1, np.int32)
+    g_hself = np.ones((G,), bool)
+    shared_h_descs: List[SharedHostTG] = []
+    shared_d_descs: List[SharedDomainTG] = []
+    _h_slots: Dict[int, int] = {}
+    _d_slots: Dict[int, int] = {}
+
+    def _h_slot(desc: SharedHostTG) -> int:
+        j = _h_slots.setdefault(id(desc), len(_h_slots))
+        if j == len(shared_h_descs):
+            shared_h_descs.append(desc)
+        return j
+
+    def _d_slot(desc: SharedDomainTG) -> int:
+        j = _d_slots.setdefault(id(desc), len(_d_slots))
+        if j == len(shared_d_descs):
+            shared_d_descs.append(desc)
+        return j
+
+    for i, g in enumerate(groups):
+        t = g.topo
+        if t is None:
+            continue
+        if t.shared_h is not None:
+            g_hstg[i] = _h_slot(t.shared_h)
+            g_hscap[i] = t.h_capval if t.h_capval is not None else t.shared_h.cap
+            g_hself[i] = t.h_self
+        if t.shared_d is not None:
+            g_dtg[i] = _d_slot(t.shared_d)
+        for desc in t.contrib_h:
+            _h_slot(desc)
+        for desc in t.contrib_d:
+            _d_slot(desc)
+    JH = max(len(shared_h_descs), 1)
+    JD = max(len(_d_slots), 1)
+    dd0 = np.zeros((JD, V1), np.int32)
+    dtg_key = np.zeros((JD,), np.int32)
+    for j, desc in enumerate(shared_d_descs):
+        dtg_key[j] = 0 if desc.key == labels_mod.TOPOLOGY_ZONE else 1
+    # contribution rows: slots this group's placements count toward (the
+    # oracle's record() rule, scheduling/topology.py:491-498)
+    g_hcontrib = np.zeros((G, JH), bool)
+    g_dcontrib = np.zeros((G, JD), bool)
+    for i, g in enumerate(groups):
+        t = g.topo
+        if t is None:
+            continue
+        for desc in t.contrib_h:
+            g_hcontrib[i, _h_slots[id(desc)]] = True
+        for desc in t.contrib_d:
+            g_dcontrib[i, _d_slots[id(desc)]] = True
+    for i, g in enumerate(groups):
+        if cluster is not None:
+            g_def[i], g_neg[i], g_mask[i] = cluster.group_rows(
+                i, vocab, g.requirements, K, V1
+            )
+        else:
+            g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
+        if g.topo is not None:
+            if g.topo.host_cap is not None:
+                g_hcap[i] = g.topo.host_cap
+            g_haff[i] = g.topo.haff
+            if g.topo.dmode != DMODE_NONE:
+                t = g.topo
+                g_dmode[i] = t.dmode
+                g_dkey[i] = 0 if t.dkey == labels_mod.TOPOLOGY_ZONE else 1
+                g_dskew[i] = min(t.dskew, HCAP_NONE)
+                g_dmin0[i] = t.dmin0
+                # rank = sorted-domain order, the oracle's tie-break and
+                # bootstrap preference (topologygroup.go:291-324)
+                for rank, d in enumerate(sorted(t.dreg)):
+                    vid = vocab.value_id(t.dkey, d)
+                    g_dreg[i, vid] = True
+                    g_drank[i, vid] = rank
+                    g_dprior[i, vid] = t.dprior.get(d, 0)
+    return (
+        g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
+        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
+        g_hstg, g_hscap, g_dtg, g_hself, g_hcontrib, g_dcontrib,
+        dd0, dtg_key, shared_h_descs, JH,
+    )
+
+
 def encode(
     groups: List[PodGroup],
     templates: List[NodeClaimTemplate],
@@ -581,6 +1181,7 @@ def encode(
     pool_limits: Optional[Dict[str, res.ResourceList]] = None,
     vocab: Optional[Vocab] = None,
     cache: Optional[dict] = None,
+    cluster: Optional[ClusterEncoding] = None,
 ) -> EncodedSnapshot:
     """Encode a snapshot. ``vocab``/``cache`` (both owned by one TpuSolver)
     let repeat solves skip the instance-type/template side: those arrays
@@ -658,96 +1259,59 @@ def encode(
     R = len(resource_names)
     G, T, P, N = len(groups), len(instance_types), len(templates), len(existing_nodes)
 
+    # content-shared node rows (see the existing-nodes section below) are
+    # keyed on non-hostname label shapes; an interned hostname value (a pod
+    # node-selector naming a node) disables sharing for this encode
+    hn_kid = vocab.key_ids.get(labels_mod.HOSTNAME)
+    hn_interned = bool(vocab.values[hn_kid]) if hn_kid is not None else False
+
+    delta = None
+    if cluster is not None:
+        delta = cluster.begin(
+            vocab, K, V1, resource_names, groups, existing_nodes,
+            daemon_overhead, pool_limits, hn_interned,
+        )
+        if delta.reused:
+            # content-hash fast path: nothing row-relevant changed since
+            # the previous encode — prior arrays verbatim, fresh metadata
+            return cluster.reused_snapshot(
+                groups, templates, instance_types, existing_nodes
+            )
+
     # -- groups -----------------------------------------------------------
-    g_count = np.array([g.count for g in groups], dtype=np.int32)
-    g_req = np.stack(
-        [quantize_requests(g.requests, resource_names) for g in groups]
-    ) if G else np.zeros((0, R), np.float32)
-    g_def = np.zeros((G, K), bool)
-    g_neg = np.zeros((G, K), bool)
-    g_mask = np.ones((G, K, V1), bool)
-    g_hcap = np.full((G,), HCAP_NONE, np.int32)
-    g_haff = np.zeros((G,), bool)
-    g_dmode = np.zeros((G,), np.int32)
-    g_dkey = np.zeros((G,), np.int32)
-    g_dskew = np.zeros((G,), np.int32)
-    g_dmin0 = np.zeros((G,), bool)
-    g_dprior = np.zeros((G, V1), np.int32)
-    g_dreg = np.zeros((G, V1), bool)
-    g_drank = np.full((G, V1), _DRANK_NONE, np.int32)
-    # shared-constraint carry slots, assigned by descriptor identity
-    g_hstg = np.full((G,), -1, np.int32)
-    g_hscap = np.full((G,), HCAP_NONE, np.int32)
-    g_dtg = np.full((G,), -1, np.int32)
-    g_hself = np.ones((G,), bool)
-    shared_h_descs: List[SharedHostTG] = []
-    shared_d_descs: List[SharedDomainTG] = []
-    _h_slots: Dict[int, int] = {}
-    _d_slots: Dict[int, int] = {}
-
-    def _h_slot(desc: SharedHostTG) -> int:
-        j = _h_slots.setdefault(id(desc), len(_h_slots))
-        if j == len(shared_h_descs):
-            shared_h_descs.append(desc)
-        return j
-
-    def _d_slot(desc: SharedDomainTG) -> int:
-        j = _d_slots.setdefault(id(desc), len(_d_slots))
-        if j == len(shared_d_descs):
-            shared_d_descs.append(desc)
-        return j
-
-    for i, g in enumerate(groups):
-        t = g.topo
-        if t is None:
-            continue
-        if t.shared_h is not None:
-            g_hstg[i] = _h_slot(t.shared_h)
-            g_hscap[i] = t.h_capval if t.h_capval is not None else t.shared_h.cap
-            g_hself[i] = t.h_self
-        if t.shared_d is not None:
-            g_dtg[i] = _d_slot(t.shared_d)
-        for desc in t.contrib_h:
-            _h_slot(desc)
-        for desc in t.contrib_d:
-            _d_slot(desc)
-    JH = max(len(shared_h_descs), 1)
-    JD = max(len(_d_slots), 1)
-    dd0 = np.zeros((JD, V1), np.int32)
-    dtg_key = np.zeros((JD,), np.int32)
-    for j, desc in enumerate(shared_d_descs):
-        dtg_key[j] = 0 if desc.key == labels_mod.TOPOLOGY_ZONE else 1
-    # contribution rows: slots this group's placements count toward (the
-    # oracle's record() rule, scheduling/topology.py:491-498)
-    g_hcontrib = np.zeros((G, JH), bool)
-    g_dcontrib = np.zeros((G, JD), bool)
-    for i, g in enumerate(groups):
-        t = g.topo
-        if t is None:
-            continue
-        for desc in t.contrib_h:
-            g_hcontrib[i, _h_slots[id(desc)]] = True
-        for desc in t.contrib_d:
-            g_dcontrib[i, _d_slots[id(desc)]] = True
-    for i, g in enumerate(groups):
-        g_def[i], g_neg[i], g_mask[i] = vocab.encode(g.requirements, K, V1)
-        if g.topo is not None:
-            if g.topo.host_cap is not None:
-                g_hcap[i] = g.topo.host_cap
-            g_haff[i] = g.topo.haff
-            if g.topo.dmode != DMODE_NONE:
-                t = g.topo
-                g_dmode[i] = t.dmode
-                g_dkey[i] = 0 if t.dkey == labels_mod.TOPOLOGY_ZONE else 1
-                g_dskew[i] = min(t.dskew, HCAP_NONE)
-                g_dmin0[i] = t.dmin0
-                # rank = sorted-domain order, the oracle's tie-break and
-                # bootstrap preference (topologygroup.go:291-324)
-                for rank, d in enumerate(sorted(t.dreg)):
-                    vid = vocab.value_id(t.dkey, d)
-                    g_dreg[i, vid] = True
-                    g_drank[i, vid] = rank
-                    g_dprior[i, vid] = t.dprior.get(d, 0)
+    p_tol_reuse = None
+    if delta is not None and delta.groups_shape_unchanged:
+        # every group SHAPE tag (requests, requirement content,
+        # tolerations, no-topology) matched the prior encode: the G-side
+        # arrays are byte-identical by construction, so share them;
+        # count-only churn (the steady-state reconcile shape) rebuilds
+        # just the [G] count vector
+        ps = cluster._prior_snap
+        g_count = (
+            ps.g_count
+            if delta.groups_unchanged
+            else np.array([g.count for g in groups], dtype=np.int32)
+        )
+        g_req = ps.g_req
+        g_def, g_neg, g_mask = ps.g_def, ps.g_neg, ps.g_mask
+        g_hcap, g_haff = ps.g_hcap, ps.g_haff
+        g_dmode, g_dkey, g_dskew = ps.g_dmode, ps.g_dkey, ps.g_dskew
+        g_dmin0, g_dprior, g_dreg = ps.g_dmin0, ps.g_dprior, ps.g_dreg
+        g_drank = ps.g_drank
+        g_hstg, g_hscap, g_dtg = ps.g_hstg, ps.g_hscap, ps.g_dtg
+        g_hself = ps.g_hself
+        g_hcontrib, g_dcontrib = ps.g_hcontrib, ps.g_dcontrib
+        dd0, dtg_key = ps.dd0, ps.dtg_key
+        shared_h_descs = []
+        JH = g_hcontrib.shape[1]
+        p_tol_reuse = ps.p_tol
+    else:
+        g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff, \
+            g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank, \
+            g_hstg, g_hscap, g_dtg, g_hself, g_hcontrib, g_dcontrib, \
+            dd0, dtg_key, shared_h_descs, JH = _encode_groups(
+                groups, vocab, cluster, resource_names, K, V1, R, G
+            )
 
     # -- instance types + templates (static side, cached per padding) -----
     static_key = (K, V1, tuple(resource_names))
@@ -818,12 +1382,16 @@ def encode(
      p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_titype_ok) = static
 
     # -- template/group tolerance (depends on this solve's groups) --------
-    p_tol = np.zeros((P, max(G, 1)), bool)
-    for i, nct in enumerate(templates):
-        for gi, g in enumerate(groups):
-            p_tol[i, gi] = (
-                taints_mod.tolerates(nct.taints, g.pods[0].spec.tolerations) is None
-            )
+    if p_tol_reuse is not None:
+        p_tol = p_tol_reuse
+    else:
+        p_tol = np.zeros((P, max(G, 1)), bool)
+        for i, nct in enumerate(templates):
+            for gi, g in enumerate(groups):
+                p_tol[i, gi] = (
+                    taints_mod.tolerates(nct.taints, g.pods[0].spec.tolerations)
+                    is None
+                )
 
     # -- existing nodes ---------------------------------------------------
     n_avail = np.zeros((N, R), np.float32)
@@ -843,11 +1411,10 @@ def encode(
     # hostname values are provider-side and encode to the OVERFLOW slot,
     # identical across nodes — UNLESS some hostname value has been interned
     # (a pod node-selector naming a node), which disables sharing for this
-    # encode. Caches are per-call: the vocab is stable here (all
-    # observation happened above), and cross-call reuse is the _enc_rows
-    # stash's job.
-    hn_kid = vocab.key_ids.get(labels_mod.HOSTNAME)
-    hn_interned = bool(vocab.values[hn_kid]) if hn_kid is not None else False
+    # encode (hn_interned was derived above, before the delta fast path).
+    # Caches are per-call: the vocab is stable here (all observation
+    # happened above); cross-call reuse is the _enc_rows stash's job —
+    # or the ClusterEncoding banks' when a ``cluster`` is leased.
     row_cache: Dict[tuple, tuple] = {}
     tol_cache: Dict[tuple, np.ndarray] = {}
     # groups with hostname-topology priors, walked per node; everything
@@ -870,16 +1437,38 @@ def encode(
         # snapshot), node label requirements are positive-only (rows are
         # stable under vocab growth at fixed K/V1), and the tag pins the
         # vocab instance, array shapes, and the daemon remainder.
-        sn = getattr(en, "state_node", None)
-        tag = (
-            vocab.serial, K, V1, tuple(resource_names),
-            tuple(sorted(en.requests.items())),
-        )
-        cached = getattr(sn, "_enc_rows", None) if sn is not None else None
+        if cluster is not None and cluster._banks_on:
+            # delta path: quantized rows are recomputed (cheap, and their
+            # content is part of the node tag), the mask rows ride the
+            # cross-solve content bank
+            n_avail[i] = quantize_capacity(en.cached_available, resource_names)
+            n_base[i] = quantize_requests(en.requests, resource_names)
+
+            def _mask_rows(en=en):
+                ndef, _, nmask = vocab.encode(en.requirements, K, V1)
+                return (
+                    ndef, nmask,
+                    _node_domain_id(vocab, en, labels_mod.TOPOLOGY_ZONE),
+                    _node_domain_id(
+                        vocab, en, labels_mod.CAPACITY_TYPE_LABEL_KEY
+                    ),
+                )
+
+            (n_def[i], n_mask[i], n_dzone[i],
+             n_dct[i]) = cluster.node_mask_rows(i, _mask_rows)
+            sn = None
+            cached = tag = None
+        else:
+            sn = getattr(en, "state_node", None)
+            tag = (
+                vocab.serial, K, V1, tuple(resource_names),
+                tuple(sorted(en.requests.items())),
+            )
+            cached = getattr(sn, "_enc_rows", None) if sn is not None else None
         if cached is not None and cached[0] == tag:
             (n_avail[i], n_base[i], n_def[i], n_mask[i], n_dzone[i],
              n_dct[i]) = cached[1]
-        else:
+        elif tag is not None:
             n_avail[i] = quantize_capacity(en.cached_available, resource_names)
             n_base[i] = quantize_requests(en.requests, resource_names)
             ck = None
@@ -924,12 +1513,9 @@ def encode(
             for j, desc in enumerate(shared_h_descs):
                 nh_cnt0[i, j] = desc.counts.get(hostname, 0)
         if G:
-            tkey = tuple(
-                (t.key, t.value, t.effect) for t in en.cached_taints
-            )
-            trow = tol_cache.get(tkey)
-            if trow is None:
-                trow = np.fromiter(
+
+            def _trow(en=en):
+                return np.fromiter(
                     (
                         taints_mod.tolerates(
                             en.cached_taints, g.pods[0].spec.tolerations
@@ -940,8 +1526,21 @@ def encode(
                     bool,
                     G,
                 )
-                tol_cache[tkey] = trow
-            n_tol[i, :G] = trow
+
+            if cluster is not None:
+                # cross-solve tolerance bank, keyed by taint content under
+                # the current group-toleration epoch (begin() cleared it
+                # if the group axis changed)
+                n_tol[i, :G] = cluster.tol_row(i, _trow)
+            else:
+                tkey = tuple(
+                    (t.key, t.value, t.effect) for t in en.cached_taints
+                )
+                trow = tol_cache.get(tkey)
+                if trow is None:
+                    trow = _trow()
+                    tol_cache[tkey] = trow
+                n_tol[i, :G] = trow
         for gi in topo_gis:
             g = groups[gi]
             # hostname domains are the node's hostname label (node name
@@ -959,7 +1558,7 @@ def encode(
                 else g.topo.host_counts.get(domain, 0)
             )
 
-    return EncodedSnapshot(
+    snap = EncodedSnapshot(
         vocab=vocab,
         resource_names=resource_names,
         groups=groups,
@@ -1018,6 +1617,9 @@ def encode(
         ct_kid=ct_kid,
         well_known=vocab.well_known_mask(K),
     )
+    if cluster is not None:
+        cluster.finish(snap)
+    return snap
 
 
 def class_partition(snap: "EncodedSnapshot", min_mean_size: float = 0.0):

@@ -1,0 +1,44 @@
+"""Seeded "host crossing between solves" violations (ISSUE 8).
+
+A delta-encode store holds device buffers across solves under the
+resident-attribute naming convention (``dev_*`` / ``_dev*``,
+solver/residency.py). Laundering one of those buffers through host numpy
+— or reading it back outside the sanctioned drain — is exactly the
+crossing the device-residency contract forbids BETWEEN solves, and the
+poison-to-unknown discipline used to hide it (the carrying ``self`` is
+untracked). The resident-origin rule makes every sink below reachable.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class ResidentStore:
+    def __init__(self):
+        self._dev_rows = None
+        self.dev_avail = None
+
+    def stage(self, host):
+        self._dev_rows = jax.device_put(host)
+        self.dev_avail = jnp.zeros((4,))
+
+    def laundered_delta(self, idx):
+        # DTX903: np.asarray on a resident buffer between solves — an
+        # implicit device_get smuggled through the delta path
+        rows = np.asarray(self._dev_rows)
+        return rows[idx]
+
+    def peek(self):
+        if self.dev_avail[0] > 0:  # DTX901: truthiness on resident buffer
+            return True
+        return False
+
+    def drain_all(self):
+        # DTX906: readback of a resident buffer outside the sanctioned
+        # drain point (no sanction annotation)
+        return jax.device_get(self._dev_rows)
+
+    def walk(self):
+        return list(self.dev_avail)  # DTX904: host iteration per element

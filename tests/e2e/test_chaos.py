@@ -49,6 +49,16 @@ def _operator_kinds(ctx):
     return ctx.get("kind") in ("NodeClaim", "Node")
 
 
+def _inflate_delta_rows(vals):
+    """Corrupt a delta on the wire: the device copy of the rows claims
+    absurd capacity. The pre-decode guard (checking against the HOST
+    snapshot) must reject the solve; the driver sheds the warm encoding
+    and retries full (faults/breaker.py:SolverHealth.delta_fallback)."""
+    import numpy as np
+
+    return np.full_like(vals, 10_000_000)
+
+
 def chaos_rules(until):
     return [
         faults.FaultRule(
@@ -75,6 +85,17 @@ def chaos_rules(until):
         ),
         faults.FaultRule(
             faults.SOLVER_DISPATCH, probability=0.15, until=until,
+        ),
+        # incremental-solving seams (ISSUE 8): crash the dispatch-queue
+        # edges and corrupt delta rows in flight — the degradation ladder
+        # and the guard's full-re-encode half-step must absorb both
+        faults.FaultRule(
+            faults.DISPATCH_QUEUE, probability=0.1, until=until,
+        ),
+        faults.FaultRule(
+            faults.ENCODE_DELTA, probability=0.25, until=until,
+            mutate=_inflate_delta_rows,
+            match=lambda ctx: ctx.get("name") == "n_avail",
         ),
     ]
 
@@ -209,6 +230,76 @@ class TestChaosSmoke:
         dep.scale(dep.replicas + 1)  # force one fresh solve
         s.run_until(dep.all_bound, 60, "post-quarantine re-probe solve")
         assert health.ladder.breakers["kernel"].state == "closed"
+
+
+class TestChaosIncrementalEncode:
+    def test_corrupt_delta_never_commits_stale_snapshot(self):
+        """ISSUE 8: every delta apply of the soak window is corrupted
+        (inflated node capacity on the device copy). The pre-decode
+        invariant guard must reject each such solve and the driver must
+        answer with the full-re-encode half-step — so the cluster
+        converges with zero overcommit (asserted every tick by
+        run_chaos) and the ladder records fallbacks, not quarantines
+        from committed garbage."""
+
+        s = Scenario()
+        s.client.create(make_nodepool())
+        dep = s.deployment(
+            "churn", 10, lambda: make_pod(cpu="1", memory="2Gi")
+        )
+        until = s.clock.now() + 40
+        injector = faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        faults.ENCODE_DELTA, until=until,
+                        mutate=_inflate_delta_rows,
+                        match=lambda ctx: ctx.get("name") == "n_avail",
+                    )
+                ],
+                seed=7, clock=s.clock,
+            )
+        )
+        # steady scale-up keeps the provisioner solving against a growing
+        # node set — exactly the steady-state-churn shape whose encode
+        # arrives as row deltas
+        for t in range(30):
+            if t % 3 == 2:
+                dep.scale(dep.replicas + 4)
+            s.tick()
+            _assert_no_overcommit(s)
+        injector.clear()
+        health = s.operator.solver_health
+        assert injector.fired(faults.ENCODE_DELTA) >= 1
+        # every corrupted delta was answered pre-commit: fallbacks (the
+        # half-step) or, if a retry tripped too, a quarantine — never a
+        # committed stale snapshot (the per-tick overcommit assert above)
+        assert health.delta_fallbacks >= 1
+
+        def converged():
+            _assert_no_overcommit(s)
+            return dep.all_bound() and s.monitor.pending_pod_count() == 0
+
+        s.run_until(converged, 400, "post-corrupt-delta convergence")
+        assert dep.bound_count() == dep.replicas
+
+    def test_queue_crash_degrades_and_recovers(self):
+        """DISPATCH_QUEUE faults at both edges: solves degrade through
+        the ladder (oracle stays exact) and the roster converges once
+        the plan clears."""
+
+        def rules(until):
+            return [
+                faults.FaultRule(
+                    faults.DISPATCH_QUEUE, probability=0.5, until=until,
+                )
+            ]
+
+        s, dep, injector = run_chaos(
+            seed=13, replicas=25, fault_ticks=12, rules=rules,
+        )
+        assert dep.bound_count() == dep.replicas
+        assert injector.fired(faults.DISPATCH_QUEUE) >= 1
 
 
 @pytest.mark.slow

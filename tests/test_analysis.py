@@ -694,6 +694,7 @@ class TestDevicePass:
     REAL_TARGETS = [
         os.path.join(REPO, "karpenter_tpu", "ops"),
         os.path.join(REPO, "karpenter_tpu", "solver", "driver.py"),
+        os.path.join(REPO, "karpenter_tpu", "solver", "residency.py"),
         os.path.join(REPO, "karpenter_tpu", "faults", "guard.py"),
     ]
 
@@ -762,17 +763,45 @@ class TestDevicePass:
         assert kept == [], [f.render() for f in kept]
         assert len(sanctioned) == 1
 
-    def test_real_solve_path_clean_with_three_blessed_readbacks(self):
+    def test_real_solve_path_clean_with_two_blessed_readbacks(self):
         """The device-residency contract (PARITY.md): the ONLY
-        device->host crossings in the solve path are driver.py's three
-        sanctioned decode readbacks — the set the delta-encode PR must
-        not widen."""
+        device->host crossings in the solve path are driver.py's two
+        sanctioned readbacks — the dispatch queue's single drain point
+        (plain, classed, AND scenario kernels all cross there) plus the
+        sharded-mesh path. The delta-encode PR collapsed the former
+        three per-path readbacks into the drain, exactly the end state
+        the round-7 contract table predicted; any further change goes
+        through the documented contract-table workflow."""
         findings, sources = device.check_paths(self.REAL_TARGETS)
         kept, suppressed, sanctioned = partition_findings(findings, sources)
         assert kept == [], [f.render() for f in kept]
-        assert len(sanctioned) == 3
+        assert len(sanctioned) == 2
         assert all(f.rule == "DTX906" for f in sanctioned)
         assert all(f.path.endswith("driver.py") for f in sanctioned)
+
+    def test_resident_attr_bad_fixture_flags_between_solve_crossings(self):
+        """The "no host crossing between solves" extension: dev_*/_dev*
+        attribute loads are DEVICE-born, so a delta path laundering a
+        resident buffer through np.asarray (or truthiness, iteration, an
+        unsanctioned device_get) flags even though the carrying object
+        is untracked."""
+        findings, _ = device.check_paths(
+            [fixture("bad_device_resident.py")]
+        )
+        assert rules_of(findings) == {
+            "DTX901", "DTX903", "DTX904", "DTX906",
+        }
+        # the laundering shape from the contract: np.asarray on a
+        # resident buffer between solves
+        assert any(f.rule == "DTX903" for f in findings)
+
+    def test_resident_attr_good_fixture_clean_with_sanctioned_drain(self):
+        findings, sources = device.check_paths(
+            [fixture("good_device_resident.py")]
+        )
+        kept, _, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert [f.rule for f in sanctioned] == ["DTX906"]
 
     def test_unparsable_file_reported(self, tmp_path):
         (tmp_path / "broken.py").write_text("def oops(:\n")

@@ -134,6 +134,34 @@ class TestCompareGrids:
         ]))
         assert compare_grids(old, new_bad) == 1
 
+    def test_churn_rows_enforced(self, tmp_path):
+        # ISSUE 8's steady-state churn rows (warm reconcile under 1%/10%
+        # pod churn) are first-class compare rows: a warm-path regression
+        # (encode/transfer creeping back into best_ms) trips the gate
+        def churn_entry(pct, best_ms, encode_ms, transfer_ms):
+            return {
+                "config": f"churn-{pct}pct", "pods": 5000, "types": 400,
+                "best_ms": best_ms, "pods_per_sec": 5000 / best_ms * 1000,
+                "encode_ms": encode_ms, "transfer_ms": transfer_ms,
+                "delta_rows": 25, "cold_encode_ms": 14.0,
+                "cold_transfer_ms": 5.0,
+            }
+
+        old = _write(tmp_path, "old.json", _grid("cpu", [
+            churn_entry(1, 120.0, 2.0, 1.0),
+            churn_entry(10, 130.0, 4.0, 1.0),
+        ]))
+        new_ok = _write(tmp_path, "new_ok.json", _grid("cpu", [
+            churn_entry(1, 125.0, 2.1, 1.1),
+            churn_entry(10, 128.0, 4.2, 0.9),
+        ]))
+        assert compare_grids(old, new_ok) == 0
+        new_bad = _write(tmp_path, "new_bad.json", _grid("cpu", [
+            churn_entry(1, 190.0, 40.0, 22.0),  # warm path gone cold
+            churn_entry(10, 130.0, 4.0, 1.0),
+        ]))
+        assert compare_grids(old, new_bad) == 1
+
     def test_cli_entrypoint(self, tmp_path):
         old = _write(tmp_path, "old.json", _grid("tpu", [
             _entry("mixed", 5000, 400, 100.0),

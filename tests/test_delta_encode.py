@@ -1,0 +1,589 @@
+"""Incremental always-warm solving (ISSUE 8): the delta-encode
+equivalence suite.
+
+The contract under test: after ANY churn sequence — pod births, pod
+deletes, label flips, node adds/removes, node capacity changes,
+daemonset-overhead changes, pool-limit edits — the warm path
+(ClusterEncoding banks + prior-snapshot fast path + device-resident
+delta staging) produces an encoding BYTE-IDENTICAL to a from-scratch
+``encode()`` of the same cluster, and decisions identical to a cold
+solver's. A corrupt delta must trip the pre-decode invariant guard and
+fall back to a full re-encode (the degradation ladder's half-step) —
+never commit a stale snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_platforms", "cpu")
+
+from karpenter_tpu import faults, obs
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import encode as enc
+from karpenter_tpu.solver.driver import EncodeCache, SolverConfig, TpuSolver
+
+from helpers import make_nodepool, make_pod, make_state_node
+
+_ITS = corpus.generate(16)
+
+
+# -- churn harness -----------------------------------------------------------
+
+
+from karpenter_tpu.api import labels as labels_mod
+
+_POD_SHAPES = [
+    dict(cpu="1", memory="2Gi"),
+    dict(cpu="2", memory="4Gi"),
+    dict(cpu="500m", memory="1Gi", labels={"tier": "web"}),
+    dict(
+        cpu="1500m", memory="3Gi",
+        node_selector={labels_mod.TOPOLOGY_ZONE: "test-zone-a"},
+    ),
+]
+_NODE_SHAPES = [
+    dict(cpu="16", memory="64Gi", zone="test-zone-a"),
+    dict(cpu="8", memory="32Gi", zone="test-zone-b"),
+    dict(cpu="32", memory="128Gi", zone="test-zone-a"),
+]
+_ZONES = ["test-zone-a", "test-zone-b"]
+
+
+class ChurnCluster:
+    """Mutable cluster description; each tick materializes fresh objects
+    (pods are shared — uids must match across solvers; state nodes are
+    per-solver fresh copies, like production's deep-copied snapshots)."""
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+        self.pods = [make_pod(**_POD_SHAPES[i % len(_POD_SHAPES)]) for i in range(24)]
+        self.nodes = [
+            ["churn-n%d" % i, dict(_NODE_SHAPES[i % len(_NODE_SHAPES)])]
+            for i in range(5)
+        ]
+        self.daemon_cpu = "100m"
+        self.pool_limit = None  # or a cpu quantity string
+
+    OPS = (
+        "pod_birth", "pod_delete", "pod_label_flip",
+        "node_add", "node_remove", "node_capacity", "node_zone_flip",
+        "daemonset_change", "pool_limit_edit", "noop",
+    )
+
+    def tick(self, n_ops: int = 2) -> None:
+        for _ in range(n_ops):
+            op = self.rng.choice(self.OPS)
+            getattr(self, "_op_" + op)()
+
+    def _op_noop(self):
+        pass
+
+    def _op_pod_birth(self):
+        self.pods.append(make_pod(**self.rng.choice(_POD_SHAPES)))
+
+    def _op_pod_delete(self):
+        if len(self.pods) > 4:
+            self.pods.pop(self.rng.randrange(len(self.pods)))
+
+    def _op_pod_label_flip(self):
+        # a changed node-selector moves the pod to a different group
+        p = self.rng.choice(self.pods)
+        i = self.pods.index(p)
+        shape = dict(self.rng.choice(_POD_SHAPES))
+        shape["node_selector"] = {
+            labels_mod.TOPOLOGY_ZONE: self.rng.choice(_ZONES)
+        }
+        self.pods[i] = make_pod(**shape)
+
+    def _op_node_add(self):
+        if len(self.nodes) < 9:
+            self.nodes.append(
+                [
+                    "churn-n%d" % self.rng.randrange(100, 1000),
+                    dict(self.rng.choice(_NODE_SHAPES)),
+                ]
+            )
+
+    def _op_node_remove(self):
+        if len(self.nodes) > 1:
+            self.nodes.pop(self.rng.randrange(len(self.nodes)))
+
+    def _op_node_capacity(self):
+        name, shape = self.rng.choice(self.nodes)
+        shape["cpu"] = self.rng.choice(["8", "16", "24"])
+
+    def _op_node_zone_flip(self):
+        name, shape = self.rng.choice(self.nodes)
+        shape["zone"] = self.rng.choice(_ZONES)
+
+    def _op_daemonset_change(self):
+        self.daemon_cpu = self.rng.choice(["100m", "200m", "300m"])
+
+    def _op_pool_limit_edit(self):
+        self.pool_limit = self.rng.choice([None, "5000", "9000"])
+
+    # -- materialization ---------------------------------------------------
+
+    def pools(self):
+        limits = {"cpu": self.pool_limit} if self.pool_limit else None
+        return [make_nodepool(limits=limits)]
+
+    def state_nodes(self):
+        return [
+            make_state_node(name=name, cpu=s["cpu"], memory=s["memory"], zone=s["zone"])
+            for name, s in self.nodes
+        ]
+
+    def daemonset_pods(self):
+        return [make_pod(name=None, cpu=self.daemon_cpu, memory="128Mi")]
+
+    def build_solver(self, cache: EncodeCache) -> TpuSolver:
+        pools = self.pools()
+        its_by_pool = {pools[0].name: list(_ITS)}
+        sns = self.state_nodes()
+        topo = Topology(Client(TestClock()), sns, pools, its_by_pool, self.pods)
+        return TpuSolver(
+            pools,
+            its_by_pool,
+            topo,
+            state_nodes=sns,
+            daemonset_pods=self.daemonset_pods(),
+            encode_cache=cache,
+        )
+
+
+def _assert_snapshots_identical(a: enc.EncodedSnapshot, b: enc.EncodedSnapshot):
+    assert a.resource_names == b.resource_names
+    assert a.existing_names == b.existing_names
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray):
+            assert va.dtype == vb.dtype, f.name
+            assert va.shape == vb.shape, f.name
+            assert np.array_equal(va, vb), f"delta snapshot diverged in {f.name}"
+
+
+def _decision_signature(results):
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+                repr(sorted(map(repr, c.requirements))),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(
+            (en.name, tuple(sorted(p.uid for p in en.pods)))
+            for en in results.existing_nodes
+        ),
+        sorted(results.pod_errors),
+    )
+
+
+class TestDeltaEncodeEquivalence:
+    @pytest.mark.parametrize("seed", [3, 11])
+    def test_churn_script_byte_identical_and_same_decisions(self, seed):
+        """Seeded property test: every tick of a random churn script, the
+        warm incremental encoding equals a from-scratch encode of the
+        same cluster byte-for-byte, and a warm solver's decisions equal a
+        cold solver's."""
+        rng = random.Random(seed)
+        cluster = ChurnCluster(rng)
+        warm_cache = EncodeCache()
+        saw_reuse = saw_delta = False
+        for t in range(14):
+            if t:
+                cluster.tick(rng.randrange(1, 3))
+            warm = cluster.build_solver(warm_cache)
+            cold = cluster.build_solver(EncodeCache())
+            groups_w, rest_w = enc.partition_and_group(
+                cluster.pods, topology=warm.oracle.topology
+            )
+            groups_c, rest_c = enc.partition_and_group(
+                cluster.pods, topology=cold.oracle.topology
+            )
+            assert not rest_w and not rest_c
+            snap_w, _, _, _, delta = warm._encode_batch(groups_w)
+            snap_c, _, _, _, _delta_c = cold._encode_batch(groups_c)
+            _assert_snapshots_identical(snap_w, snap_c)
+            saw_reuse |= delta.reused
+            saw_delta |= delta.delta_rows > 0
+            # decision equivalence through the full solve (device staging,
+            # queue, decode) — fresh solvers, same pod objects
+            r_warm = cluster.build_solver(warm_cache).solve(cluster.pods)
+            r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+            assert _decision_signature(r_warm) == _decision_signature(r_cold)
+        # the script must actually exercise the warm machinery
+        assert saw_delta, "churn script never took the delta path"
+
+    def test_unchanged_cluster_reuses_snapshot_verbatim(self):
+        cluster = ChurnCluster(random.Random(0))
+        cache = EncodeCache()
+        s1 = cluster.build_solver(cache)
+        r1 = s1.solve(cluster.pods)
+        assert not s1.last_encode_reused  # cold
+        s2 = cluster.build_solver(cache)
+        r2 = s2.solve(cluster.pods)
+        assert s2.last_encode_reused
+        assert s2.last_delta_rows == 0
+        assert _decision_signature(r1) == _decision_signature(r2)
+        # the reused snapshot shares the prior arrays by identity (zero
+        # host assembly) but binds THIS solve's metadata
+        cl = cache.cluster
+        assert cl.last_delta.reused
+        rec = obs.AUDIT.last()
+        assert rec.encode_reused is True
+        assert rec.delta_rows == 0
+
+    def test_node_churn_reports_row_level_delta(self):
+        cluster = ChurnCluster(random.Random(0))
+        cache = EncodeCache()
+        cluster.build_solver(cache).solve(cluster.pods)
+        # touch ONE node's capacity: the delta must be row-level, not a
+        # full re-encode
+        cluster.nodes[2][1]["cpu"] = "24"
+        s = cluster.build_solver(cache)
+        s.solve(cluster.pods)
+        d = cache.cluster.last_delta
+        assert not d.reused and not d.full
+        assert d.node_rows is not None and list(d.node_rows) == [2]
+        assert d.groups_unchanged
+        assert s.last_delta_rows >= 1
+
+    def test_vocab_growth_falls_back_to_full_encode(self):
+        """A genuinely new label value (vocab growth) drops the banks and
+        the fast path for that encode — correctness over warmth."""
+        cluster = ChurnCluster(random.Random(0))
+        cache = EncodeCache()
+        cluster.build_solver(cache).solve(cluster.pods)
+        cluster.pods.append(
+            make_pod(cpu="1", memory="1Gi", node_selector={"brand-new-key": "v"})
+        )
+        s = cluster.build_solver(cache)
+        s.solve(cluster.pods)
+        assert cache.cluster.last_delta.full
+        assert not cache.cluster.last_delta.reused
+
+
+class TestStaleBufferGates:
+    """Review-hardening regressions: the residency layer must never feed
+    the kernel a buffer that is more than one encode behind, and the
+    delta contract must not paper over state its tags don't model."""
+
+    def test_unstaged_encode_forces_full_restage(self):
+        """An encode WITHOUT a device stage (a scenario batch declining
+        after its encode, a native-backend solve) advances the version
+        counters; the next stage must detect the gap and restage whole —
+        a row delta would patch only the newest encode's rows and leave
+        the skipped encode's rows stale on device."""
+        cluster = ChurnCluster(random.Random(2))
+        cache = EncodeCache()
+        cluster.build_solver(cache).solve(cluster.pods)  # stage @ v
+        # churn B: encode WITHOUT staging (versions advance, device stays)
+        cluster.nodes[1][1]["cpu"] = "24"
+        sB = cluster.build_solver(cache)
+        groups, rest = enc.partition_and_group(
+            cluster.pods, topology=sB.oracle.topology
+        )
+        assert not rest
+        sB._encode_batch(groups)
+        # churn C: a full warm solve — decisions must match a cold solver
+        cluster.nodes[2][1]["cpu"] = "8"
+        r_warm = cluster.build_solver(cache).solve(cluster.pods)
+        r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(r_warm) == _decision_signature(r_cold)
+
+    def test_empty_diff_after_unstaged_bump_restages(self):
+        """The sharper shape of the same hazard: after the unstaged
+        version-bumping encode, the NEXT encode changes nothing on the
+        node axis — its node diff is EMPTY while the node version sits
+        one ahead of the buffer. An empty patch must not stamp the buffer
+        current (it still holds content from before the unstaged encode);
+        the stage must restage whole."""
+        cluster = ChurnCluster(random.Random(2))
+        cache = EncodeCache()
+        cluster.build_solver(cache).solve(cluster.pods)  # stage @ v
+        cluster.nodes[1][1]["cpu"] = "24"  # node change...
+        sB = cluster.build_solver(cache)
+        groups, rest = enc.partition_and_group(
+            cluster.pods, topology=sB.oracle.topology
+        )
+        assert not rest
+        sB._encode_batch(groups)  # ...encoded but never staged
+        # pods churn only: node tags identical to the unstaged encode's
+        cluster.pods = cluster.pods[:-1]
+        r_warm = cluster.build_solver(cache).solve(cluster.pods)
+        r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(r_warm) == _decision_signature(r_cold)
+
+    def test_topology_batch_always_restages_cross_arrays(self):
+        """n_hcnt/nh_cnt0 derive from TopoSpec priors the content tags
+        don't model: any topology-carrying encode must bump the cross
+        version and disable the cross-row delta."""
+        cl2 = enc.ClusterEncoding()
+        cache = EncodeCache()
+        cache.cluster = cl2
+        cluster = ChurnCluster(random.Random(4))
+        from helpers import spread_constraint
+        from karpenter_tpu.api import labels as labels_mod2
+
+        cluster.pods = [
+            make_pod(
+                cpu="1", memory="1Gi", labels={"app": "s"},
+                spread=[
+                    spread_constraint(
+                        labels_mod2.HOSTNAME, labels={"app": "s"}
+                    )
+                ],
+            )
+            for _ in range(4)
+        ]
+        cluster.build_solver(cache).solve(cluster.pods)
+        v1 = cl2.v_cross
+        cluster.build_solver(cache).solve(cluster.pods)
+        assert cl2.v_cross > v1, (
+            "topology encode must bump the cross-class version"
+        )
+        assert cl2.last_delta.cross_rows is None
+        assert not cl2.last_delta.reused
+
+    def test_interned_hostname_node_swap_detected(self):
+        """With a pod node-selector naming a node (hostname value
+        interned), two nodes differing ONLY by hostname encode different
+        mask rows — a positional node swap must break the fast path and
+        match a cold solver's decisions."""
+        from karpenter_tpu.api import labels as labels_mod2
+
+        cluster = ChurnCluster(random.Random(6))
+        cluster.pods = cluster.pods[:8] + [
+            make_pod(
+                cpu="1", memory="1Gi",
+                node_selector={labels_mod2.HOSTNAME: "churn-n0"},
+            )
+        ]
+        cache = EncodeCache()
+        r1 = cluster.build_solver(cache).solve(cluster.pods)
+        # the pinned pod landed on churn-n0
+        assert any(
+            en.name == "churn-n0" and en.pods for en in r1.existing_nodes
+        )
+        # swap node identity at position 0: same shape, different hostname
+        # same sort position (the oracle orders nodes by name), same
+        # shape — ONLY the hostname differs
+        cluster.nodes[0][0] = "churn-n0x"
+        r_warm = cluster.build_solver(cache).solve(cluster.pods)
+        r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(r_warm) == _decision_signature(r_cold)
+        # the pinned pod must NOT have been placed on the swapped node
+        assert not any(
+            en.name == "churn-n0x"
+            and any(
+                p.spec.node_selector.get(labels_mod2.HOSTNAME)
+                == "churn-n0"
+                for p in en.pods
+            )
+            for en in r_warm.existing_nodes
+        )
+
+
+class TestCorruptDeltaFallback:
+    def _solve_with_injector(self, rules, health=None):
+        cluster = ChurnCluster(random.Random(0))
+        cache = EncodeCache()
+        cfg = SolverConfig(health=health)
+        cluster.build_solver(cache).solve(cluster.pods)  # warm + stage
+        # churn one node so the next stage takes the row-delta path
+        cluster.nodes[0][1]["cpu"] = "8"
+        pools = cluster.pools()
+        its_by_pool = {pools[0].name: list(_ITS)}
+        sns = cluster.state_nodes()
+        topo = Topology(Client(TestClock()), sns, pools, its_by_pool, cluster.pods)
+        solver = TpuSolver(
+            pools, its_by_pool, topo, state_nodes=sns,
+            daemonset_pods=cluster.daemonset_pods(),
+            config=cfg, encode_cache=cache,
+        )
+        inj = faults.install(faults.FaultInjector(rules, seed=1))
+        try:
+            results = solver.solve(cluster.pods)
+        finally:
+            faults.uninstall()
+        return cluster, cache, solver, inj, results
+
+    def test_corrupt_delta_trips_guard_and_full_reencode(self):
+        """A corrupted delta row (inflated node capacity on the device
+        copy) must be caught by the pre-decode invariant guard and
+        answered with a full re-encode retry — correct results, nothing
+        stale committed, no rung tripped."""
+        rules = [
+            faults.FaultRule(
+                site=faults.ENCODE_DELTA,
+                mutate=lambda vals: np.full_like(vals, 10_000_000),
+                match=lambda ctx: ctx.get("name") == "n_avail",
+                times=1,
+            )
+        ]
+        from karpenter_tpu.faults.breaker import SolverHealth
+
+        health = SolverHealth(TestClock())
+        cluster, cache, solver, inj, results = self._solve_with_injector(
+            rules, health=health
+        )
+        assert inj.fired(faults.ENCODE_DELTA) >= 1
+        # the half-step: warm state shed, retried clean, rung intact
+        assert health.delta_fallbacks == 1
+        assert health.level() == 0
+        assert not results.pod_errors
+        # decisions equal a cold solver's (nothing stale committed)
+        r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(results) == _decision_signature(r_cold)
+        # the fallback invalidated the warm encoding: this encode was full
+        assert cache.cluster.last_delta.full
+        # audit provenance describes the committed (full re-encode)
+        # attempt, not the discarded incremental one
+        rec = obs.AUDIT.last()
+        assert rec.encode_reused is False
+        assert rec.delta_rows == 0
+
+    def test_corrupt_delta_without_health_still_recovers(self):
+        rules = [
+            faults.FaultRule(
+                site=faults.ENCODE_DELTA,
+                mutate=lambda vals: np.full_like(vals, 10_000_000),
+                match=lambda ctx: ctx.get("name") == "n_avail",
+                times=1,
+            )
+        ]
+        cluster, cache, solver, inj, results = self._solve_with_injector(rules)
+        assert not results.pod_errors
+        r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(results) == _decision_signature(r_cold)
+
+
+class TestAsyncQueueEquivalence:
+    @pytest.mark.parametrize("n_nodes", [40])
+    def test_single_node_sweep_identical_with_and_without_prefetch(
+        self, monkeypatch, n_nodes
+    ):
+        """Batched decisions are identical with and without the async
+        double-buffered prefetch (the queue is pure overlap, never
+        semantics)."""
+        from karpenter_tpu.solver.workloads import (
+            build_single_consolidation_env,
+        )
+
+        def decide(prefetch: str):
+            monkeypatch.setenv("KTPU_PREFETCH", prefetch)
+            ctx, method, candidates, budgets = build_single_consolidation_env(
+                n_nodes
+            )
+            cmd = method.compute_command(candidates, budgets)
+            return (
+                cmd.decision,
+                sorted(c.node_claim.name for c in cmd.candidates),
+                [
+                    sorted(it.name for it in r.instance_type_options)
+                    for r in cmd.replacements
+                ],
+            )
+
+        assert decide("0") == decide("1")
+
+    def test_queue_submit_fault_degrades_batched_rung(self):
+        """A DISPATCH_QUEUE fault at submit is absorbed like any batched
+        dispatch failure: the batch declines, the breaker records it, and
+        callers replay per-probe."""
+        from karpenter_tpu.faults.breaker import SolverHealth
+        from karpenter_tpu.solver.driver import Scenario
+
+        cluster = ChurnCluster(random.Random(0))
+        health = SolverHealth(TestClock())
+        cache = EncodeCache()
+        pools = cluster.pools()
+        its_by_pool = {pools[0].name: list(_ITS)}
+        sns = cluster.state_nodes()
+        topo = Topology(Client(TestClock()), sns, pools, its_by_pool, cluster.pods)
+        solver = TpuSolver(
+            pools, its_by_pool, topo, state_nodes=sns,
+            config=SolverConfig(health=health), encode_cache=cache,
+        )
+        inj = faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        site=faults.DISPATCH_QUEUE,
+                        match=lambda ctx: ctx.get("op") == "submit",
+                        times=1,
+                    )
+                ],
+                seed=0,
+            )
+        )
+        try:
+            out = solver.solve_scenarios(
+                [Scenario(pods=list(cluster.pods))]
+            )
+        finally:
+            faults.uninstall()
+        assert out is None
+        assert inj.fired(faults.DISPATCH_QUEUE) == 1
+
+
+class TestBankCompaction:
+    def test_stale_bank_entries_evicted(self):
+        cl = enc.ClusterEncoding(compact_every=4)
+        cluster = ChurnCluster(random.Random(5))
+        cache = EncodeCache()
+        cache.cluster = cl
+        cluster.build_solver(cache).solve(cluster.pods)
+        assert cl.group_bank
+        # a key unique to the first shape (the zone-selector group), then
+        # churn the group set away and keep encoding NEW shapes (each
+        # tick consults the group bank — the use clock only advances on
+        # consulting encodes): the stale entry must age out
+        marker = next(k for k in cl.group_bank if k)
+        for tick in range(16):
+            cpu = "3" if tick % 2 else "4"
+            cluster.pods = [
+                make_pod(cpu=cpu, memory="6Gi", labels={"gen": "two"})
+                for _ in range(6)
+            ]
+            cluster.build_solver(cache).solve(cluster.pods)
+        assert cl._guses >= 12
+        assert marker not in cl.group_bank, (
+            "stale group-bank entry survived compaction"
+        )
+
+    def test_quiet_reuse_does_not_age_live_entries(self):
+        """Consecutive content-hash reuses must not age the still-live
+        bank entries to eviction: the next churn tick is exactly when the
+        banks are supposed to be warm."""
+        cl = enc.ClusterEncoding(compact_every=2)
+        cluster = ChurnCluster(random.Random(5))
+        cache = EncodeCache()
+        cache.cluster = cl
+        cluster.build_solver(cache).solve(cluster.pods)
+        live = set(cl.group_bank) | set(cl.node_bank)
+        assert live
+        for _ in range(10):  # a quiet cluster: every encode reuses
+            cluster.build_solver(cache).solve(cluster.pods)
+        assert cl.last_delta.reused
+        assert live <= (set(cl.group_bank) | set(cl.node_bank)), (
+            "quiet reuse evicted live bank entries"
+        )
+
+
+class TestFaultSiteRegistry:
+    def test_new_sites_registered(self):
+        assert faults.ENCODE_DELTA in faults.ALL_SITES
+        assert faults.DISPATCH_QUEUE in faults.ALL_SITES

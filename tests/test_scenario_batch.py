@@ -505,7 +505,7 @@ class TestSolveArgNames:
         its_by_pool = {p.name: its for p in pools}
         topo = Topology(ctx.client, [], pools, its_by_pool, pods)
         solver = TpuSolver(pools, its_by_pool, topo)
-        snap, avail, _, _ = solver._encode_batch(groups)
+        snap, avail, _, _, _delta = solver._encode_batch(groups)
         args = snap.solve_args(*avail)
         assert len(args) == len(enc.SOLVE_ARG_NAMES)
         assert args[enc.SOLVE_ARG_NAMES.index("g_count")] is snap.g_count

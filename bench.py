@@ -231,6 +231,7 @@ def run_config(
 
     times: List[float] = []
     tpu_results = warm
+    s = None
     for _ in range(trials):
         s = make_solver()
         t0 = time.perf_counter()
@@ -253,6 +254,10 @@ def run_config(
         "nodes": tpu_results.node_count(),
         "cost": round(tpu_results.total_price(), 4),
         "tpu_routed_fraction": round(routed, 4),
+        # ISSUE 10: sequential-fallback gate count of one solve — the
+        # reference configs (diverse-ref, constrained) must report 0 now
+        # that topology/minValues/volumes/reservations ride the kernel
+        "fallback_solves": s.fallback_solves if s is not None else 0,
     }
     # phase attribution from one extra traced solve (compiled shapes are
     # already warm, so this costs one execution, not a compile)
@@ -395,6 +400,97 @@ def run_churn(
         **warm_phases,
         "cold_encode_ms": cold_phases["encode_ms"],
         "cold_transfer_ms": cold_phases["transfer_ms"],
+    }
+
+
+def run_constraint_churn(
+    config: str, n_pods: int, n_types: int = 400, ticks: int = 4
+) -> Dict:
+    """Steady-state reconcile under churn for the CONSTRAINED workloads
+    (ISSUE 10): topology-carrying batches now participate in the
+    delta-encode contract (content-tagged TopoSpecs), so a repeat solve of
+    an unchanged constrained cluster must hit the REUSE outcome and churn
+    ticks must ride row deltas instead of forcing FULL re-encodes — and
+    the whole workload must report zero sequential fallbacks."""
+    import random as _random
+
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver.driver import EncodeCache
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.workloads import (
+        constrained_mix, diverse_reference_mix,
+    )
+
+    mix = (
+        constrained_mix
+        if config == "constrained-churn"
+        else diverse_reference_mix
+    )
+    pools = [example_nodepool()]
+    its_by_pool = {pools[0].name: corpus.generate(n_types)}
+    cache = EncodeCache()
+    rng = _random.Random(7)
+    pods = mix(n_pods)
+
+    def solver_for(current_pods):
+        topo = Topology(
+            Client(TestClock()), [], pools, its_by_pool, current_pods
+        )
+        return TpuSolver(pools, its_by_pool, topo, encode_cache=cache)
+
+    def churn(current_pods):
+        # same-seed regeneration keeps the shape pool identical; swapping
+        # k pods shifts group counts (and occasionally the label-keyed
+        # group set — the topology delta the content tags must absorb)
+        k = max(1, n_pods // 100)
+        regen = mix(n_pods)
+        idx = rng.sample(range(len(current_pods)), k)
+        jdx = rng.sample(range(len(regen)), k)
+        out = list(current_pods)
+        for i, j in zip(idx, jdx):
+            out[i] = regen[j]
+        return out
+
+    solver_for(pods).solve(pods)
+    solver_for(pods).solve(pods)  # a-priori + adaptive NMAX warm-ups
+
+    times: List[float] = []
+    delta_rows: List[int] = []
+    fallbacks = 0
+    full_encodes = 0
+    for _ in range(ticks):
+        pods = churn(pods)
+        s = solver_for(pods)
+        t0 = time.perf_counter()
+        s.solve(pods)
+        times.append(time.perf_counter() - t0)
+        delta_rows.append(s.last_delta_rows)
+        fallbacks += s.fallback_solves
+        full_encodes += int(
+            not s.last_encode_reused and s.last_delta_rows == 0
+        )
+    # the REUSE proof: an unchanged re-solve of the topology-carrying
+    # cluster must hit the content-hash fast path (PR-8 contract extended)
+    s2 = solver_for(pods)
+    s2.solve(pods)
+    repeat_reused = bool(s2.last_encode_reused)
+    fallbacks += s2.fallback_solves
+
+    best = min(times)
+    return {
+        "config": config,
+        "pods": n_pods,
+        "types": n_types,
+        "pods_per_sec": round(n_pods / best, 1),
+        "best_ms": round(best * 1000, 1),
+        "p99_ms": round(max(times) * 1000, 1),
+        "delta_rows": int(statistics.median(delta_rows)),
+        "full_encodes": full_encodes,
+        "repeat_reused": repeat_reused,
+        "fallback_solves": fallbacks,
     }
 
 
@@ -654,6 +750,13 @@ def main() -> None:
                 grid.append(run_churn(5_000, pct, ticks=3))
             except Exception as exc:  # pragma: no cover - bench resilience
                 print(f"bench: churn-{pct}pct failed: {exc}", file=sys.stderr)
+        # ISSUE 10: constrained-workload churn — topology batches on the
+        # delta/REUSE contract with zero sequential fallbacks
+        for cfg in ("constrained-churn", "diverse-churn"):
+            try:
+                grid.append(run_constraint_churn(cfg, 5_000, ticks=3))
+            except Exception as exc:  # pragma: no cover - bench resilience
+                print(f"bench: {cfg} failed: {exc}", file=sys.stderr)
         headline = run_config(
             "constrained", N_HEADLINE_PODS, N_HEADLINE_TYPES, trials=1,
             with_oracle=False,
@@ -708,6 +811,17 @@ def main() -> None:
                 f"bench: churn {n_pods}x{pct}pct failed: {exc}",
                 file=sys.stderr,
             )
+    # ISSUE 10: constrained-workload churn rows — the topology delta/REUSE
+    # contract and the zero-fallback gate, at the reference shapes
+    for cfg, n_pods in (
+        ("constrained-churn", 5_000),
+        ("diverse-churn", 5_000),
+        ("constrained-churn", 50_000),
+    ):
+        try:
+            grid.append(run_constraint_churn(cfg, n_pods))
+        except Exception as exc:  # pragma: no cover - bench resilience
+            print(f"bench: {cfg}-{n_pods} failed: {exc}", file=sys.stderr)
 
     # the north star: 50k constrained pods x 800 types (BASELINE config[2])
     headline = run_config(

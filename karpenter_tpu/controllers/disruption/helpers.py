@@ -196,10 +196,17 @@ class ScenarioSimulator:
     binary search's probe set costs dispatches, not solves.
 
     ``available`` turns False when the batched path cannot represent this
-    cluster/workload (topology constraints whose priors depend on which
-    nodes remain, pods with volumes, non-tensorizable pods, reservations,
-    minValues, non-TPU backends) — callers fall back to the sequential
-    per-subset simulate_scheduling, the semantic reference."""
+    cluster/workload — pods with volumes (zonal-volume injection
+    deep-copies per simulation), non-tensorizable pods, strict-mode
+    reservations, non-TPU backends, and the topology remnants
+    TpuSolver._plan_scenario_topology documents (candidate pods owning
+    anti-affinity or selected by affinity-type constraints). Topology
+    SPREAD constraints, minValues pools, and default-mode reservations
+    now ride the batch (ISSUE 10): per-scenario prior deltas, dense
+    distinct-value counting, and a per-scenario ledger replay keep a
+    topology-constrained consolidation search at <= 2 dispatches —
+    callers fall back to the sequential per-subset simulate_scheduling,
+    the semantic reference, only on those remnants."""
 
     def __init__(
         self,

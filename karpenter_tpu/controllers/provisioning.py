@@ -59,6 +59,15 @@ DISPATCH_QUEUE_DEPTH = Gauge(
     "In-flight kernel dispatches left in the two-slot queue after the "
     "solve (nonzero = an abandoned speculative prefetch)",
 )
+# dense in-kernel constraints (ISSUE 10): how often work still fell off
+# the batched path for representability reasons — the reference configs
+# must keep this at zero (bench.py's fallback_solves column asserts it)
+SEQUENTIAL_FALLBACK = Counter(
+    "scheduler_sequential_fallback_total",
+    "Solve/scenario events routed through the sequential host path by a "
+    "remnant gate (strict reservations, oracle-routed pods, scenario "
+    "topology declines)",
+)
 
 
 class Batcher:
@@ -332,6 +341,9 @@ class Provisioner:
         delta_rows = getattr(solver, "last_delta_rows", 0)
         if delta_rows:
             ENCODE_DELTA_ROWS.inc(value=delta_rows)
+        fallbacks = getattr(solver, "fallback_solves", 0)
+        if fallbacks:
+            SEQUENTIAL_FALLBACK.inc(value=fallbacks)
         queue = getattr(solver, "_queue", None)
         if queue is not None:
             DISPATCH_QUEUE_DEPTH.set(float(queue.depth()))

@@ -96,6 +96,7 @@ def solve_core_native(
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     nh_cnt0, dd0, dtg_key,
     well_known,
+    p_mvmin, t_mvoh,
     nmax: int,
     zone_kid: int,
     ct_kid: int,
@@ -152,6 +153,8 @@ def solve_core_native(
     n_avail, n_base = _as(n_avail, np.float32), _as(n_base, np.float32)
     n_tol = _as(n_tol, np.uint8)
     well_known = _as(well_known, np.uint8)
+    p_mvmin = _as(p_mvmin, np.int32)
+    t_mvoh = _as(t_mvoh, np.uint8)
 
     G = g_count.shape[0]
     P, K = p_def.shape
@@ -162,6 +165,8 @@ def solve_core_native(
     JH = nh_cnt0.shape[1] if nh_cnt0.ndim == 2 else 1
     JD = dd0.shape[0] if dd0.ndim == 2 else 1
     NRES = res_cap0.shape[0]
+    MV = p_mvmin.shape[1] if p_mvmin.ndim == 2 else 0
+    MW = t_mvoh.shape[2] if t_mvoh.ndim == 3 else 1
 
     c_pool = np.zeros(nmax, np.int32)
     c_tmask = np.zeros((nmax, T), np.uint8)
@@ -179,6 +184,7 @@ def solve_core_native(
         ctypes.c_int(R), ctypes.c_int(K), ctypes.c_int(V1), ctypes.c_int(O),
         ctypes.c_int(nmax), ctypes.c_int(zone_kid), ctypes.c_int(ct_kid),
         ctypes.c_int(JH), ctypes.c_int(JD), ctypes.c_int(NRES),
+        ctypes.c_int(MV), ctypes.c_int(MW),
         _ptr(g_count), _ptr(g_req), _ptr(g_def), _ptr(g_neg), _ptr(g_mask),
         _ptr(g_hcap), _ptr(g_haff),
         _ptr(g_dmode), _ptr(g_dkey), _ptr(g_dskew), _ptr(g_dmin0),
@@ -195,6 +201,7 @@ def solve_core_native(
         _ptr(n_dzone), _ptr(n_dct),
         _ptr(nh_cnt0), _ptr(dd0), _ptr(dtg_key),
         _ptr(well_known),
+        _ptr(p_mvmin), _ptr(t_mvoh),
         _ptr(c_pool), _ptr(c_tmask), _ptr(n_open), _ptr(overflow),
         _ptr(exist_fills), _ptr(claim_fills), _ptr(unplaced),
         _ptr(c_dzone), _ptr(c_dct), _ptr(c_resv),

@@ -26,6 +26,7 @@
 // parity: dtype int32
 // parity: dtype bool
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -183,6 +184,35 @@ inline void waterfill(const std::vector<int32_t>& npods,
   }
 }
 
+// minvalues_cap (ops/packing.py): largest fill k keeping every minValues
+// floor satisfied after the fill narrows options to {t : mask && fit >= k}.
+// For key j / catalog value w, f_w = max fit over masked types offering w;
+// the floor_j-th largest f_w (descending order statistic) is the cap for
+// that key; the result is the min over constrained keys. Identical
+// semantics to the JAX twin's sorted take_along_axis.
+inline int32_t minvalues_cap_one(const uint8_t* tmask, const int32_t* fit,
+                                 const int32_t* floors, const uint8_t* t_mvoh,
+                                 int T, int MV, int MW) {
+  int32_t cap = kBigDom;
+  std::vector<int32_t> f(MW);
+  for (int j = 0; j < MV; ++j) {
+    const int32_t need = floors[j];
+    if (need <= 0) continue;
+    if (need > MW) return 0;  // floors beyond the catalog's value count
+    std::fill(f.begin(), f.end(), 0);
+    for (int t = 0; t < T; ++t) {
+      if (!tmask[t] || fit[t] <= 0) continue;
+      const uint8_t* row = t_mvoh + (static_cast<size_t>(t) * MV + j) * MW;
+      for (int w = 0; w < MW; ++w)
+        if (row[w]) f[w] = std::max(f[w], fit[t]);
+    }
+    std::nth_element(f.begin(), f.begin() + (need - 1), f.end(),
+                     std::greater<int32_t>());
+    cap = std::min(cap, f[need - 1]);
+  }
+  return cap;
+}
+
 }  // namespace
 
 extern "C" {
@@ -192,7 +222,7 @@ extern "C" {
 int kt_solve(
     // dims
     int G, int T, int P, int N, int R, int K, int V1, int O, int NMAX,
-    int zone_kid, int ct_kid, int JH, int JD, int NRES,
+    int zone_kid, int ct_kid, int JH, int JD, int NRES, int MV, int MW,
     // groups (FFD order)
     const int32_t* g_count, const float* g_req, const uint8_t* g_def,
     const uint8_t* g_neg, const uint8_t* g_mask,
@@ -232,6 +262,8 @@ int kt_solve(
     const int32_t* dd0,      // [JD, V1] shared domain carry init
     const int32_t* dtg_key,  // [JD] shared domain-constraint axis (0=zone)
     const uint8_t* well_known,
+    const int32_t* p_mvmin,  // [P, MV] per-template minValues floors
+    const uint8_t* t_mvoh,   // [T, MV, MW] per-type catalog-value one-hots
     // outputs
     int32_t* out_c_pool,      // [NMAX]
     uint8_t* out_c_tmask,     // [NMAX, T]
@@ -423,6 +455,55 @@ int kt_solve(
       D0v[v] = g_dprior[static_cast<size_t>(gi) * V1 + v] +
                (has_d ? ddc[static_cast<size_t>(jd) * V1 + v] : 0);
     const int32_t* D0 = D0v.data();
+
+    // parity: phase min-values
+    // dense minValues: per-claim cap on this step's joins so the narrowed
+    // option set keeps every constrained key's distinct-value floor
+    // satisfied (the oracle's per-Add SatisfiesMinValues recount). Mirrors
+    // ops/packing.py's cap_mv over tm = c_tmask ∧ type_ok ∧ off ∧ fits.
+    std::vector<int32_t> cap_mv(MV ? NMAX : 0, kBigDom);
+    if (MV) {
+      std::vector<uint8_t> mv_mask(T);
+      std::vector<int32_t> mv_fit(T);
+      for (int s = 0; s < NMAX; ++s) {
+        if (!c_active[s]) continue;
+        const int pp = c_pool[s];
+        const int32_t* floors = p_mvmin + static_cast<size_t>(pp) * MV;
+        bool any_floor = false;
+        for (int j = 0; j < MV; ++j) any_floor = any_floor || floors[j] > 0;
+        if (!any_floor) continue;
+        const uint8_t* sm = c_mask.data() + static_cast<size_t>(s) * KV;
+        for (int t = 0; t < T; ++t) {
+          mv_mask[t] = 0;
+          mv_fit[t] = 0;
+          if (!c_tmask[static_cast<size_t>(s) * T + t]) continue;
+          if (!type_ok_pgt[(static_cast<size_t>(pp) * G + gi) * T + t])
+            continue;
+          int32_t add = fits_count(
+              t_alloc + t * R, c_used.data() + static_cast<size_t>(s) * R,
+              req, R);
+          if (add < 1) continue;
+          bool off = false;
+          const uint8_t* az =
+              a_for_claim(s) + static_cast<size_t>(t) * V1 * V1;
+          for (int z = 0; z < V1 && !off; ++z) {
+            if (!(sm[zone_kid * V1 + z] && gmask[zone_kid * V1 + z]))
+              continue;
+            for (int c = 0; c < V1; ++c)
+              if (az[z * V1 + c] && sm[ct_kid * V1 + c] &&
+                  gmask[ct_kid * V1 + c]) {
+                off = true;
+                break;
+              }
+          }
+          if (!off) continue;
+          mv_mask[t] = 1;
+          mv_fit[t] = add;
+        }
+        cap_mv[s] = minvalues_cap_one(mv_mask.data(), mv_fit.data(), floors,
+                                      t_mvoh, T, MV, MW);
+      }
+    }
 
     // parity: phase existing-nodes
     // ---- 1. existing nodes, fixed priority order ----
@@ -734,6 +815,7 @@ int kt_solve(
       }
       claim_cap[s] = best;
       claim_cap[s] = std::min(claim_cap[s], hc);  // open claims carry no prior
+      if (MV) claim_cap[s] = std::min(claim_cap[s], cap_mv[s]);
       if (has_h)
         claim_cap[s] = std::min(
             claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
@@ -796,6 +878,7 @@ int kt_solve(
         claim_cap[s] =
             (d_star < V1) ? percap_d[static_cast<size_t>(s) * V1 + d_star] : 0;
         claim_cap[s] = std::min(claim_cap[s], hc);
+        if (MV) claim_cap[s] = std::min(claim_cap[s], cap_mv[s]);
         if (has_h)
           claim_cap[s] = std::min(
               claim_cap[s], h_allow(ch_cnt[static_cast<size_t>(s) * JH + jh]));
@@ -956,12 +1039,34 @@ int kt_solve(
       };
 
       int p_star = -1;
-      for (int p = 0; p < P && p_star < 0; ++p)
+      int32_t mv_cap_sel = kBigDom;
+      std::vector<uint8_t> mv_av(MV ? T : 0);
+      std::vector<int32_t> mv_ft(MV ? T : 0);
+      for (int p = 0; p < P && p_star < 0; ++p) {
+        bool anyt = false;
         for (int t = 0; t < T; ++t)
           if (type_avail(p, t)) {
-            p_star = p;
+            anyt = true;
             break;
           }
+        if (!anyt) continue;
+        if (MV) {
+          // a template whose available set cannot satisfy its floors is
+          // infeasible for this bulk (filter_instance_types' minValues
+          // validation) — fall through to the next template in weight order
+          for (int t = 0; t < T; ++t) {
+            mv_av[t] = type_avail(p, t);
+            mv_ft[t] =
+                n_fit_pgt[(static_cast<size_t>(p) * G + gi) * T + t];
+          }
+          int32_t mc = minvalues_cap_one(
+              mv_av.data(), mv_ft.data(),
+              p_mvmin + static_cast<size_t>(p) * MV, t_mvoh, T, MV, MW);
+          if (mc < 1) continue;
+          mv_cap_sel = mc;
+        }
+        p_star = p;
+      }
       if (p_star < 0) {
         ddead[d_sel] = 1;
         continue;
@@ -981,6 +1086,7 @@ int kt_solve(
           debit[r] = std::max(debit[r], t_cap[t * R + r]);
       }
       n_per = std::min(n_per, hc);
+      if (MV) n_per = std::min(n_per, mv_cap_sel);
       // fresh claims have count 0: self owners cap at scap_h; gate owners
       // are unblocked (0 never exceeds the threshold)
       if (hself) n_per = std::min(n_per, scap_h);
@@ -1109,6 +1215,7 @@ int kt_solve(
       // haff: a second trip would open a second entity — retire the slot
       if (haff) ddead[d_sel] = 1;
     }
+    // parity: phase spread-counters
     // shared domain carry: a SELF owner's per-domain placements feed the
     // next sharing group's counts (gate modes never count themselves)
     if (has_d && mode <= 2)

@@ -150,6 +150,39 @@ def waterfill(npods, cap, n, iters: int = 32):
     return fills
 
 
+def minvalues_cap(tmask, fit, floors, t_mvoh):
+    """Largest fill count k that keeps every minValues floor satisfied
+    after the fill narrows options to {t : tmask_t and fit_t >= k} —
+    the dense form of the oracle's per-Add distinct-value recount
+    (cloudprovider/types.py:satisfies_min_values; nodeclaim.go:363-426).
+
+    Shared by pack and pack_classed (and mirrored in native/solve_core.cc
+    — the three must stay bit-exact). tmask [..., T] bool, fit [..., T]
+    int32, floors [..., MV] int32 (0 = no floor), t_mvoh [T, MV, W] bool.
+
+    For key j and catalog value w, f_w = max fit over masked types
+    offering w: value w survives a fill of k iff f_w >= k, so the number
+    of distinct values after a fill of k is #{w : f_w >= k}, and the
+    largest k keeping >= floor_j of them alive is the floor_j-th largest
+    f_w (descending). The cap is the min over constrained keys; floors
+    beyond the catalog's distinct-value count are unsatisfiable (cap 0).
+    """
+    f = jnp.max(
+        jnp.where(
+            tmask[..., :, None, None] & t_mvoh,
+            fit[..., :, None, None],
+            0,
+        ),
+        axis=-3,
+    )  # [..., MV, W]
+    fs = -jnp.sort(-f, axis=-1)  # descending over the value axis
+    W = fs.shape[-1]
+    idx = jnp.clip(floors - 1, 0, W - 1)
+    kth = jnp.take_along_axis(fs, idx[..., None], axis=-1)[..., 0]
+    kth = jnp.where(floors > W, 0, kth)
+    return jnp.min(jnp.where(floors > 0, kth, _BIGI), axis=-1)
+
+
 def spread_domain_choice(adm, qrem_v, mode, V1, DEAD):
     """Tier-2 domain assignment for dynamic groups, shared by pack and
     pack_classed (and mirrored in native/solve_core.cc — the three must
@@ -275,6 +308,8 @@ def pack(
     dd0,  # [JD, V1] int32 shared domain-count carry init
     dtg_key,  # [JD] int32 shared domain-constraint axis (0 = zone, 1 = ct)
     well_known,
+    p_mvmin,  # [P, MV] int32 per-template minValues floors (0 = none)
+    t_mvoh,  # [T, MV, W] bool per-type catalog-value one-hots per mv key
     nmax: int,
     zone_kid: int,
     ct_kid: int,
@@ -316,6 +351,8 @@ def pack(
     NRES = res_cap0.shape[0]
     if NRES:
         a_held_f = (a_tzc | jnp.any(a_res, axis=0)).astype(jnp.float32)
+    # static minValues gate: MV == 0 traces the distinct-value counting out
+    MV = p_mvmin.shape[1]
 
     state = PackState(
         exist_used=n_base,
@@ -556,6 +593,16 @@ def pack(
 
         cap_any = jnp.where(claim_live, jnp.max(jnp.where(tm, add_fit, 0), axis=-1), 0)
 
+        # parity: phase min-values
+        # dense minValues: joining pods narrows a claim's options via
+        # still-fits, so cap the join at the largest count that keeps every
+        # constrained key's distinct-value floor satisfied (the oracle's
+        # per-Add SatisfiesMinValues recount, inflight.py:82)
+        if MV:
+            cap_mv = minvalues_cap(
+                tm, add_fit, p_mvmin[state.c_pool], t_mvoh
+            )  # [NMAX]
+
         if has_domains:
             # per-claim per-domain capacity, computed ONCE for dynamic
             # groups and shared by the bootstrap anchor and tier 2 (the
@@ -754,6 +801,8 @@ def pack(
         def _clamp(cap):
             cap = jnp.minimum(cap, hcap)  # open claims carry no prior
             cap = jnp.minimum(cap, count)  # keeps int32 waterfill sums safe
+            if MV:
+                cap = jnp.minimum(cap, cap_mv)
             return jnp.minimum(cap, _h_allow(state.ch_cnt[:, jhc]))
 
         def _tier2_any(_):
@@ -916,11 +965,20 @@ def pack(
                 eff_dom = jnp.where(dkey == 0, eff_z, eff_c)
                 avail = avail & jnp.where(is_any, t_eff, eff_dom)
             feas_p = jnp.any(avail, axis=-1)
+            if MV:
+                # a template whose available set cannot satisfy its floors
+                # is infeasible for this bulk (filter_instance_types'
+                # minValues validation); the per-claim fill is additionally
+                # capped so the post-takes narrowed set stays satisfying
+                mv_cap_p = minvalues_cap(avail, n_fit_row, p_mvmin, t_mvoh)
+                feas_p = feas_p & (mv_cap_p >= 1)
             p_star = jnp.argmax(feas_p)  # first True in weight order
             any_feasible = jnp.any(feas_p)
             n_per = jnp.minimum(
                 jnp.max(jnp.where(avail[p_star], n_fit_row[p_star], 0)), hcap
             )
+            if MV:
+                n_per = jnp.minimum(n_per, mv_cap_p[p_star])
             # fresh claims have count 0: self owners cap at scap_h; gate
             # owners are unblocked (0 never exceeds the threshold)
             n_per = jnp.minimum(n_per, jnp.where(has_h & hself, scap_h, _BIGI))
@@ -1079,6 +1137,7 @@ def pack(
         new_state, qrem_fin, claim_fill, _ = jax.lax.while_loop(
             cond2, body, (new_state, qrem, claim_fill, ddead0)
         )
+        # parity: phase spread-counters
         # shared domain carry: a SELF owner's per-domain placements feed the
         # next sharing group's counts (gate modes never count themselves)
         new_state = new_state._replace(
@@ -1186,6 +1245,7 @@ def pack_classed(
     n_hcnt, n_dzone, n_dct,
     nh_cnt0, dd0, dtg_key,
     well_known,
+    p_mvmin, t_mvoh,  # dense minValues tables (see pack())
     # class partition (driver-computed): groups sorted FFD fall into
     # contiguous runs with identical feasibility rows (same requests,
     # requirement masks, tolerations) — the FFD key IS the class key
@@ -1241,6 +1301,11 @@ def pack_classed(
     ANY, DEAD = V1, V1 + 1
     NRES = res_cap0.shape[0]
     assert NRES == 0, "pack_classed requires an empty reservation ledger"
+    # minValues rides the maintained-summary discipline (exact under the
+    # class's uniform-request decrements); batches mixing floors with
+    # in-class domain pins are routed to pack() by the driver, where the
+    # cap is recomputed from the narrowed mask each step
+    MV = p_mvmin.shape[1]
 
     a_tzc_f = a_tzc.astype(jnp.float32)
 
@@ -1457,6 +1522,17 @@ def pack_classed(
             )  # [NMAX, V1] (zeros when the class has no dynamic member)
         else:
             percapv0 = jnp.zeros((nmax, 0), jnp.int32)
+        # parity: phase min-values
+        # per-claim minValues headroom, maintained like capv: within a
+        # class every fill decrements all fits uniformly, so the k-th
+        # largest per-value fit shifts by exactly the fill (order
+        # preserved) and the head value decrements member-by-member
+        if MV:
+            mvcapv0 = minvalues_cap(
+                tm0, add_fit0, p_mvmin[state.c_pool], t_mvoh
+            )  # [NMAX]
+        else:
+            mvcapv0 = jnp.zeros((nmax,), jnp.int32)
 
         # snapshots for pin-on-read and opened-this-class classification
         n_open0 = state.n_open
@@ -1464,8 +1540,8 @@ def pack_classed(
         kid_sel = jnp.where(cdk == 0, zone_kid, ct_kid)
 
         def _member_body(
-            j, state: PackState, exist_cap, capv, percapv, af0, cfills,
-            live, tor,
+            j, state: PackState, exist_cap, capv, percapv, mvcapv, af0,
+            cfills, live, tor,
         ):
             gi = cs + j
             count = g_count[gi]
@@ -1651,6 +1727,8 @@ def pack_classed(
             def _clamp(cap):
                 cap = jnp.minimum(cap, hcap)
                 cap = jnp.minimum(cap, count)
+                if MV:
+                    cap = jnp.minimum(cap, mvcapv)
                 return jnp.minimum(cap, _h_allow(state.ch_cnt[:, jhc]))
 
             def _tier2_any(_):
@@ -1754,12 +1832,15 @@ def pack_classed(
                 )
                 c_dzone2, c_dct2 = state.c_dzone, state.c_dct
                 capv = capv - claim_fill
+            if MV:
+                # uniform same-req decrement, exact (see the head comment)
+                mvcapv = jnp.maximum(mvcapv - claim_fill, 0)
             cfills = cfills + claim_fill
 
             # parity: phase fresh-claims
             # ---- 3. fresh claims ----------------------------------------
             def body(carry):
-                (st, qrem, fills, ddead, capv, percapv, af0, cfills,
+                (st, qrem, fills, ddead, capv, percapv, mvcapv, af0, cfills,
                  live, tor) = carry
                 d_sel = jnp.argmax(jnp.where(ddead, -1, qrem))
                 rem_d = qrem[d_sel]
@@ -1781,12 +1862,19 @@ def pack_classed(
                 )
                 avail = type_ok_row & within_limits & tdok
                 feas_p = jnp.any(avail, axis=-1)
+                if MV:
+                    mv_cap_p = minvalues_cap(
+                        avail, n_fit_row, p_mvmin, t_mvoh
+                    )
+                    feas_p = feas_p & (mv_cap_p >= 1)
                 p_star = jnp.argmax(feas_p)
                 any_feasible = jnp.any(feas_p)
                 n_fit_max = jnp.max(
                     jnp.where(avail[p_star], n_fit_row[p_star], 0)
                 )
                 n_per = jnp.minimum(n_fit_max, hcap)
+                if MV:
+                    n_per = jnp.minimum(n_per, mv_cap_p[p_star])
                 n_per = jnp.minimum(
                     n_per, jnp.where(has_h & hself, scap_h, _BIGI)
                 )
@@ -1904,6 +1992,12 @@ def pack_classed(
                     )
                     prow = jnp.where(d_pin >= 0, prow * pin_oh_v[None, :], prow)
                     percapv = jnp.where(in_bulk[:, None], prow, percapv)
+                if MV:
+                    mv_open = minvalues_cap(
+                        avail[p_star], n_fit_row[p_star],
+                        p_mvmin[p_star], t_mvoh,
+                    )
+                    mvcapv = jnp.where(in_bulk, mv_open - takes, mvcapv)
                 af0 = write(af0, n_fit_row[p_star][None, :] - takes[:, None])
                 cfills = jnp.where(in_bulk, 0, cfills)
                 live = live | in_bulk
@@ -1913,7 +2007,10 @@ def pack_classed(
                 ddead = ddead.at[d_sel].set(
                     ddead[d_sel] | (placed == 0) | haff
                 )
-                return st, qrem, fills, ddead, capv, percapv, af0, cfills, live, tor
+                return (
+                    st, qrem, fills, ddead, capv, percapv, mvcapv, af0,
+                    cfills, live, tor,
+                )
 
             def cond2(carry):
                 return jnp.any((carry[1] > 0) & ~carry[3]) & ~carry[0].overflow
@@ -1931,13 +2028,14 @@ def pack_classed(
                 nhc=nhc,
             )
             ddead0 = jnp.zeros((NSLOT,), bool).at[DEAD].set(True)
-            (new_state, qrem_fin, claim_fill, _dd, capv, percapv, af0,
-             cfills, live, tor) = jax.lax.while_loop(
+            (new_state, qrem_fin, claim_fill, _dd, capv, percapv, mvcapv,
+             af0, cfills, live, tor) = jax.lax.while_loop(
                 cond2,
                 body,
-                (new_state, qrem, claim_fill, ddead0, capv, percapv, af0,
-                 cfills, live, tor),
+                (new_state, qrem, claim_fill, ddead0, capv, percapv, mvcapv,
+                 af0, cfills, live, tor),
             )
+            # parity: phase spread-counters
             new_state = new_state._replace(
                 ddc=new_state.ddc.at[jdc].add(
                     jnp.where(
@@ -1990,44 +2088,47 @@ def pack_classed(
                 )
             unplaced = count - jnp.sum(exist_fill) - jnp.sum(claim_fill)
             return (
-                new_state, exist_cap, capv, percapv, af0, cfills, live, tor,
+                new_state, exist_cap, capv, percapv, mvcapv, af0, cfills,
+                live, tor,
                 (exist_fill, claim_fill, unplaced),
             )
 
         def _member(j, carry):
-            (state, exist_cap, capv, percapv, af0, cfills, live, tor,
+            (state, exist_cap, capv, percapv, mvcapv, af0, cfills, live, tor,
              ebuf, cbuf, ubuf) = carry
             gi = cs + j
 
             def _run(_):
                 out = _member_body(
-                    j, state, exist_cap, capv, percapv, af0, cfills, live, tor
+                    j, state, exist_cap, capv, percapv, mvcapv, af0, cfills,
+                    live, tor,
                 )
-                return out[:8] + out[8]
+                return out[:9] + out[9]
 
             def _skip(_):
                 return (
-                    state, exist_cap, capv, percapv, af0, cfills, live, tor,
+                    state, exist_cap, capv, percapv, mvcapv, af0, cfills,
+                    live, tor,
                     jnp.zeros((N,), jnp.int32),
                     jnp.zeros((nmax,), jnp.int32),
                     jnp.int32(0),
                 )
 
             out = jax.lax.cond(g_count[gi] > 0, _run, _skip, None)
-            ebuf = jax.lax.dynamic_update_slice(ebuf, out[8][None, :], (j, 0))
-            cbuf = jax.lax.dynamic_update_slice(cbuf, out[9][None, :], (j, 0))
-            ubuf = ubuf.at[j].set(out[10])
-            return out[:8] + (ebuf, cbuf, ubuf)
+            ebuf = jax.lax.dynamic_update_slice(ebuf, out[9][None, :], (j, 0))
+            cbuf = jax.lax.dynamic_update_slice(cbuf, out[10][None, :], (j, 0))
+            ubuf = ubuf.at[j].set(out[11])
+            return out[:9] + (ebuf, cbuf, ubuf)
 
         carry0 = (
-            state, exist_cap0, capv0, percapv0, add_fit0,
+            state, exist_cap0, capv0, percapv0, mvcapv0, add_fit0,
             jnp.zeros((nmax,), jnp.int32), live0, tor0,
             jnp.zeros((lmax, N), jnp.int32),
             jnp.zeros((lmax, nmax), jnp.int32),
             jnp.zeros((lmax,), jnp.int32),
         )
         out = jax.lax.fori_loop(0, cl, _member, carry0)
-        (state, _ec, _capv, _pcv, af0_f, cfills_f, live_f, tor_f,
+        (state, _ec, _capv, _pcv, _mcv, af0_f, cfills_f, live_f, tor_f,
          ebuf, cbuf, ubuf) = out
 
         # ---- end-of-class type-mask settlement --------------------------

@@ -91,6 +91,7 @@ def _solve_with(
     n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
     nh_cnt0, dd0, dtg_key,
     well_known,
+    p_mvmin, t_mvoh,
     *extra_args,
     zone_kid: int,
     ct_kid: int,
@@ -135,6 +136,7 @@ def _solve_with(
             n_dzone, n_dct,
             nh_cnt0, dd0, dtg_key,
             well_known,
+            p_mvmin, t_mvoh,
             *extra_args,
             zone_kid=zone_kid,
             ct_kid=ct_kid,
@@ -275,29 +277,46 @@ solve_all_classed_packed = jax.jit(
 # host loop of solves.
 
 SCENARIO_BATCHED_ARGS = ("g_count", "n_tol")
+# topology-carrying consolidation searches additionally batch the prior
+# arrays: which candidate nodes remain decides which bound pods count as
+# topology priors, so each scenario carries its own corrected copies
+# (driver.submit_scenarios derives them from the shared encoding plus
+# per-candidate contribution deltas). The kernel math is unchanged — the
+# vmap simply maps four more inputs.
+SCENARIO_TOPO_BATCHED_ARGS = SCENARIO_BATCHED_ARGS + (
+    "g_dprior", "n_hcnt", "nh_cnt0", "dd0",
+)
 _SCENARIO_IN_AXES = tuple(
     0 if name in SCENARIO_BATCHED_ARGS else None for name in SOLVE_ARG_NAMES
 )
+_SCENARIO_TOPO_IN_AXES = tuple(
+    0 if name in SCENARIO_TOPO_BATCHED_ARGS else None
+    for name in SOLVE_ARG_NAMES
+)
 
 
-def solve_scenarios_core_packed(*args, fills_dtype=jnp.int32, **statics):
+def solve_scenarios_core_packed(
+    *args, fills_dtype=jnp.int32, batch_topo: bool = False, **statics
+):
     """solve_core_packed vmapped over a leading scenario axis on
-    (g_count, n_tol); every other arg is shared. Outputs gain a leading
-    [S] axis and stay wire-packed per scenario."""
+    (g_count, n_tol) — plus the topology prior arrays (g_dprior, n_hcnt,
+    nh_cnt0, dd0) when ``batch_topo`` — every other arg is shared.
+    Outputs gain a leading [S] axis and stay wire-packed per scenario."""
 
     def one(*scenario_args):
         return solve_core_packed(
             *scenario_args, fills_dtype=fills_dtype, **statics
         )
 
-    return jax.vmap(one, in_axes=_SCENARIO_IN_AXES)(*args)
+    axes = _SCENARIO_TOPO_IN_AXES if batch_topo else _SCENARIO_IN_AXES
+    return jax.vmap(one, in_axes=axes)(*args)
 
 
 solve_all_scenarios_packed = jax.jit(
     solve_scenarios_core_packed,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility", "wf_iters", "fills_dtype",
+        "tile_feasibility", "wf_iters", "fills_dtype", "batch_topo",
     ),
 )
 

@@ -110,6 +110,8 @@ def snapshot_shardings(mesh) -> Tuple:
         rep,  # dd0 [JD, V1]
         rep,  # dtg_key [JD]
         rep,  # well_known [K]
+        rep,  # p_mvmin [P, MV]
+        S("model"),  # t_mvoh [T, MV, W]
     )
 
 
@@ -177,6 +179,7 @@ def pad_args_for_mesh(args, mesh):
         n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
         nh_cnt0, dd0, dtg_key,
         well_known,
+        p_mvmin, t_mvoh,
     ) = args
 
     def pad_axis(arr, axis, mult, fill=0):
@@ -216,6 +219,7 @@ def pad_args_for_mesh(args, mesh):
     o_avail, o_zone, o_ct, a_tzc = map(for_t, (o_avail, o_zone, o_ct, a_tzc))
     a_res = pad_axis(a_res, 1, model)  # padded types have no reservations
     p_titype_ok = pad_axis(p_titype_ok, 1, model)  # padded types stay infeasible
+    t_mvoh = pad_axis(t_mvoh, 0, model)  # padded types offer no mv values
 
     return (
         g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
@@ -229,4 +233,5 @@ def pad_args_for_mesh(args, mesh):
         n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
         nh_cnt0, dd0, dtg_key,
         well_known,
+        p_mvmin, t_mvoh,
     )

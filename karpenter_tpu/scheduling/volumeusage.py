@@ -14,7 +14,7 @@ by attach-limit accounting (driver + volume id) and zonal topology injection
 
 from __future__ import annotations
 
-from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 from ..api.objects import (
     PersistentVolume,
@@ -128,6 +128,28 @@ class VolumeUsage:
                 if d:
                     vu._volumes.setdefault(d, set()).add(v)
         return vu
+
+    def has(self, driver: str, volume_id: str) -> bool:
+        """True when the volume is already attached to this node (the
+        solver's dense attach-slot ledger routes such pods host-side:
+        per-node dedup can't be expressed as a uniform request)."""
+        return volume_id in self._volumes.get(driver, ())
+
+    def attached(self) -> Iterable[Tuple[str, str]]:
+        """Every (driver, volume id) currently attached — the solver's
+        batch-admission precomputation."""
+        for driver, vols in self._volumes.items():
+            for vid in vols:
+                yield (driver, vid)
+
+    def attached_count(self, driver: str) -> int:
+        """Distinct volumes attached for one driver (the encoder's
+        remaining-attach-slot column derives from this)."""
+        return len(self._volumes.get(driver, ()))
+
+    def attached_counts(self) -> Dict[str, int]:
+        """Per-driver distinct-volume counts (delta-tag content)."""
+        return {d: len(v) for d, v in self._volumes.items()}
 
     def validate(self, resolved: Sequence, limits: Dict[str, int]) -> Optional[str]:
         """Error string if adding ``resolved`` would exceed any driver's

@@ -79,6 +79,17 @@ class EncodeCache:
         # them along with the vocab (lease() below)
         self.cluster = enc.ClusterEncoding()
         self.device_store = None  # solver/residency.py, built lazily
+        # scenario-build warm path (ISSUE 10 satellite): consolidation
+        # searches encode a DIFFERENT workload shape than provisioning
+        # (union of candidates' pods + pending over the full node set), so
+        # alternating provisioning and simulation solves through ONE
+        # ClusterEncoding would thrash its prior-snapshot fast path. The
+        # scenario paths get their own encoding + device store: repeated
+        # searches within a reconcile pass (multi-node then single-node)
+        # hit the content-hash REUSE outcome instead of re-paying the
+        # ~130 ms cold encode per fresh environment.
+        self.scenario_cluster = enc.ClusterEncoding()
+        self.scenario_device_store = None
         # pure per-node scheduler model inputs (taints, daemon remainder,
         # label requirements) keyed by object resource versions — catalog-
         # independent, so it survives fingerprint resets. Consolidation
@@ -113,17 +124,31 @@ class EncodeCache:
         return fp
 
     @staticmethod
-    def fingerprint(templates, its_by_pool, daemon_overhead, pool_limits):
-        tpl = tuple(
-            (
-                nct.node_pool_name,
-                nct.node_pool_weight,
-                tuple(sorted(nct.labels.items())),
-                tuple((t.key, t.value, t.effect) for t in nct.taints),
-                repr(nct.requirements),
-            )
-            for nct in templates
+    def _template_fp(nct) -> tuple:
+        """Content tuple for one template. repr(requirements) omits
+        min_values (Requirement.__repr__ prints key/operator/values only),
+        and the dense minValues tables (p_mvmin/t_mvoh) live in the leased
+        static cache — so the floors are fingerprinted explicitly or a
+        NodePool minValues edit would serve stale floors until an
+        unrelated catalog change."""
+        return (
+            nct.node_pool_name,
+            nct.node_pool_weight,
+            tuple(sorted(nct.labels.items())),
+            tuple((t.key, t.value, t.effect) for t in nct.taints),
+            repr(nct.requirements),
+            tuple(
+                sorted(
+                    (r.key, r.min_values)
+                    for r in nct.requirements
+                    if r.min_values is not None
+                )
+            ),
         )
+
+    @staticmethod
+    def fingerprint(templates, its_by_pool, daemon_overhead, pool_limits):
+        tpl = tuple(EncodeCache._template_fp(nct) for nct in templates)
         # content-addressed (NOT id()): the gRPC sidecar decodes a fresh
         # InstanceType object per request, and the cache must still hit on
         # an unchanged catalog
@@ -165,16 +190,7 @@ class EncodeCache:
         offerings come as new objects, never in-place flips. Strong refs
         to the keyed objects are held so a recycled id can never alias."""
         prekey = (
-            tuple(
-                (
-                    nct.node_pool_name,
-                    nct.node_pool_weight,
-                    tuple(sorted(nct.labels.items())),
-                    tuple((t.key, t.value, t.effect) for t in nct.taints),
-                    repr(nct.requirements),
-                )
-                for nct in templates
-            ),
+            tuple(EncodeCache._template_fp(nct) for nct in templates),
             tuple(
                 (pool, tuple(map(id, its)))
                 for pool, its in sorted(its_by_pool.items())
@@ -211,16 +227,26 @@ class EncodeCache:
             # the warm encoding and device buffers are catalog-derived:
             # a changed catalog invalidates both (next encode is full)
             self.cluster.invalidate("catalog changed")
+            self.scenario_cluster.invalidate("catalog changed")
             if self.device_store is not None:
                 self.device_store.reset()
+            if self.scenario_device_store is not None:
+                self.scenario_device_store.reset()
         return self.vocab, self.cache
 
-    def lease_device_store(self):
+    def lease_device_store(self, scenario: bool = False):
         """The device-resident argument store (created on first use so
-        the native backend never imports residency/jax machinery)."""
-        if self.device_store is None:
-            from .residency import DeviceResidentArgs
+        the native backend never imports residency/jax machinery).
+        ``scenario`` selects the scenario-side store (paired with
+        ``scenario_cluster``) so consolidation searches don't evict the
+        provisioning path's buffers and vice versa."""
+        from .residency import DeviceResidentArgs
 
+        if scenario:
+            if self.scenario_device_store is None:
+                self.scenario_device_store = DeviceResidentArgs()
+            return self.scenario_device_store
+        if self.device_store is None:
             self.device_store = DeviceResidentArgs()
         return self.device_store
 
@@ -263,6 +289,7 @@ def _clone_existing_node(en):
     c.pods = list(en.pods)
     c.requests = dict(en.requests)
     c.requirements = Requirements(*en.requirements.values())
+    c.volume_usage = en.volume_usage.copy() if en.volume_usage else None
     return c
 
 
@@ -343,10 +370,24 @@ class TpuSolver:
         self.last_encode_reused = False
         self.last_delta_rows = 0
         self._last_incremental = False
+        # sequential-fallback telemetry (ISSUE 10): how often work fell off
+        # the dense path for REPRESENTABILITY reasons — oracle-routed pods,
+        # gated solve routes, scenario-batch declines. The reference
+        # configs must drive this to zero (bench.py fallback_solves column;
+        # scheduler_sequential_fallback_total in the provisioner).
+        self.fallback_solves = 0
+        self.last_fallback_reasons: List[str] = []
+        # per-solve volume routing state (prepare_volume_routing)
+        self._vol_resolved: Dict[str, list] = {}
         # two-slot async dispatch window: a submitted kernel computes
         # while the host encodes the next batch or decodes the previous
         # one (solver/residency.py)
         self._queue = DispatchQueue()
+
+    def _note_fallback(self, reason: str) -> None:
+        self.fallback_solves += 1
+        self.last_fallback_reasons.append(reason)
+        obs.event("solver.sequential_fallback", reason=reason)
 
     # -- solve ------------------------------------------------------------
 
@@ -406,14 +447,18 @@ class TpuSolver:
         return [np.asarray(x) for x in jax.device_get(out)]
 
     def _delta_fallback(self, reason: str) -> None:
-        """Corrupt-delta half-step: invalidate the warm cluster encoding
+        """Corrupt-delta half-step: invalidate the warm cluster encodings
         and the device-resident buffers so the retry re-encodes and
         re-transfers from scratch. Half a rung: the kernel breaker is NOT
         tripped — only the incremental state is shed."""
         self._shared_cache.cluster.invalidate(reason)
-        store = self._shared_cache.device_store
-        if store is not None:
-            store.reset()
+        self._shared_cache.scenario_cluster.invalidate(reason)
+        for store in (
+            self._shared_cache.device_store,
+            self._shared_cache.scenario_device_store,
+        ):
+            if store is not None:
+                store.reset()
         health = self.config.health
         if health is not None:
             health.delta_fallback(reason)  # counts + publishes the event
@@ -459,23 +504,16 @@ class TpuSolver:
             and self.oracle.reserved_offering_mode
             == RESERVED_OFFERING_MODE_STRICT
         ):
-            # strict reservation policy raises mid-Add and blocks pool
-            # fallback (scheduler.py:244-258) — inherently sequential;
-            # the kernel ledger covers the default fallback mode
+            # DOCUMENTED REMNANT GATE (ISSUE 10): strict reservation policy
+            # raises mid-Add and blocks pool fallback (scheduler.py:244-258)
+            # — inherently sequential; the kernel ledger covers the default
+            # fallback mode. minValues pools, volumes, and topology all ride
+            # the kernel now (dense distinct-value counting, attach-slot
+            # ledger columns, domain counters) — this mode and pod-side
+            # sequential state (host ports, preference relaxation, Gt/Lt,
+            # pod-level minValues) are what remains of the old fallback.
             self._audit_rung = "oracle"
-            return self.oracle.solve(pods)
-        mv_templates = [
-            nct
-            for nct in self.oracle.templates
-            if nct.requirements.has_min_values()
-        ]
-        if mv_templates and self._min_values_reachable(mv_templates, pods):
-            # minValues is enforced per-Add by the oracle's in-flight claim
-            # (inflight.py:82; types.go SatisfiesMinValues): each added pod
-            # may narrow the claim's distinct values below the floor. The
-            # kernel's bulk fills narrow options the same way but never
-            # count distinct values, so minValues pools serialize host-side.
-            self._audit_rung = "oracle"
+            self._note_fallback("strict-reservation-mode")
             return self.oracle.solve(pods)
         groups, rest = enc.partition_and_group(
             pods,
@@ -484,7 +522,10 @@ class TpuSolver:
             # bootstrap inputs: a reservation ledger makes offering
             # availability evolve across scan steps
             merge_bootstrap_affinity=not self.oracle.reserved_capacity_enabled,
+            volume_shapes=self.prepare_volume_routing(pods),
         )
+        if rest:
+            self._note_fallback(f"oracle-routed-pods:{len(rest)}")
 
         if rest and _LOG.isEnabledFor(logging.DEBUG):
             for p in rest:
@@ -573,32 +614,66 @@ class TpuSolver:
         # oracle claims are already truncated, so this is a no-op for them
         return results.truncate_instance_types()
 
-    def _min_values_reachable(self, mv_templates, pods) -> bool:
-        """True when any batch pod could land on a minValues pool — only
-        then must the batch serialize host-side. A minValues pool the batch
-        cannot reach (taints it doesn't tolerate, requirements it can't
-        meet) leaves the fast path on (the kernel's claims never open
-        there for these pods anyway)."""
-        from ..api import taints as taints_mod
-        from ..api.requirements import pod_requirements
+    def prepare_volume_routing(
+        self, pods: Sequence[Pod]
+    ) -> Optional[Dict[str, tuple]]:
+        """Per-solve volume resolution for the dense attach-slot ledger.
 
-        for p in pods:
-            reqs = pod_requirements(p)  # built once per pod, not per pair
-            for nct in mv_templates:
-                if (
-                    taints_mod.tolerates(nct.taints, p.spec.tolerations)
-                    is not None
-                ):
-                    continue
-                if (
-                    nct.requirements.compatible(
-                        reqs, labels_mod.WELL_KNOWN_LABELS
-                    )
-                    is not None
-                ):
-                    continue
-                return True
-        return False
+        Returns the ``volume_shapes`` map partition_and_group consumes:
+        uid -> ((shape key), {synthetic resource: request}) for every pod
+        whose volumes the kernel can ledger — resolvable, counted volumes
+        that are FRESH (not attached to any node) and UNSHARED within the
+        batch, so "one pod = len(volumes) new attach slots per driver" is
+        exact. Everything else (missing PVC, RWX sharing, re-attachment of
+        an existing volume, no resolver) routes host-side. Zonal
+        constraints were already injected as node affinity upstream
+        (VolumeTopology.inject), so only the attach accounting lives here.
+        """
+        resolver = getattr(self.oracle, "volume_resolver", None)
+        if resolver is None:
+            return None
+        candidates = [p for p in pods if p.spec.volumes]
+        if not candidates:
+            return None
+        self._vol_resolved = {}
+        seen: Dict[tuple, int] = {}
+        resolved_by_uid: Dict[str, list] = {}
+        for p in candidates:
+            resolved, err = resolver.resolve(p)
+            if err is not None:
+                continue
+            resolved_by_uid[p.uid] = resolved
+            for r in resolved:
+                if r[0]:
+                    seen[(r[0], r[1])] = seen.get((r[0], r[1]), 0) + 1
+        # attached (driver, vid) pairs across the cluster, computed ONCE:
+        # the admission loop below must stay O(volumes), not O(volumes x
+        # nodes), on the hot provisioning path
+        attached: set = set()
+        for en in self.oracle.existing_nodes:
+            if en.volume_usage is not None:
+                attached.update(en.volume_usage.attached())
+        out: Dict[str, tuple] = {}
+        for p in candidates:
+            resolved = resolved_by_uid.get(p.uid)
+            if resolved is None:
+                continue
+            counted = [(r[0], r[1]) for r in resolved if r[0]]
+            if any(seen[c] > 1 for c in counted):
+                continue  # shared volume: distinct-id dedup breaks the ledger
+            if any(c in attached for c in counted):
+                continue  # already attached somewhere: per-node dedup differs
+            per_driver: Dict[str, int] = {}
+            for d, _vid in counted:
+                per_driver[d] = per_driver.get(d, 0) + 1
+            shape = tuple(sorted(per_driver.items()))
+            reqs = {
+                enc.VOL_RES_PREFIX + d: n * res.MILLI
+                for d, n in per_driver.items()
+            }
+            out[p.uid] = (shape, reqs)
+            self._vol_resolved[p.uid] = resolved
+        return out or None
 
     # -- scenario axis ----------------------------------------------------
 
@@ -614,13 +689,15 @@ class TpuSolver:
 
         The solver must have been constructed with the FULL node set (no
         candidates pre-removed); each scenario masks its removed nodes and
-        activates its workload subset over one shared encoding. Returns
-        per-scenario Results aligned with ``scenarios``, or None when the
-        batch cannot be represented scenario-batched — any workload or
-        solver state whose encoding would differ per scenario (topology
-        constraints change priors, reservations and minValues serialize,
-        oracle-routed pods need the host loop) — in which case the caller
-        falls back to per-scenario solve()s. ``last_scenario_dispatches``
+        activates its workload subset over one shared encoding — with
+        topology priors batched as per-scenario contribution deltas
+        (_plan_scenario_topology) and the reservation ledger replayed per
+        scenario. Returns per-scenario Results aligned with ``scenarios``,
+        or None when the batch cannot be represented scenario-batched (the
+        documented remnants: oracle-routed pods, strict-mode reservations,
+        topology shapes the prior deltas cannot express) — in which case
+        the caller falls back to per-scenario solve()s and the decline is
+        counted in ``fallback_solves``. ``last_scenario_dispatches``
         records the kernel dispatch count of the last successful call.
 
         Internally split into :meth:`submit_scenarios` (host-side prep +
@@ -648,9 +725,15 @@ class TpuSolver:
             return None
         if self._resolve_mesh() is not None:
             return None
-        if self.oracle.reserved_capacity_enabled:
-            # the reservation ledger's holdings would have to merge back
-            # into ONE oracle ReservationManager across scenarios
+        if (
+            self.oracle.reserved_capacity_enabled
+            and self.oracle.reserved_offering_mode
+            == RESERVED_OFFERING_MODE_STRICT
+        ):
+            # documented remnant: strict mode raises mid-Add (see
+            # _solve_routed) — the default fallback mode rides the batched
+            # ledger with a fresh per-scenario replay in decode
+            self._note_fallback("scenario-strict-reservation")
             return None
         # union workload across scenarios, deduped by pod identity
         union: List[Pod] = []
@@ -660,26 +743,36 @@ class TpuSolver:
                 if p.uid not in seen:
                     seen.add(p.uid)
                     union.append(p)
-        mv_templates = [
-            nct
-            for nct in self.oracle.templates
-            if nct.requirements.has_min_values()
-        ]
-        if mv_templates and self._min_values_reachable(mv_templates, union):
-            return None
         topo = self.oracle.topology
-        if topo.topology_groups or topo.inverse_topology_groups:
-            # topology priors (domain counts, per-node selected-pod counts)
-            # are computed from the nodes present — they would differ per
-            # scenario, and the shared encoding cannot mask them
-            return None
         if not self.oracle.templates:
             return None
-        groups, rest = enc.partition_and_group(union, topology=topo)
-        if rest or any(g.topo is not None for g in groups):
+        groups, rest = enc.partition_and_group(
+            union,
+            topology=topo,
+            merge_bootstrap_affinity=not self.oracle.reserved_capacity_enabled,
+        )
+        if rest:
+            self._note_fallback(f"scenario-oracle-routed:{len(rest)}")
             return None
         if not groups:
             return {"noop": True, "scenarios": list(scenarios)}
+        # topology priors (domain counts, per-node selected-pod counts)
+        # depend on which candidate nodes remain: bound pods of an INCLUDED
+        # candidate count as priors, an excluded one's ride the workload.
+        # The plan decomposes them into per-candidate contribution deltas
+        # applied to per-scenario copies of (g_dprior, n_hcnt, nh_cnt0,
+        # dd0) — the kernel math is untouched, the scenario vmap simply
+        # maps four more inputs (ops/solve.py SCENARIO_TOPO_BATCHED_ARGS).
+        # Shapes the deltas cannot express exactly decline to the
+        # sequential reference (documented remnants: candidate pods owning
+        # anti-affinity or selected by affinity-type / statically-folded
+        # constraints, out-of-catalog candidate domains).
+        topo_plan = None
+        if topo.topology_groups or topo.inverse_topology_groups:
+            topo_plan = self._plan_scenario_topology(scenarios, groups, topo)
+            if topo_plan is None:
+                self._note_fallback("scenario-topology-unrepresentable")
+                return None
 
         # the duration clock starts at submit so a prefetched batch's
         # audit record reports wall time of the whole decision, overlap
@@ -689,11 +782,9 @@ class TpuSolver:
         fault_mark = self._fault_log_mark()
         with obs.span("solve.encode", groups=len(groups)):
             snap, avail, nmax_hint, lease_cache, delta = self._encode_batch(
-                groups
+                groups, scenario=True
             )
         a_tzc, res_cap0, a_res = avail
-        if res_cap0.shape[0]:
-            return None
         fit = self._fit_matrix(snap)
         nmax = self._select_nmax(snap, fit, nmax_hint)
         # no G floor here, unlike _solve_fast: under vmap the empty-step
@@ -752,17 +843,21 @@ class TpuSolver:
             jnp.int16 if self._fill_bound(snap, fit) < 2**15 else jnp.int32
         )
         # device residency over the SHARED encoding; the per-scenario
-        # stacks (g_count, n_tol) are rebuilt per call and ride the
+        # stacks (g_count, n_tol — plus the topology prior arrays when the
+        # plan carries corrections) are rebuilt per call and ride the
         # dispatch as host arrays
-        store = self._shared_cache.lease_device_store()
+        batch_topo = bool(topo_plan and topo_plan["batch"])
+        skip = {"g_count", "n_tol"}
+        if batch_topo:
+            skip |= {"g_dprior", "n_hcnt", "nh_cnt0", "dd0"}
+        store = self._shared_cache.lease_device_store(scenario=True)
         with obs.span(
             "solve.transfer",
             reused=bool(delta.reused),
             delta_rows=int(delta.delta_rows),
         ):
             args = store.stage(
-                enc.SOLVE_ARG_NAMES, args, delta,
-                skip=frozenset({"g_count", "n_tol"}),
+                enc.SOLVE_ARG_NAMES, args, delta, skip=frozenset(skip)
             )
             if obs.active() is not None:
                 jax.block_until_ready(
@@ -770,9 +865,18 @@ class TpuSolver:
                 )
         args[idx_g_count] = g_count_s
         args[idx_n_tol] = n_tol_s
+        if batch_topo:
+            gp_s, nh_s, nh0_s, dd0_s = self._scenario_topo_arrays(
+                topo_plan, snap, snap_run, scenarios, S
+            )
+            args[enc.SOLVE_ARG_NAMES.index("g_dprior")] = gp_s
+            args[enc.SOLVE_ARG_NAMES.index("n_hcnt")] = nh_s
+            args[enc.SOLVE_ARG_NAMES.index("nh_cnt0")] = nh0_s
+            args[enc.SOLVE_ARG_NAMES.index("dd0")] = dd0_s
         incremental = store.last_incremental or delta.reused
 
         token = {
+            "batch_topo": batch_topo,
             "scenarios": list(scenarios),
             "snap": snap,
             "snap_run": snap_run,
@@ -816,9 +920,208 @@ class TpuSolver:
             "scenarios",
             lambda: dispatch_scenarios_packed(
                 *args, nmax=nmax, fills_dtype=token["fills_dtype"],
+                batch_topo=token.get("batch_topo", False),
                 **token["statics"],
             ),
         )
+
+    def _plan_scenario_topology(self, scenarios, groups, topo):
+        """Per-candidate topology-prior contribution plan for one scenario
+        batch, or None when the deltas cannot express the sequential
+        reference exactly (the caller declines to per-probe solves).
+
+        A scenario's priors differ from the shared (union) encoding only
+        by the bound pods of its INCLUDED candidates: the union topology
+        treats every candidate's reschedulable pods as pending, so for a
+        scenario keeping candidate c, c's pods must be re-counted as
+        priors. Each such pod counts toward exactly the constraints whose
+        selector matches it — its own group's self-selecting dynamic
+        state, and the shared descriptors the group owns or contributes
+        to — through four channels:
+
+          nh   n_hcnt[row, gi]     private hostname cap priors
+          nh0  nh_cnt0[row, slot]  shared hostname carry priors
+          gpr  g_dprior[gi, vid]   private domain-spread priors
+          dd0  dd0[slot, vid]      shared domain carry init
+
+        Declines (documented remnants): candidate pods owning
+        anti-affinity (the sequential path gates them through the oracle's
+        inverse machinery), candidate pods selected by affinity-type or
+        statically-folded constraints (their folds baked union counts
+        in), hostname folds over several constraints, haff pins, and
+        candidate nodes carrying out-of-catalog spread domains (their
+        registration would differ per scenario)."""
+        from ..scheduling.topology import TopologyType
+
+        cand_pids: set = set()
+        for sc in scenarios:
+            cand_pids |= set(sc.excluded_provider_ids)
+        if not cand_pids:
+            return {"by_pid": {}, "cand_pids": cand_pids, "batch": False}
+        row_by_name: Dict[str, tuple] = {}
+        for ni, en in enumerate(self.oracle.existing_nodes):
+            row_by_name[en.name] = (
+                ni, getattr(en.state_node, "provider_id", None), en
+            )
+        # candidate-bound pods of the union, aggregated per (pid, row, gi)
+        per: Dict[tuple, int] = {}
+        for gi, g in enumerate(groups):
+            for p in g.pods:
+                nn = p.spec.node_name
+                if not nn:
+                    continue
+                ent = row_by_name.get(nn)
+                if ent is None or ent[1] not in cand_pids:
+                    continue
+                if p.spec.pod_anti_affinity:
+                    return None
+                per[(ent[1], ent[0], gi)] = per.get(
+                    (ent[1], ent[0], gi), 0
+                ) + 1
+        # out-of-catalog candidate domains: removing the node would
+        # unregister the domain in the sequential path, shifting the
+        # spread min — checked on candidate NODES (registration is
+        # node-based), pods or not
+        dyn_keys = {
+            g.topo.dkey
+            for g in groups
+            if g.topo is not None
+            and g.topo.dmode
+            in (enc.DMODE_SPREAD, enc.DMODE_GATE_SPREAD)
+            and g.topo.dkey
+        }
+        if dyn_keys:
+            cand_rows = {
+                ni
+                for ni, pid, _en in row_by_name.values()
+                if pid in cand_pids
+            }
+            for key in dyn_keys:
+                catalog = topo.domain_groups.get(key)
+                universe = catalog.domains() if catalog is not None else set()
+                for ni in cand_rows:
+                    en = self.oracle.existing_nodes[ni]
+                    dom = enc._node_single_value(en, key)
+                    if dom is not None and dom not in universe:
+                        return None
+        if not per:
+            return {"by_pid": {}, "cand_pids": cand_pids, "batch": False}
+        static_folds = list(getattr(topo, "kernel_static_folds", ()))
+        aff_tgs = [
+            tg
+            for tg in topo.topology_groups.values()
+            if tg.type is TopologyType.POD_AFFINITY
+        ]
+        h_slots, d_slots = enc.shared_slot_ids(groups)
+        by_pid: Dict[str, list] = {}
+        sel_memo: Dict[tuple, bool] = {}
+        for (pid, ni, gi), m in sorted(per.items()):
+            g = groups[gi]
+            rep = g.pods[0]
+            en = self.oracle.existing_nodes[ni]
+            for tg in static_folds + aff_tgs:
+                memo_key = (gi, id(tg))
+                hit = sel_memo.get(memo_key)
+                if hit is None:
+                    hit = sel_memo[memo_key] = tg.selects(rep)
+                if hit:
+                    return None
+            t = g.topo
+            if t is None:
+                continue  # selected by nothing admitted: no counting
+            if t.haff or t.dmode == enc.DMODE_AFFINITY:
+                return None
+            ch = by_pid.setdefault(pid, [])
+            taints = en.cached_taints
+            node_reqs = en.requirements
+            if t.host_cap is not None:
+                if len(t.src_h) != 1 or t.host_nsrc != 1:
+                    return None
+                if t.src_h[0].node_filter.matches(taints, node_reqs):
+                    ch.append(("nh", ni, gi, m))
+            desc = t.shared_h if t.h_self else None
+            if desc is not None:
+                if desc.tg is None:
+                    return None
+                if desc.tg.node_filter.matches(taints, node_reqs):
+                    ch.append(("nh0", ni, h_slots[id(desc)], m))
+            for desc in t.contrib_h:
+                if desc.tg is None:
+                    return None
+                if desc.tg.node_filter.matches(taints, node_reqs):
+                    ch.append(("nh0", ni, h_slots[id(desc)], m))
+            dom_descs = []
+            if t.dmode == enc.DMODE_SPREAD and t.shared_d is None:
+                if t.src_d is None:
+                    return None
+                dom = enc._node_single_value(en, t.dkey)
+                if (
+                    dom is not None
+                    and dom in t.dreg
+                    and t.src_d.node_filter.matches(taints, node_reqs)
+                ):
+                    axis = 0 if t.dkey == labels_mod.TOPOLOGY_ZONE else 1
+                    ch.append(("gpr", gi, axis, ni, m))
+            if t.shared_d is not None and t.dmode == enc.DMODE_SPREAD:
+                dom_descs.append(t.shared_d)
+            for desc in t.contrib_d:
+                if desc.mode != enc.DMODE_SPREAD:
+                    return None  # affinity options evolve: sequential
+                dom_descs.append(desc)
+            for desc in dom_descs:
+                if desc.tg is None:
+                    return None
+                dom = enc._node_single_value(en, desc.key)
+                if (
+                    dom is not None
+                    and dom in desc.reg
+                    and desc.tg.node_filter.matches(taints, node_reqs)
+                ):
+                    axis = 0 if desc.key == labels_mod.TOPOLOGY_ZONE else 1
+                    ch.append(("dd0", d_slots[id(desc)], axis, ni, m))
+        return {
+            "by_pid": by_pid,
+            "cand_pids": cand_pids,
+            "batch": any(by_pid.values()),
+        }
+
+    def _scenario_topo_arrays(self, plan, snap, snap_run, scenarios, S):
+        """Per-scenario copies of the topology prior arrays with each
+        scenario's included-candidate contributions applied (see
+        _plan_scenario_topology). Domain value ids come from the shared
+        encoding's node rows — the correction's domain IS the candidate
+        node's own zone/capacity-type slot."""
+        g_dprior_s = np.repeat(snap_run.g_dprior[None], S, axis=0)
+        n_hcnt_s = np.repeat(snap_run.n_hcnt[None], S, axis=0)
+        nh0_s = np.repeat(snap_run.nh_cnt0[None], S, axis=0)
+        dd0_s = np.repeat(snap_run.dd0[None], S, axis=0)
+        by_pid = plan["by_pid"]
+        cand_pids = plan["cand_pids"]
+        for si, sc in enumerate(scenarios):
+            for pid in sorted(cand_pids - set(sc.excluded_provider_ids)):
+                for chan in by_pid.get(pid, ()):
+                    kind = chan[0]
+                    if kind == "nh":
+                        _, ni, gi, m = chan
+                        n_hcnt_s[si, ni, gi] += m
+                    elif kind == "nh0":
+                        _, ni, slot, m = chan
+                        nh0_s[si, ni, slot] += m
+                    elif kind == "gpr":
+                        _, gi, axis, ni, m = chan
+                        vid = (
+                            snap.n_dzone[ni] if axis == 0 else snap.n_dct[ni]
+                        )
+                        if vid >= 0:
+                            g_dprior_s[si, gi, vid] += m
+                    else:  # dd0
+                        _, slot, axis, ni, m = chan
+                        vid = (
+                            snap.n_dzone[ni] if axis == 0 else snap.n_dct[ni]
+                        )
+                        if vid >= 0:
+                            dd0_s[si, slot, vid] += m
+        return g_dprior_s, n_hcnt_s, nh0_s, dd0_s
 
     def collect_scenarios(self, token) -> Optional[List[Results]]:
         """Drain, guard, decode, and audit a batch submitted by
@@ -1266,7 +1569,7 @@ class TpuSolver:
         if violations:
             raise SolverIntegrityError(violations)
 
-    def _encode_batch(self, groups: List[enc.PodGroup]):
+    def _encode_batch(self, groups: List[enc.PodGroup], scenario: bool = False):
         """Encode ``groups`` against the shared cache. Returns
         (snap, (a_tzc, res_cap0, a_res), nmax_hint, cache, delta) —
         ``cache`` is the LEASED dict this encode ran against; post-solve
@@ -1275,7 +1578,9 @@ class TpuSolver:
         catalog may have replaced — a stale hint written into a fresh
         catalog's dict would mis-size that catalog's first NMAX).
         ``delta`` is the ClusterEncoding's EncodeDelta for this encode
-        (what the device-residency staging transfers)."""
+        (what the device-residency staging transfers). ``scenario``
+        selects the scenario-side ClusterEncoding so consolidation
+        searches warm independently of the provisioning path."""
         templates = self.oracle.templates
         its_by_pool = {
             nct.node_pool_name: nct.instance_type_options for nct in templates
@@ -1285,7 +1590,11 @@ class TpuSolver:
                 templates, its_by_pool, self.oracle.daemon_overhead,
                 self.pool_limits,
             )
-            cluster = self._shared_cache.cluster
+            cluster = (
+                self._shared_cache.scenario_cluster
+                if scenario
+                else self._shared_cache.cluster
+            )
             snap = enc.encode(
                 groups,
                 templates,
@@ -1365,6 +1674,18 @@ class TpuSolver:
             if env is not None:
                 cfg = env == "1"
         if cfg is False or res_cap0.shape[0] != 0:
+            return None
+        if (
+            cfg is not True
+            and snap_run.p_mvmin.shape[1]
+            and bool((snap_run.g_dmode > 0).any())
+        ):
+            # minValues + domain-dynamic groups auto-route to pack(): the
+            # classed kernel's maintained mv summary is exact under
+            # same-request decrements but approximates across in-class
+            # domain PINS, where pack() recomputes the cap from the
+            # narrowed mask each step. Pin-free minValues batches keep the
+            # classed amortization.
             return None
         out = enc.class_partition(
             snap_run,
@@ -1652,6 +1973,14 @@ class TpuSolver:
             en.pods.extend(pods)
             en.requests = res.merge(en.requests, *(p.spec.requests for p in pods))
             en.requirements.add(*g.requirements.values())
+            # attach-slot ledger commit: mirror ExistingNode.add's
+            # volume_usage.add so a subsequent oracle pass (and the next
+            # encode's remaining-slot columns) see the attachments
+            if en.volume_usage is not None:
+                for p in pods:
+                    rv = self._vol_resolved.get(p.uid)
+                    if rv:
+                        en.volume_usage.add(p, rv)
 
         claims: List[DecodedClaim] = []
         claim_by_slot: Dict[int, DecodedClaim] = {}

@@ -53,6 +53,15 @@ DMODE_GATE_AFF = 4
 # therefore ride the TPU as a dense domain axis (solver/vocab.py)
 DOMAIN_KEYS = (labels_mod.TOPOLOGY_ZONE, labels_mod.CAPACITY_TYPE_LABEL_KEY)
 _DRANK_NONE = 2**28
+
+# synthetic per-CSI-driver resource columns: pods with volumes consume
+# attach slots as an ordinary resource the pack phase ledgers (requests
+# ceil per pod, node capacity = remaining CSINode attach limit). Fresh
+# claims have no CSINode yet, so their columns carry the no-limit
+# sentinel — exactly the oracle's "limits only apply to existing nodes"
+# (scheduling/volumeusage.py).
+VOL_RES_PREFIX = "ktpu.io/vol-"
+VOL_UNLIMITED = float(2**24)  # float32-exact; far above any attach limit
 # per-pod memoized routing verdict sentinel; a STRING so it survives
 # copy.deepcopy of a pod (an object() sentinel would deep-copy to a new
 # identity and masquerade as a group key)
@@ -74,6 +83,7 @@ _GN_FREE_FIELDS = frozenset({
     "o_avail", "o_zone", "o_ct", "o_price",
     "p_def", "p_neg", "p_mask", "p_daemon", "p_limit", "p_has_limit",
     "p_titype_ok",
+    "p_mvmin", "t_mvoh",
     "dd0", "dtg_key", "well_known",
 })
 
@@ -81,6 +91,11 @@ _GN_FREE_FIELDS = frozenset({
 def _unit_divisor(resource_name: str) -> int:
     if resource_name == res.CPU:
         return 1  # milli-cpu
+    if resource_name.startswith(VOL_RES_PREFIX):
+        # attach-slot columns are whole-unit counts regardless of the CSI
+        # driver's NAME — "pd.csi.storage.gke.io" must not quantize as
+        # memory-like or the kernel over-packs past the attach limit
+        return res.MILLI
     if any(tag in resource_name for tag in _MEMORY_LIKE):
         return 2**20 * res.MILLI  # MiB
     return res.MILLI  # whole units (pods, gpus, ...)
@@ -130,17 +145,23 @@ class SharedHostTG:
     """A hostname-keyed constraint shared by several pod groups (e.g. one
     Deployment's anti-affinity across request shapes). Counts live in the
     kernel carry, indexed by the slot encode() assigns; ``counts`` are the
-    cluster priors per hostname."""
+    cluster priors per hostname. ``tg`` back-references the oracle
+    TopologyGroup this descriptor distilled from (host-side only — never
+    encoded; the scenario axis uses it to re-derive per-scenario priors)."""
 
     cap: int
     counts: Dict[str, int] = field(default_factory=dict)
+    tg: object = None
+
+    def content(self) -> tuple:
+        return (self.cap, tuple(sorted(self.counts.items())))
 
 
 @dataclass
 class SharedDomainTG:
     """A zone/capacity-type-keyed constraint shared by several pod groups.
     Descriptor fields mirror TopoSpec's d* fields; the evolving counts ride
-    the kernel's domain carry."""
+    the kernel's domain carry. ``tg`` is the host-side oracle back-ref."""
 
     key: str
     mode: int
@@ -148,6 +169,13 @@ class SharedDomainTG:
     min0: bool = False
     prior: Dict[str, int] = field(default_factory=dict)
     reg: frozenset = frozenset()
+    tg: object = None
+
+    def content(self) -> tuple:
+        return (
+            self.key, self.mode, self.skew, self.min0,
+            tuple(sorted(self.prior.items())), tuple(sorted(self.reg)),
+        )
 
 
 @dataclass
@@ -203,6 +231,33 @@ class TopoSpec:
     # constraint — the oracle counts them at record(), topology.py:491-498)
     contrib_h: List[SharedHostTG] = field(default_factory=list)
     contrib_d: List[SharedDomainTG] = field(default_factory=list)
+    # host-side oracle back-refs (never encoded): the TopologyGroups the
+    # dynamic state above distilled from — ``src_h`` the self-selecting
+    # hostname constraints folded into host_cap/host_counts, ``src_d`` the
+    # private domain-dynamic constraint. The scenario-batched axis walks
+    # these to re-derive per-scenario priors when candidate nodes' bound
+    # pods count toward a constraint.
+    src_h: List[object] = field(default_factory=list)
+    src_d: object = None
+    # total hostname constraints folded into host_cap/host_counts (self +
+    # gate): the scenario corrections are additive only for a single-source
+    # fold, so the count gates representability
+    host_nsrc: int = 0
+
+    def content_sig(self) -> tuple:
+        """Canonical content signature for the delta-encode contract: two
+        groups whose sig (plus slot structure, added by the caller) match
+        encode to identical g_* topology rows."""
+        return (
+            self.host_cap,
+            tuple(sorted(self.host_counts.items())),
+            self.haff,
+            tuple(sorted(self.haff_prior.items())),
+            self.dmode, self.dkey, self.dskew, self.dmin0,
+            tuple(sorted(self.dprior.items())),
+            tuple(sorted(self.dreg)),
+            self.h_self, self.h_capval,
+        )
 
 
 @dataclass
@@ -298,16 +353,22 @@ def _sel_signature(pod: Pod, sel_keys: frozenset) -> tuple:
     )
 
 
-def is_tensorizable(pod: Pod, allow_topology: bool = False) -> bool:
+def is_tensorizable(
+    pod: Pod, allow_topology: bool = False, allow_volumes: bool = False
+) -> bool:
     """Pods the TPU fast path handles; the rest route to the host oracle.
 
     ``allow_topology`` admits the topology shapes the kernel models —
     hostname-keyed spread / anti-affinity (per-entity caps) and zone- or
     capacity-type-keyed spread / pod-affinity (domain quotas / mask gates)
     — subject to the global cross-group checks in partition_and_group (a
-    Topology context is required for those). Everything else with
-    sequential state (host ports, volumes, preference relaxation, Gt/Lt)
-    stays host-side."""
+    Topology context is required for those). ``allow_volumes`` admits pods
+    whose volumes the driver has resolved into attach-slot requests
+    (driver.prepare_volume_routing: fresh non-shared volumes become
+    synthetic resource columns the pack-phase ledger consumes; zonal
+    constraints were already injected as node affinity upstream).
+    Everything else with sequential state (host ports, preference
+    relaxation, Gt/Lt) stays host-side."""
     spec = pod.spec
     if not allow_topology and (
         spec.topology_spread_constraints or spec.pod_anti_affinity or spec.pod_affinity
@@ -345,7 +406,7 @@ def is_tensorizable(pod: Pod, allow_topology: bool = False) -> bool:
             return False
     if spec.preferred_pod_affinity or spec.preferred_pod_anti_affinity:
         return False
-    if spec.host_ports or spec.volumes:
+    if spec.host_ports or (spec.volumes and not allow_volumes):
         return False
     if spec.node_affinity is not None:
         if spec.node_affinity.preferred or len(spec.node_affinity.required) > 1:
@@ -431,6 +492,15 @@ class EncodedSnapshot:
     p_has_limit: np.ndarray  # [P] bool
     p_titype_ok: np.ndarray  # [P, T] bool  template prefilter
     p_tol: np.ndarray  # [P, G] bool  group tolerates template taints
+    # dense minValues: MV = distinct requirement keys carrying min_values
+    # across templates, W = padded distinct-value bound over the catalog.
+    # p_mvmin[p, j] is template p's floor for key slot j (0 = none);
+    # t_mvoh[t, j, w] marks instance type t offering catalog value w of key
+    # slot j (the raw per-type value union satisfies_min_values counts,
+    # cloudprovider/types.go:155-233). MV == 0 traces the whole minValues
+    # machinery out of the kernels.
+    p_mvmin: np.ndarray  # [P, MV] int32
+    t_mvoh: np.ndarray  # [T, MV, W] bool
 
     # existing nodes (priority order: initialized first, then name)
     n_avail: np.ndarray  # [N, R] f32 (available to new pods)
@@ -545,6 +615,7 @@ class EncodedSnapshot:
             self.n_dzone, self.n_dct,
             self.nh_cnt0, self.dd0, self.dtg_key,
             self.well_known,
+            self.p_mvmin, self.t_mvoh,
         )
 
 
@@ -569,10 +640,77 @@ SOLVE_ARG_NAMES = (
     "n_dzone", "n_dct",
     "nh_cnt0", "dd0", "dtg_key",
     "well_known",
+    "p_mvmin", "t_mvoh",
 )
 
 
 # -- incremental (delta) encoding -------------------------------------------
+
+
+def shared_slot_ids(
+    groups: Sequence["PodGroup"],
+) -> Tuple[Dict[int, int], Dict[int, int]]:
+    """(hostname-slot, domain-slot) maps keyed by id(descriptor), assigned
+    by first appearance over the group walk — EXACTLY _encode_groups'
+    assignment, so callers (the scenario-batched prior corrections, the
+    delta content tags) address the same carry columns the kernel reads."""
+    h_slots: Dict[int, int] = {}
+    d_slots: Dict[int, int] = {}
+    for g in groups:
+        t = g.topo
+        if t is None:
+            continue
+        if t.shared_h is not None:
+            h_slots.setdefault(id(t.shared_h), len(h_slots))
+        if t.shared_d is not None:
+            d_slots.setdefault(id(t.shared_d), len(d_slots))
+        for d in t.contrib_h:
+            h_slots.setdefault(id(d), len(h_slots))
+        for d in t.contrib_d:
+            d_slots.setdefault(id(d), len(d_slots))
+    return h_slots, d_slots
+
+
+def topo_content_sigs(groups: Sequence["PodGroup"]) -> tuple:
+    """Per-group topology content signatures for the delta-encode
+    contract: ``None`` for topology-free groups, else the TopoSpec content
+    plus the shared-carry SLOT STRUCTURE (slot index + descriptor content
+    per shared/contributed constraint). Slot indices are assigned by
+    first-appearance order over the group walk — exactly
+    ``_encode_groups``'s assignment — so equal sig tuples guarantee
+    byte-identical g_* topology arrays AND carry layouts (dd0/dtg_key
+    shapes, g_hcontrib/g_dcontrib columns)."""
+    h_slots: Dict[int, int] = {}
+    d_slots: Dict[int, int] = {}
+
+    def _h(desc) -> int:
+        return h_slots.setdefault(id(desc), len(h_slots))
+
+    def _d(desc) -> int:
+        return d_slots.setdefault(id(desc), len(d_slots))
+
+    sigs = []
+    for g in groups:
+        t = g.topo
+        if t is None:
+            sigs.append(None)
+            continue
+        shared_h = (
+            (_h(t.shared_h), t.shared_h.content())
+            if t.shared_h is not None
+            else None
+        )
+        shared_d = (
+            (_d(t.shared_d), t.shared_d.content())
+            if t.shared_d is not None
+            else None
+        )
+        contrib_h = tuple((_h(d), d.content()) for d in t.contrib_h)
+        contrib_d = tuple((_d(d), d.content()) for d in t.contrib_d)
+        sigs.append(
+            t.content_sig() + (shared_h, shared_d, contrib_h, contrib_d)
+        )
+    return tuple(sigs)
 
 
 def _req_content_key(reqs) -> tuple:
@@ -769,13 +907,17 @@ class ClusterEncoding:
             self._epoch = epoch
             self.v_static += 1
         self._banks_on = not hn_interned
-        # per-group content tags; a topology-carrying group gets a fresh
-        # sentinel object so its tag never matches across encodes (the
-        # TopoSpec/shared-carry machinery is deliberately outside the
-        # delta contract — it re-encodes fully, always correctly)
+        # per-group content tags; topology-carrying groups tag the FULL
+        # TopoSpec content + shared-carry slot structure (topo_content_sigs)
+        # so topology batches participate in the content-hash/delta fast
+        # paths instead of forcing FULL re-encodes — the ISSUE-10 extension
+        # of the PR-8 contract. Equal sigs guarantee identical g_* topology
+        # arrays and carry layouts; any prior/universe/role change breaks
+        # the tag and restages.
+        topo_sigs = topo_content_sigs(groups)
         gkeys: List[Optional[tuple]] = []
         gtags = []
-        for g in groups:
+        for g, tsig in zip(groups, topo_sigs):
             gk = _req_content_key(g.requirements)
             gkeys.append(gk)
             tolk = (
@@ -792,9 +934,16 @@ class ClusterEncoding:
                     frozenset(g.requests.items()),
                     gk,
                     tolk,
-                    object() if g.topo is not None else None,
+                    tsig,
                 )
             )
+        # node identity extensions: hostname joins the tag whenever any
+        # group carries topology (n_hcnt/nh_cnt0 rows are keyed by the
+        # node's hostname — a positional node swap must break the fast
+        # path); volume-ledger state joins when the resource axis carries
+        # attach-slot columns (n_avail vol columns derive from it)
+        has_topo = any(s is not None for s in topo_sigs)
+        vol_cols = any(n.startswith(VOL_RES_PREFIX) for n in resource_names)
         ntags = []
         tkeys = []
         for en in existing_nodes:
@@ -815,12 +964,28 @@ class ClusterEncoding:
                     if hn_interned or r.key != labels_mod.HOSTNAME
                 )
             ) + (en.requirements.has(labels_mod.HOSTNAME),)
+            ext: tuple = ()
+            if has_topo:
+                ext += (
+                    en.state_node.hostname()
+                    if hasattr(en, "state_node")
+                    else en.name,
+                )
+            if vol_cols:
+                vu = getattr(en, "volume_usage", None)
+                ext += (
+                    tuple(sorted((getattr(en, "volume_limits", None) or {}).items())),
+                    tuple(sorted(vu.attached_counts().items()))
+                    if vu is not None
+                    else (),
+                )
             ntags.append(
                 (
                     ck,
                     tuple(sorted(en.cached_available.items())),
                     tuple(sorted(en.requests.items())),
                 )
+                + ext
             )
             tkeys.append(
                 tuple((t.key, t.value, t.effect) for t in en.cached_taints)
@@ -951,11 +1116,7 @@ class ClusterEncoding:
 
         delta = self.last_delta
         node_rows = self._diff_positions(self._prior_ntags, self._ntags)
-        group_rows = (
-            self._diff_positions(self._prior_gtags, self._gtags)
-            if not any(t[4] is not None for t in self._gtags)
-            else None
-        )
+        group_rows = self._diff_positions(self._prior_gtags, self._gtags)
         count_rows = (
             self._diff_positions(
                 tuple(t[0] for t in self._prior_gtags),
@@ -978,16 +1139,19 @@ class ClusterEncoding:
             else None
         )
         tolsig = tuple(t[3] for t in self._gtags)
-        # topology batches: n_hcnt/nh_cnt0 derive from TopoSpec priors
-        # (host_counts, shared-constraint counts) that the content tags
-        # deliberately don't model — the cross arrays must restage whole
-        # on EVERY such encode, never ride a version match or a row delta
-        has_topo = any(t[4] is not None for t in self._gtags) or (
-            self._prior_gtags is not None
-            and any(t[4] is not None for t in self._prior_gtags)
+        # topology batches ride the delta contract through their content
+        # tags (topo_content_sigs): n_hcnt/nh_cnt0/g_dprior derive from
+        # TopoSpec priors that the GROUP sigs now model fully, and the node
+        # tags carry the hostname whenever topology is present — so the
+        # cross arrays restage only when either side's content moved
+        toposig = tuple(t[4] for t in self._gtags)
+        prior_toposig = (
+            tuple(t[4] for t in self._prior_gtags)
+            if self._prior_gtags is not None
+            else None
         )
         cross_changed = (
-            has_topo
+            toposig != prior_toposig
             or self._tkeys != self._prior_tkeys
             or self._ntags != self._prior_ntags
             or tolsig != prior_tolsig
@@ -998,13 +1162,12 @@ class ClusterEncoding:
         )
         if cross_changed or self._prior_gtags is None:
             self.v_cross += 1
-        # cross-row delta only when the group axis kept its shape and
-        # toleration signature (and no topology priors are in play): then
-        # a node x group row changes only via its node's taints or
-        # node-content position
+        # cross-row delta only when the group axis kept its shape,
+        # toleration signature, AND topology signature: then a node x group
+        # row changes only via its node's taints or node-content position
         cross_rows = None
         if (
-            not has_topo
+            toposig == prior_toposig
             and tolsig == prior_tolsig
             and node_rows is not None
             and tol_rows is not None
@@ -1323,6 +1486,15 @@ def encode(
         t_cap = np.stack(
             [quantize_capacity(it.capacity, resource_names) for it in instance_types]
         ) if T else np.zeros((0, R), np.float32)
+        # synthetic volume-attach columns: fresh claims have no CSINode, so
+        # their capacity is the no-limit sentinel (volumeusage.py: limits
+        # only apply to existing nodes); node columns are filled per encode
+        # below from the live attach ledger
+        for ri, rn in enumerate(resource_names):
+            if rn.startswith(VOL_RES_PREFIX):
+                if T:
+                    t_alloc[:, ri] = VOL_UNLIMITED
+                    t_cap[:, ri] = VOL_UNLIMITED
         t_def = np.zeros((T, K), bool)
         t_mask = np.ones((T, K, V1), bool)
         for i, it in enumerate(instance_types):
@@ -1372,14 +1544,55 @@ def encode(
                         p_limit[i, ri] = limits[rn] // _unit_divisor(rn)
             for it in nct.instance_type_options:
                 p_titype_ok[i, type_index[it.name]] = True
+
+        # dense minValues tables (ISSUE 10): distinct-value counting over
+        # the per-key catalog value universe replaces the host-side
+        # serialization the driver used to force for reachable minValues
+        # pools. Values get their own per-key index (NOT the shared vocab:
+        # provider-side values land in the overflow slot there, which
+        # cannot count distinct values).
+        mv_keys = sorted(
+            {
+                r.key
+                for nct in templates
+                for r in nct.requirements
+                if r.min_values is not None
+            }
+        )
+        MV = len(mv_keys)
+        mv_vals: List[Dict[str, int]] = []
+        for key in mv_keys:
+            vals: Dict[str, int] = {}
+            for it in instance_types:
+                for v in sorted(it.requirements.get(key).values_list()):
+                    vals.setdefault(v, len(vals))
+            mv_vals.append(vals)
+        W = _next_pow2(max((len(v) for v in mv_vals), default=1), floor=1)
+        p_mvmin = np.zeros((P, max(MV, 0)), np.int32)
+        t_mvoh = np.zeros((T, max(MV, 0), W), bool)
+        for j, key in enumerate(mv_keys):
+            for i, nct in enumerate(templates):
+                r = (
+                    nct.requirements.get(key)
+                    if nct.requirements.has(key)
+                    else None
+                )
+                if r is not None and r.min_values is not None:
+                    p_mvmin[i, j] = r.min_values
+            for t, it in enumerate(instance_types):
+                for v in it.requirements.get(key).values_list():
+                    t_mvoh[t, j, mv_vals[j][v]] = True
+
         static = cache[static_key] = (
             t_alloc, t_cap, t_def, t_mask, t_price,
             o_avail, o_zone, o_ct, o_price,
             p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_titype_ok,
+            p_mvmin, t_mvoh,
         )
     (t_alloc, t_cap, t_def, t_mask, t_price,
      o_avail, o_zone, o_ct, o_price,
-     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_titype_ok) = static
+     p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_titype_ok,
+     p_mvmin, t_mvoh) = static
 
     # -- template/group tolerance (depends on this solve's groups) --------
     if p_tol_reuse is not None:
@@ -1424,6 +1637,16 @@ def encode(
         gi
         for gi, g in enumerate(groups)
         if g.topo is not None and (g.topo.host_counts or g.topo.haff_prior)
+    ]
+    # synthetic volume-attach columns (node side): remaining CSINode attach
+    # slots per driver. Overwritten AFTER any cached-row retrieval — the
+    # per-node row stashes/banks key on capacity+requests content, not the
+    # volume ledger, so the columns are recomputed per encode (cheap) and
+    # staleness is impossible.
+    vol_cols = [
+        (ri, rn[len(VOL_RES_PREFIX):])
+        for ri, rn in enumerate(resource_names)
+        if rn.startswith(VOL_RES_PREFIX)
     ]
     for i, en in enumerate(existing_nodes):
         # `en` is a scheduling.inflight.ExistingNode (carries the remaining
@@ -1506,6 +1729,15 @@ def encode(
                     (n_avail[i].copy(), n_base[i].copy(), n_def[i].copy(),
                      n_mask[i].copy(), n_dzone[i], n_dct[i]),
                 )
+        for ri, drv in vol_cols:
+            vu = getattr(en, "volume_usage", None)
+            limit = (getattr(en, "volume_limits", None) or {}).get(drv)
+            if limit is None:
+                n_avail[i, ri] = VOL_UNLIMITED
+            else:
+                used = vu.attached_count(drv) if vu is not None else 0
+                n_avail[i, ri] = max(limit - used, 0)
+            n_base[i, ri] = 0.0
         if shared_h_descs:
             hostname = (
                 en.state_node.hostname() if hasattr(en, "state_node") else en.name
@@ -1608,6 +1840,8 @@ def encode(
         p_has_limit=p_has_limit,
         p_titype_ok=p_titype_ok,
         p_tol=p_tol,
+        p_mvmin=p_mvmin,
+        t_mvoh=t_mvoh,
         n_avail=n_avail,
         n_base=n_base,
         n_def=n_def,
@@ -1748,6 +1982,7 @@ def partition_and_group(
     pods: Sequence[Pod],
     topology=None,
     merge_bootstrap_affinity: bool = True,
+    volume_shapes: Optional[Dict[str, tuple]] = None,
 ) -> Tuple[List[PodGroup], List[Pod]]:
     """One pass over the batch: route non-tensorizable pods to the host
     oracle and group the rest into equivalence classes, FFD-ordered
@@ -1799,6 +2034,34 @@ def partition_and_group(
     # verdict there keeps them oracle-routed (slower, never wrong).
     gk_attr = "_gk_cache" if allow_topo else "_gk_cache_nt"
     for pod in pods:
+        if pod.spec.volumes:
+            # volume routing is BATCH-dependent (cross-pod volume sharing
+            # and already-attached volumes break the dense ledger), so the
+            # verdict comes from the driver's per-solve resolution map and
+            # is never memoized on the pod
+            spec0 = pod.spec
+            vs = volume_shapes.get(pod.uid) if volume_shapes else None
+            if vs is None or not is_tensorizable(
+                pod, allow_topology=allow_topo, allow_volumes=True
+            ):
+                rest_append(pod)
+                continue
+            key = group_key(pod) + ("__vol__", vs[0])
+            if sel_keys and not (
+                spec0.topology_spread_constraints
+                or spec0.pod_anti_affinity
+                or spec0.pod_affinity
+            ):
+                key = key + _sel_signature(pod, sel_keys)
+            g = get_group(key)
+            if g is None:
+                req = dict(spec0.requests)
+                for rn, rv in vs[1].items():
+                    req[rn] = req.get(rn, 0) + rv
+                by_key[key] = PodGroup([pod], pod_requirements(pod), req)
+            else:
+                g.pods.append(pod)
+            continue
         cached = getattr(pod, gk_attr, None)
         key = None
         if (
@@ -1905,6 +2168,11 @@ def _resolve_topology(
 ) -> Tuple[List[PodGroup], List[Pod]]:
     """Global cross-group checks + TopoSpec construction (see
     partition_and_group docstring). Returns (kept groups, demoted pods)."""
+    # constraints folded STATICALLY into group requirements this pass
+    # (gates, affinity-with-priors): recorded on the topology so the
+    # scenario-batched axis can decline when a candidate node's bound pods
+    # would move counts a static fold already baked in
+    topology.kernel_static_folds = []
     # distinct (namespace, labels) -> owning group indices (-1 = oracle side)
     _empty = frozenset()
     label_owners: Dict[tuple, set] = {}
@@ -2043,6 +2311,7 @@ def _resolve_topology(
                     constraints.append(
                         (cap, {d: c for d, c in tg.domains.items() if c > 0})
                     )
+                    spec.src_h.append(tg)
                 else:
                     # non-self-selecting: placements never change the counts,
                     # so the constraint is a binary per-node gate — blocked
@@ -2099,6 +2368,7 @@ def _resolve_topology(
                         spec.dmin0 = min0
                         spec.dprior = counts
                         spec.dreg = frozenset(counts)
+                        spec.src_d = tg
                     else:
                         # static gate: placements never move the counts, so
                         # admissible domains are exactly those within skew
@@ -2111,6 +2381,7 @@ def _resolve_topology(
                         g.requirements.add(
                             Requirement(tg.key, Operator.IN, allowed)
                         )
+                        topology.kernel_static_folds.append(tg)
                 else:  # POD_AFFINITY on zone / capacity-type
                     nonempty = [d for d, c in counts.items() if c > 0]
                     if nonempty:
@@ -2119,6 +2390,7 @@ def _resolve_topology(
                         g.requirements.add(
                             Requirement(tg.key, Operator.IN, nonempty)
                         )
+                        topology.kernel_static_folds.append(tg)
                     elif self_sel:
                         if spec.dmode != DMODE_NONE:
                             demote.add(gi)
@@ -2129,12 +2401,14 @@ def _resolve_topology(
                         spec.dkey = tg.key
                         spec.dprior = counts
                         spec.dreg = frozenset(counts)
+                        spec.src_d = tg
                     else:
                         # no compatible placed pods and no bootstrap right:
                         # unsatisfiable (the oracle returns DoesNotExist)
                         g.requirements.add(
                             Requirement(tg.key, Operator.IN, [])
                         )
+                        topology.kernel_static_folds.append(tg)
             else:
                 # zone/ct anti-affinity and custom topology keys serialize
                 # through the host oracle
@@ -2153,6 +2427,7 @@ def _resolve_topology(
             continue
         if constraints:
             spec.host_cap = min(c for c, _ in constraints)
+            spec.host_nsrc = len(constraints)
             for d in {d for _, counts in constraints for d in counts}:
                 residual = min(c - counts.get(d, 0) for c, counts in constraints)
                 spec.host_counts[d] = spec.host_cap - max(residual, 0)
@@ -2248,6 +2523,7 @@ def _resolve_topology(
                     SharedHostTG(
                         cap=cap,
                         counts={d: c for d, c in tg.domains.items() if c > 0},
+                        tg=tg,
                     ),
                     thresh,
                 )
@@ -2286,6 +2562,7 @@ def _resolve_topology(
                             min0=min0,
                             prior=counts,
                             reg=frozenset(counts),
+                            tg=tg,
                         ),
                         None,
                     )
@@ -2304,6 +2581,7 @@ def _resolve_topology(
                         mode=DMODE_AFFINITY,
                         prior=counts,
                         reg=frozenset(counts),
+                        tg=tg,
                     ),
                     None,
                 )
@@ -2334,6 +2612,7 @@ def _resolve_topology(
                         groups[gi].requirements.add(
                             Requirement(key, Operator.IN, allowed)
                         )
+                    topology.kernel_static_folds.append(tg)
                     # static gate: no carry, no partner coupling
                 else:
                     for gi in owner_gis:

@@ -295,8 +295,13 @@ class TestParityPass:
                 kname, self.REAL_PY, src, tree, functions[kname], functions,
                 declared, import_aliases(tree), _module_const_table(tree),
             )
+            # ISSUE 10 extended the skeleton: dense minValues counting
+            # (before the tiers — the per-claim caps are computed from
+            # pre-tier state) and the shared spread-counter carry update
+            # (after fresh claims) are first-class phases in all three twins
             assert sk.phase_slugs() == [
-                "existing-nodes", "open-claims", "fresh-claims"
+                "min-values", "existing-nodes", "open-claims",
+                "fresh-claims", "spread-counters",
             ]
             assert set(sk.consts) == {
                 repr(2**28), repr(2**30), repr(1e-9), repr(0.5)

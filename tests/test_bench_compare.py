@@ -162,6 +162,45 @@ class TestCompareGrids:
         ]))
         assert compare_grids(old, new_bad) == 1
 
+    def test_constraint_churn_rows_enforced(self, tmp_path):
+        # ISSUE 10's constrained-workload churn rows (topology batches on
+        # the delta/REUSE contract) are first-class compare rows too
+        def centry(cfg, best_ms):
+            return {
+                "config": cfg, "pods": 5000, "types": 400,
+                "best_ms": best_ms, "pods_per_sec": 5000 / best_ms * 1000,
+                "delta_rows": 12, "full_encodes": 0,
+                "repeat_reused": True, "fallback_solves": 0,
+            }
+
+        old = _write(tmp_path, "old.json", _grid("cpu", [
+            centry("constrained-churn", 400.0),
+            centry("diverse-churn", 900.0),
+        ]))
+        new_ok = _write(tmp_path, "new_ok.json", _grid("cpu", [
+            centry("constrained-churn", 410.0),
+            centry("diverse-churn", 880.0),
+        ]))
+        assert compare_grids(old, new_ok) == 0
+        new_bad = _write(tmp_path, "new_bad.json", _grid("cpu", [
+            centry("constrained-churn", 900.0),  # +125% > bound
+            centry("diverse-churn", 900.0),
+        ]))
+        assert compare_grids(old, new_bad) == 1
+
+    def test_constraint_churn_zero_fallbacks_live(self):
+        """The acceptance gate, live at a small shape: the constrained mix
+        churns with ZERO sequential fallbacks, rides row deltas, and an
+        unchanged re-solve hits the REUSE outcome — the topology batch is
+        on the PR-8 contract."""
+        import bench
+
+        row = bench.run_constraint_churn(
+            "constrained-churn", 600, n_types=20, ticks=2
+        )
+        assert row["fallback_solves"] == 0
+        assert row["repeat_reused"] is True
+
     def test_cli_entrypoint(self, tmp_path):
         old = _write(tmp_path, "old.json", _grid("tpu", [
             _entry("mixed", 5000, 400, 100.0),

@@ -326,10 +326,14 @@ class TestStaleBufferGates:
         r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
         assert _decision_signature(r_warm) == _decision_signature(r_cold)
 
-    def test_topology_batch_always_restages_cross_arrays(self):
-        """n_hcnt/nh_cnt0 derive from TopoSpec priors the content tags
-        don't model: any topology-carrying encode must bump the cross
-        version and disable the cross-row delta."""
+    def test_topology_batch_rides_delta_contract(self):
+        """ISSUE 10: topology-carrying batches participate in the
+        content-tag fast paths. n_hcnt/nh_cnt0/g_dprior now derive from
+        TopoSpec content the group sigs model FULLY (topo_content_sigs)
+        and node tags carry the hostname — so an UNCHANGED topology batch
+        re-encode hits the content-hash REUSE outcome (no forced FULL),
+        while a constraint-content change (maxSkew here) still breaks the
+        tags, bumps the cross version, and matches a cold solver."""
         cl2 = enc.ClusterEncoding()
         cache = EncodeCache()
         cache.cluster = cl2
@@ -337,25 +341,40 @@ class TestStaleBufferGates:
         from helpers import spread_constraint
         from karpenter_tpu.api import labels as labels_mod2
 
-        cluster.pods = [
-            make_pod(
-                cpu="1", memory="1Gi", labels={"app": "s"},
-                spread=[
-                    spread_constraint(
-                        labels_mod2.HOSTNAME, labels={"app": "s"}
-                    )
-                ],
-            )
-            for _ in range(4)
-        ]
+        def spread_pods(skew):
+            return [
+                make_pod(
+                    cpu="1", memory="1Gi", labels={"app": "s"},
+                    spread=[
+                        spread_constraint(
+                            labels_mod2.HOSTNAME, labels={"app": "s"},
+                            max_skew=skew,
+                        )
+                    ],
+                )
+                for _ in range(4)
+            ]
+
+        cluster.pods = spread_pods(1)
         cluster.build_solver(cache).solve(cluster.pods)
         v1 = cl2.v_cross
-        cluster.build_solver(cache).solve(cluster.pods)
-        assert cl2.v_cross > v1, (
-            "topology encode must bump the cross-class version"
+        r_warm = cluster.build_solver(cache).solve(cluster.pods)
+        assert cl2.last_delta.reused, (
+            "unchanged topology batch must hit the REUSE fast path"
         )
-        assert cl2.last_delta.cross_rows is None
+        assert cl2.v_cross == v1, (
+            "an unchanged topology encode must not churn the cross version"
+        )
+        r_cold = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(r_warm) == _decision_signature(r_cold)
+        # constraint content change: tags break, cross restages, decisions
+        # still match a cold solver
+        cluster.pods = spread_pods(2)
+        r_warm2 = cluster.build_solver(cache).solve(cluster.pods)
         assert not cl2.last_delta.reused
+        assert cl2.v_cross > v1
+        r_cold2 = cluster.build_solver(EncodeCache()).solve(cluster.pods)
+        assert _decision_signature(r_warm2) == _decision_signature(r_cold2)
 
     def test_interned_hostname_node_swap_detected(self):
         """With a pod node-selector naming a node (hostname value
@@ -587,3 +606,51 @@ class TestFaultSiteRegistry:
     def test_new_sites_registered(self):
         assert faults.ENCODE_DELTA in faults.ALL_SITES
         assert faults.DISPATCH_QUEUE in faults.ALL_SITES
+
+
+class TestTopologyResidencyContract:
+    """ISSUE 10 analyzer/pinning satellite: the topology prior rows are
+    first-class members of the device-residency contract — classified
+    into the residency argument classes, batched by the scenario axis by
+    NAME through SOLVE_ARG_NAMES, and reusable on device across warm
+    topology solves with no new sanctioned host crossing (the DTX906
+    blessed set stays pinned by tests/test_analysis.py)."""
+
+    def test_topology_args_classified(self):
+        from karpenter_tpu.ops.solve import SCENARIO_TOPO_BATCHED_ARGS
+        from karpenter_tpu.solver import residency
+
+        assert "g_dprior" in residency.GROUP_ARGS
+        assert {"n_hcnt", "nh_cnt0"} <= residency.CROSS_ARGS
+        assert "dd0" in residency.GROUP_ARGS
+        assert "dd0" in residency.NO_ROW_DELTA  # slot axis, never row-delta
+        assert set(SCENARIO_TOPO_BATCHED_ARGS) <= set(enc.SOLVE_ARG_NAMES)
+
+    def test_warm_topology_solve_reuses_device_buffers(self):
+        """Second solve of an unchanged topology cluster: the residency
+        store must report an incremental stage (buffers reused, zero full
+        puts for the topology rows) instead of the pre-ISSUE-10 behavior
+        of restaging the cross class on every topology encode."""
+        from helpers import spread_constraint
+
+        cluster = ChurnCluster(random.Random(11))
+        cache = EncodeCache()
+        cluster.pods = [
+            make_pod(
+                cpu="1", memory="1Gi", labels={"app": "rz"},
+                spread=[
+                    spread_constraint(
+                        labels_mod.TOPOLOGY_ZONE, labels={"app": "rz"}
+                    )
+                ],
+            )
+            for _ in range(6)
+        ]
+        cluster.build_solver(cache).solve(cluster.pods)
+        store = cache.device_store
+        assert store is not None
+        cluster.build_solver(cache).solve(cluster.pods)
+        assert store.last_incremental, (
+            "warm topology solve must reuse device-resident buffers"
+        )
+        assert store.last_full_puts == 0

@@ -1,0 +1,702 @@
+"""ISSUE 10 equivalence suites: dense in-kernel topology-spread, minValues,
+volume, and reservation constraints vs the sequential reference.
+
+The four constraint families that used to gate a sequential fallback now
+ride the batched kernel: topology-spread priors batch per scenario
+(driver._plan_scenario_topology), minValues floors count distinct values
+densely (ops/packing.py:minvalues_cap), volumes consume attach-slot ledger
+columns, and default-mode reservations replay per scenario. These suites
+pin each family's batched decisions to the sequential path that remains
+the semantic reference — per-probe simulate_scheduling for the scenario
+axis (exact command signatures: both sides run the same kernel per probe)
+and the host oracle for single solves (node count / cost / constraint
+semantics, the established parity discipline).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as labels_mod
+from karpenter_tpu.api import resources as res
+from karpenter_tpu.api.objects import (
+    COND_CONSOLIDATABLE,
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    Node,
+    NodeClaim,
+    NodeClaimSpec,
+    NodePool,
+    NodePoolSpec,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimRef,
+)
+from karpenter_tpu.api.objects import NodeClaimTemplate as NodeClaimTemplateSpec
+from karpenter_tpu.api.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.cloudprovider import corpus
+from karpenter_tpu.cloudprovider import types as cp
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.controllers.disruption.controller import DisruptionContext
+from karpenter_tpu.controllers.disruption.methods import MultiNodeConsolidation
+from karpenter_tpu.controllers.state import Cluster
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.kube import Client, TestClock
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.scheduling.volumeusage import VolumeResolver
+from karpenter_tpu.solver import TpuSolver
+from karpenter_tpu.solver.driver import Scenario, SolverConfig
+
+from helpers import make_nodepool, make_pod, make_pods, spread_constraint
+from test_scenario_batch import (
+    _candidates_and_budgets,
+    _command_signature,
+    _pod,
+)
+
+_MI = 2**20 * res.MILLI
+
+
+def build_topo_env(
+    n_nodes: int,
+    seed: int = 0,
+    n_types: int = 30,
+    pending_pods: int = 2,
+    spread_keys=(labels_mod.TOPOLOGY_ZONE,),
+    min_values_pool: bool = False,
+):
+    """A seeded consolidatable cluster whose fill pods carry SELF-SELECTING
+    spread constraints (one 'deployment' label per constraint family across
+    nodes), nodes spread over the catalog's zones — the shape whose
+    consolidation search used to fall off the scenario-batched path."""
+    rng = random.Random(seed)
+    clock = TestClock()
+    clock.step(3600.0)
+    client = Client(clock)
+    its = corpus.generate(n_types)
+    provider = KwokCloudProvider(client, its)
+    cluster = Cluster(client)
+
+    pool = NodePool(
+        metadata=ObjectMeta(name="default"),
+        spec=NodePoolSpec(template=NodeClaimTemplateSpec(spec=NodeClaimSpec())),
+    )
+    if min_values_pool:
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(
+                corpus.INSTANCE_FAMILY_LABEL, "Exists", (), min_values=2
+            )
+        ]
+    pool.spec.disruption.consolidate_after = 10.0
+    client.create(pool)
+
+    sized = sorted(
+        (
+            it
+            for it in its
+            if it.capacity.get(res.CPU, 0) >= 4000
+            and it.capacity.get(res.MEMORY, 0) >= 8 * 1024 * _MI
+        ),
+        key=lambda it: min(
+            (o.price for o in it.offerings if o.available), default=1e9
+        ),
+    )
+    it = sized[len(sized) // 2]
+    zoned = {}
+    for o in it.offerings:
+        if o.available and o.zone() not in zoned:
+            zoned[o.zone()] = o
+    zones = sorted(zoned)
+    assert len(zones) >= 2, "topology env needs a multi-zone type"
+
+    deployments = [
+        {"app": f"d{j}", "key": key}
+        for j, key in enumerate(
+            list(spread_keys) * 2
+        )  # two deployments per key
+    ]
+
+    for i in range(n_nodes):
+        name = f"n-{i}"
+        pid = f"test://{i}"
+        offering = zoned[zones[i % len(zones)]]
+        node_labels = {
+            labels_mod.HOSTNAME: name,
+            labels_mod.INSTANCE_TYPE: it.name,
+            labels_mod.TOPOLOGY_ZONE: offering.zone(),
+            labels_mod.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type(),
+            labels_mod.NODEPOOL_LABEL_KEY: pool.name,
+        }
+        claim = NodeClaim(
+            metadata=ObjectMeta(name=name, labels=dict(node_labels)),
+            spec=NodeClaimSpec(),
+        )
+        claim.status.provider_id = pid
+        claim.status.capacity = dict(it.capacity)
+        claim.status.allocatable = dict(it.allocatable())
+        now = clock.now()
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED,
+                     COND_CONSOLIDATABLE):
+            claim.conds().set(cond, "True", now=now)
+        node = Node(
+            metadata=ObjectMeta(name=name, labels=node_labels),
+            provider_id=pid,
+        )
+        node.status.capacity = dict(it.capacity)
+        node.status.allocatable = dict(it.allocatable())
+        node.status.ready = True
+        client.create(claim)
+        client.create(node)
+        for j in range(rng.choice((1, 2))):
+            dep = deployments[(i + j) % len(deployments)]
+            p = make_pod(
+                name=f"fill-{i}-{j}",
+                cpu=str(rng.choice((0.25, 0.5, 0.75))),
+                memory=f"{rng.choice((256, 512, 1024))}Mi",
+                labels={"app": dep["app"]},
+                spread=[
+                    spread_constraint(dep["key"], labels={"app": dep["app"]})
+                ],
+                node_name=name,
+                phase="Running",
+            )
+            client.create(p)
+    for j in range(pending_pods):
+        dep = deployments[j % len(deployments)]
+        client.create(
+            make_pod(
+                name=f"pend-{j}",
+                cpu="0.5",
+                memory="512Mi",
+                labels={"app": dep["app"]},
+                spread=[
+                    spread_constraint(dep["key"], labels={"app": dep["app"]})
+                ],
+            )
+        )
+
+    return DisruptionContext(
+        client=client,
+        cluster=cluster,
+        cloud_provider=provider,
+        clock=clock,
+        recorder=Recorder(clock),
+        spot_to_spot_enabled=True,
+    )
+
+
+def _run_multi_env(env_args, batched: bool):
+    ctx = build_topo_env(**env_args)
+    ctx.scenario_batch = batched
+    method = MultiNodeConsolidation(ctx)
+    candidates, budgets = _candidates_and_budgets(ctx, method)
+    cmd = method.compute_command(candidates, budgets)
+    return cmd, method
+
+
+class TestScenarioTopologyEquivalence:
+    """Topology-constrained consolidation searches ride the batched kernel
+    (per-scenario prior corrections) and decide EXACTLY what the
+    sequential per-probe loop decides."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_zonal_spread_clusters(self, seed):
+        env_args = dict(
+            n_nodes=5 + (seed * 3) % 9,
+            seed=seed,
+            spread_keys=(labels_mod.TOPOLOGY_ZONE,),
+            pending_pods=seed % 3,
+        )
+        cmd_b, method_b = _run_multi_env(env_args, batched=True)
+        cmd_s, _ = _run_multi_env(env_args, batched=False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        if method_b.last_probes:
+            # the topology-carrying search stayed batched, <= 2 dispatches
+            assert 1 <= method_b.last_dispatches <= 2
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_hostname_spread_clusters(self, seed):
+        env_args = dict(
+            n_nodes=6 + seed * 2,
+            seed=10 + seed,
+            spread_keys=(labels_mod.HOSTNAME,),
+            pending_pods=1,
+        )
+        cmd_b, method_b = _run_multi_env(env_args, batched=True)
+        cmd_s, _ = _run_multi_env(env_args, batched=False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        if method_b.last_probes:
+            assert 1 <= method_b.last_dispatches <= 2
+
+    def test_mixed_keys_cluster(self, ):
+        env_args = dict(
+            n_nodes=8,
+            seed=21,
+            spread_keys=(labels_mod.TOPOLOGY_ZONE, labels_mod.HOSTNAME),
+            pending_pods=2,
+        )
+        cmd_b, method_b = _run_multi_env(env_args, batched=True)
+        cmd_s, _ = _run_multi_env(env_args, batched=False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        if method_b.last_probes:
+            assert 1 <= method_b.last_dispatches <= 2
+
+    def test_min_values_pool_rides_batch(self):
+        env_args = dict(
+            n_nodes=6, seed=5, min_values_pool=True, pending_pods=1
+        )
+        cmd_b, method_b = _run_multi_env(env_args, batched=True)
+        cmd_s, _ = _run_multi_env(env_args, batched=False)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+        if method_b.last_probes:
+            assert 1 <= method_b.last_dispatches <= 2
+
+    def test_anti_affinity_candidates_decline_to_sequential(self):
+        """Documented remnant: candidate pods OWNING anti-affinity gate
+        through the oracle's inverse machinery — the batch must decline
+        (and the decline must be counted), never guess."""
+        from karpenter_tpu.api.objects import PodAffinityTerm, LabelSelector
+
+        ctx = build_topo_env(n_nodes=4, seed=7, pending_pods=0)
+        anti = make_pod(
+            name="anti-0",
+            cpu="0.25",
+            memory="256Mi",
+            labels={"app": "nginx"},
+            pod_anti_affinity=[
+                PodAffinityTerm(
+                    topology_key=labels_mod.HOSTNAME,
+                    label_selector=LabelSelector(
+                        match_labels={"app": "nginx"}
+                    ),
+                )
+            ],
+            node_name="n-0",
+            phase="Running",
+        )
+        ctx.client.create(anti)
+        ctx.scenario_batch = True
+        method = MultiNodeConsolidation(ctx)
+        candidates, budgets = _candidates_and_budgets(ctx, method)
+        cmd_b = method.compute_command(candidates, budgets)
+        ctx2 = build_topo_env(n_nodes=4, seed=7, pending_pods=0)
+        ctx2.client.create(anti)
+        ctx2.scenario_batch = False
+        method_s = MultiNodeConsolidation(ctx2)
+        candidates2, budgets2 = _candidates_and_budgets(ctx2, method_s)
+        cmd_s = method_s.compute_command(candidates2, budgets2)
+        assert _command_signature(cmd_b) == _command_signature(cmd_s)
+
+
+class TestMaxSkewBoundary:
+    """Single-solve kernel-vs-oracle parity at the skew boundary and with
+    unschedulable domains (the decision shapes the old gate serialized)."""
+
+    def _run_both(self, pods, pools=None, its=None):
+        import copy
+
+        pools = pools or [make_nodepool()]
+        its = its if its is not None else corpus.generate(12)
+        its_by_pool = {p.name: list(its) for p in pools}
+
+        def topo(ps):
+            return Topology(Client(TestClock()), [], pools, its_by_pool, ps)
+
+        o_pods = copy.deepcopy(pods)
+        o = TpuSolver(
+            pools, its_by_pool, topo(o_pods),
+            config=SolverConfig(force_oracle=True),
+        ).solve(o_pods)
+        solver = TpuSolver(pools, its_by_pool, topo(pods))
+        k = solver.solve(pods)
+        assert solver.fallback_solves == 0, solver.last_fallback_reasons
+        return o, k
+
+    def _zone_spread(self, n, skew):
+        lbl = {"app": "sk"}
+        return make_pods(
+            n, cpu="1", labels=lbl,
+            spread=[
+                spread_constraint(
+                    labels_mod.TOPOLOGY_ZONE, labels=lbl, max_skew=skew
+                )
+            ],
+        )
+
+    @pytest.mark.parametrize("skew", [1, 2])
+    @pytest.mark.parametrize("n", [3, 7, 10])
+    def test_boundary_counts(self, n, skew):
+        o, k = self._run_both(self._zone_spread(n, skew))
+        assert not k.pod_errors and not o.pod_errors
+        # per-zone counts honor the skew in both paths, identically spread
+        def zone_counts(results):
+            counts = {}
+            for c in results.new_node_claims:
+                z = c.requirements.get(labels_mod.TOPOLOGY_ZONE)
+                zone = next(iter(z.values)) if len(z.values) == 1 else "?"
+                counts[zone] = counts.get(zone, 0) + len(c.pods)
+            return counts
+
+        for counts in (zone_counts(o), zone_counts(k)):
+            vals = list(counts.values())
+            assert max(vals) - min(vals) <= skew
+
+    def test_unschedulable_domain(self):
+        # zone-c offerings unavailable but REGISTERED (the catalog provides
+        # the domain): its empty count pins the global min at 0, so both
+        # paths place exactly one pod per schedulable zone and error the
+        # rest — identically (kubernetes spread semantics: an empty
+        # registered domain constrains skew even when nothing can land
+        # there)
+        its = corpus.generate(8)
+        for it in its:
+            for o in it.offerings:
+                if o.zone() == "test-zone-c":
+                    o.available = False
+        o, k = self._run_both(self._zone_spread(6, 1), its=its)
+        assert len(k.pod_errors) == len(o.pod_errors)
+        assert k.node_count() == o.node_count()
+
+        def zones_of(results):
+            out = set()
+            for c in results.new_node_claims:
+                out |= set(
+                    c.requirements.get(labels_mod.TOPOLOGY_ZONE).values
+                )
+            return out
+
+        assert zones_of(k) == zones_of(o)
+        assert "test-zone-c" not in zones_of(k)
+
+
+class TestMinValuesPartialReach:
+    """minValues pools reachable by only part of the batch: the reachable
+    pods' claims honor the floor, the rest pack normally, nothing
+    serializes host-side (the old gate sent the WHOLE batch to the
+    oracle)."""
+
+    def test_split_batch(self):
+        from karpenter_tpu.api.objects import Taint, Toleration
+
+        mv_pool = make_nodepool(
+            name="mv",
+            weight=10,
+            taints=[Taint(key="team", value="x", effect="NoSchedule")],
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "Exists", (), min_values=2
+                )
+            ],
+        )
+        open_pool = make_nodepool(name="open")
+        pools = [mv_pool, open_pool]
+        its = corpus.generate(16)
+        its_by_pool = {p.name: list(its) for p in pools}
+        pods = make_pods(6, cpu="1") + make_pods(
+            3, cpu="1",
+            tolerations=[Toleration(key="team", operator="Exists")],
+        )
+        import copy
+
+        o_pods = copy.deepcopy(pods)
+        o = TpuSolver(
+            pools, its_by_pool,
+            Topology(Client(TestClock()), [], pools, its_by_pool, o_pods),
+            config=SolverConfig(force_oracle=True),
+        ).solve(o_pods)
+        solver = TpuSolver(
+            pools, its_by_pool,
+            Topology(Client(TestClock()), [], pools, its_by_pool, pods),
+        )
+        k = solver.solve(pods)
+        assert solver.fallback_solves == 0, solver.last_fallback_reasons
+        assert len(k.pod_errors) == len(o.pod_errors) == 0
+        assert k.node_count() == o.node_count()
+        for claim in k.new_node_claims:
+            if claim.template.requirements.has_min_values():
+                fams = {
+                    it.requirements.get(corpus.INSTANCE_FAMILY_LABEL).any()
+                    for it in claim.instance_type_options
+                }
+                assert len(fams) >= 2
+
+    def test_min_values_edit_busts_encode_cache(self):
+        """A NodePool minValues edit (same keys, same values, different
+        floor) must reset the shared EncodeCache: the dense floor tables
+        live in the leased static cache, and repr(requirements) — the old
+        fingerprint content — does not print min_values."""
+        from karpenter_tpu.solver.driver import EncodeCache
+
+        def pool_with_floor(floor):
+            return make_nodepool(
+                requirements=[
+                    NodeSelectorRequirement(
+                        corpus.INSTANCE_FAMILY_LABEL, "In", ("c", "m", "r"),
+                        min_values=floor,
+                    )
+                ]
+            )
+
+        its = [
+            corpus.make_instance_type(f, c)
+            for f in ("c", "m", "r")
+            for c in (2, 4)
+        ]
+        cache = EncodeCache()
+
+        def solve(floor):
+            pool = pool_with_floor(floor)
+            its_by_pool = {pool.name: list(its)}
+            pods = make_pods(2, cpu="1")
+            solver = TpuSolver(
+                [pool], its_by_pool,
+                Topology(
+                    Client(TestClock()), [], [pool], its_by_pool, pods
+                ),
+                encode_cache=cache,
+            )
+            return solver.solve(pods)
+
+        k2 = solve(2)
+        assert not k2.pod_errors
+        k4 = solve(4)  # only 3 families exist: now unsatisfiable
+        assert len(k4.pod_errors) == 2, (
+            "stale p_mvmin served after a minValues edit"
+        )
+
+    def test_unsatisfiable_floor_matches_oracle(self):
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    corpus.INSTANCE_FAMILY_LABEL, "In", ("c",), min_values=3
+                )
+            ]
+        )
+        its = [corpus.make_instance_type("c", c) for c in (2, 4)]
+        its_by_pool = {pool.name: list(its)}
+        pods = make_pods(2, cpu="1")
+        solver = TpuSolver(
+            [pool], its_by_pool,
+            Topology(Client(TestClock()), [], [pool], its_by_pool, pods),
+        )
+        k = solver.solve(pods)
+        assert solver.fallback_solves == 0
+        assert len(k.pod_errors) == 2 and not k.new_node_claims
+
+
+class TestVolumeLedger:
+    """Volumes as pack-phase capacity ledgers: fresh unshared volumes ride
+    the kernel (attach-slot columns); sharing/attachment shapes route
+    host-side, exactly like the oracle's per-node dedup."""
+
+    def _client_with_volumes(self, n, driver="csi.test", shared=False):
+        clock = TestClock()
+        client = Client(clock)
+        for i in range(n):
+            name = "pv-shared" if shared else f"pv-{i}"
+            if not shared or i == 0:
+                client.create(
+                    PersistentVolume(
+                        metadata=ObjectMeta(name=name), driver=driver
+                    )
+                )
+            client.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=f"claim-{i}"),
+                    volume_name=name,
+                )
+            )
+        return client
+
+    def _vol_pods(self, n):
+        pods = []
+        for i in range(n):
+            p = make_pod(cpu="1", memory="1Gi")
+            p.spec.volumes = [PersistentVolumeClaimRef(claim_name=f"claim-{i}")]
+            pods.append(p)
+        return pods
+
+    def test_fresh_volumes_ride_kernel(self):
+        client = self._client_with_volumes(4)
+        pool = make_nodepool()
+        its = corpus.generate(10)
+        its_by_pool = {pool.name: list(its)}
+        pods = self._vol_pods(4) + make_pods(3, cpu="1")
+        solver = TpuSolver(
+            [pool], its_by_pool,
+            Topology(client, [], [pool], its_by_pool, pods),
+            volume_resolver=VolumeResolver(client),
+        )
+        k = solver.solve(pods)
+        assert solver.fallback_solves == 0, solver.last_fallback_reasons
+        assert not k.pod_errors
+
+    def test_attach_limit_respected_on_existing_node(self):
+        from helpers import make_state_node
+
+        client = self._client_with_volumes(3)
+        sn = make_state_node(name="node-1", cpu="64", memory="256Gi")
+        sn.volume_limits = {"csi.test": 1}
+        pool = make_nodepool()
+        its = corpus.generate(10)
+        its_by_pool = {pool.name: list(its)}
+        pods = self._vol_pods(3)
+        solver = TpuSolver(
+            [pool], its_by_pool,
+            Topology(client, [sn], [pool], its_by_pool, pods),
+            state_nodes=[sn],
+            volume_resolver=VolumeResolver(client),
+        )
+        k = solver.solve(pods)
+        assert solver.fallback_solves == 0, solver.last_fallback_reasons
+        assert not k.pod_errors
+        # at most one volume pod landed on the limited node
+        on_node = sum(
+            1
+            for en in k.existing_nodes
+            if en.name == "node-1"
+            for p in en.pods
+            if p.spec.volumes
+        )
+        assert on_node <= 1
+        # and its usage ledger recorded the attachment for the next pass
+        en = next(e for e in k.existing_nodes if e.name == "node-1")
+        attached = (
+            sum(en.volume_usage.attached_counts().values())
+            if en.volume_usage
+            else 0
+        )
+        assert attached == on_node
+
+    def test_storage_named_driver_quantizes_whole_units(self):
+        """Regression: a real-world CSI driver name containing 'storage'
+        (pd.csi.storage.gke.io) must quantize attach slots as WHOLE units,
+        not memory-like MiB — else the ledger rounds to ~0 and over-packs
+        past the node's attach limit."""
+        from helpers import make_state_node
+
+        driver = "pd.csi.storage.gke.io"
+        client = self._client_with_volumes(3, driver=driver)
+        sn = make_state_node(name="node-1", cpu="64", memory="256Gi")
+        sn.volume_limits = {driver: 1}
+        pool = make_nodepool()
+        its = corpus.generate(10)
+        its_by_pool = {pool.name: list(its)}
+        pods = self._vol_pods(3)
+        solver = TpuSolver(
+            [pool], its_by_pool,
+            Topology(client, [sn], [pool], its_by_pool, pods),
+            state_nodes=[sn],
+            volume_resolver=VolumeResolver(client),
+        )
+        k = solver.solve(pods)
+        assert solver.fallback_solves == 0
+        assert not k.pod_errors
+        on_node = sum(
+            1
+            for en in k.existing_nodes
+            if en.name == "node-1"
+            for p in en.pods
+            if p.spec.volumes
+        )
+        assert on_node <= 1, "attach limit over-packed (quantization bug)"
+
+    def test_shared_volume_routes_host_side(self):
+        client = self._client_with_volumes(2, shared=True)
+        pool = make_nodepool()
+        its = corpus.generate(10)
+        its_by_pool = {pool.name: list(its)}
+        pods = self._vol_pods(2)
+        solver = TpuSolver(
+            [pool], its_by_pool,
+            Topology(client, [], [pool], its_by_pool, pods),
+            volume_resolver=VolumeResolver(client),
+        )
+        k = solver.solve(pods)
+        assert not k.pod_errors
+        # RWX sharing breaks the dense ledger: counted as a fallback
+        assert solver.fallback_solves >= 1
+
+
+class TestScenarioReservations:
+    """Default-mode reservations ride the scenario batch: each scenario
+    consumes a fresh ledger replay, matching per-scenario sequential
+    solves on fresh solvers."""
+
+    def _reserved_types(self, capacity=1, n=4):
+        its = corpus.generate(n)
+        for it in its[-2:]:
+            res_req = Requirements(
+                Requirement(
+                    labels_mod.CAPACITY_TYPE_LABEL_KEY, Operator.IN,
+                    [labels_mod.CAPACITY_TYPE_RESERVED],
+                ),
+                Requirement(
+                    labels_mod.TOPOLOGY_ZONE, Operator.IN, ["test-zone-a"]
+                ),
+                Requirement(
+                    cp.RESERVATION_ID_LABEL, Operator.IN, [f"res-{it.name}"]
+                ),
+            )
+            it.offerings.append(
+                cp.Offering(
+                    requirements=res_req, price=0.001, available=True,
+                    reservation_capacity=capacity,
+                )
+            )
+        return its
+
+    def _build(self, pods, its):
+        pool = make_nodepool()
+        its_by_pool = {pool.name: list(its)}
+        topo = Topology(Client(TestClock()), [], [pool], its_by_pool, pods)
+        return TpuSolver(
+            [pool], its_by_pool, topo, reserved_capacity_enabled=True
+        )
+
+    def _sig(self, results):
+        return (
+            len(results.new_node_claims),
+            sorted(
+                len(c.reserved_offerings) for c in results.new_node_claims
+            ),
+            len(results.pod_errors),
+        )
+
+    def test_batched_matches_per_scenario_sequential(self):
+        its = self._reserved_types(capacity=1)
+        pods = make_pods(6, cpu="1")
+        subsets = [pods[:2], pods[:4], pods]
+        solver = self._build(pods, its)
+        batched = solver.solve_scenarios(
+            [Scenario(pods=s) for s in subsets]
+        )
+        assert batched is not None, "reservations must ride the batch now"
+        assert solver.last_scenario_dispatches >= 1
+        for subset, r_b in zip(subsets, batched):
+            its2 = self._reserved_types(capacity=1)
+            seq = self._build(subset, its2).solve(subset)
+            assert self._sig(r_b) == self._sig(seq)
+
+    def test_strict_mode_still_declines(self):
+        from karpenter_tpu.scheduling.inflight import (
+            RESERVED_OFFERING_MODE_STRICT,
+        )
+
+        its = self._reserved_types(capacity=1)
+        pods = make_pods(3, cpu="1")
+        pool = make_nodepool()
+        its_by_pool = {pool.name: list(its)}
+        topo = Topology(Client(TestClock()), [], [pool], its_by_pool, pods)
+        solver = TpuSolver(
+            [pool], its_by_pool, topo,
+            reserved_capacity_enabled=True,
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
+        assert solver.solve_scenarios([Scenario(pods=pods)]) is None
+        assert solver.fallback_solves >= 1

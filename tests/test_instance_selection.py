@@ -539,10 +539,11 @@ class TestMinValues:
             }
             assert len(fams) >= 2 and len(cpus) >= 3
 
-    def test_unreachable_min_values_pool_keeps_fast_path(self):
-        # a tainted minValues pool the batch doesn't tolerate must NOT
-        # serialize the whole solve host-side
-        from karpenter_tpu.api.objects import Taint
+    def test_min_values_pool_keeps_fast_path(self):
+        # ISSUE 10: minValues pools no longer serialize the solve
+        # host-side — reachable or not, the batch rides the kernel (dense
+        # distinct-value counting) and records NO sequential fallback
+        from karpenter_tpu.api.objects import Taint, Toleration
         from karpenter_tpu.kube import Client, TestClock
         from karpenter_tpu.scheduling.topology import Topology
         from karpenter_tpu.solver import TpuSolver
@@ -561,24 +562,25 @@ class TestMinValues:
         pools = [mv_pool, open_pool]
         its = diverse_catalog()
         its_by_pool = {p.name: list(its) for p in pools}
-        pods = make_pods(4, cpu="1")
+        pods = make_pods(4, cpu="1") + make_pods(
+            2, cpu="1",
+            tolerations=[Toleration(key="team", operator="Exists")],
+        )
         topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
         solver = TpuSolver(pools, its_by_pool, topo)
-        mv = [
-            nct for nct in solver.oracle.templates
-            if nct.requirements.has_min_values()
-        ]
-        assert mv and not solver._min_values_reachable(mv, pods)
-        # and a tolerating batch flips it
-        tolerant = make_pods(
-            1,
-            tolerations=[
-                __import__(
-                    "karpenter_tpu.api.objects", fromlist=["Toleration"]
-                ).Toleration(key="team", operator="Exists")
-            ],
-        )
-        assert solver._min_values_reachable(mv, tolerant)
+        results = solver.solve(pods)
+        assert not results.pod_errors
+        assert solver.fallback_solves == 0, solver.last_fallback_reasons
+        # the tolerating pods' claims honor the mv pool's floor when they
+        # land there
+        for claim in results.new_node_claims:
+            if not claim.template.requirements.has_min_values():
+                continue
+            fams = {
+                it.requirements.get(corpus.INSTANCE_FAMILY_LABEL).any()
+                for it in claim.instance_type_options
+            }
+            assert len(fams) >= 2
 
     def test_min_values_survives_60_type_truncation(self):
         # the 60-type truncation (nodeclaimtemplate 60-type cap) keeps the

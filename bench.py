@@ -567,6 +567,73 @@ def run_single_consolidation(n_nodes: int) -> Dict:
     )
 
 
+def run_twin(n_nodes: int = 2000, minutes: int = 10) -> Dict:
+    """Twin row (ISSUE 12): a deterministic churn replay over a fabricated
+    fleet — sustained solves/sec across the whole roster plus the
+    worst-minute SLO margins (the numbers the day-scale soak asserts,
+    measured at bench scale). ``best_ms`` is roster wall time per
+    simulated minute so ``--compare`` can gate twin-loop regressions the
+    way it gates solver ones."""
+    from karpenter_tpu.sim import trace as twin_trace
+    from karpenter_tpu.sim.slo import SLOConfig
+    from karpenter_tpu.sim.twin import ClusterProfile, ClusterTwin, TwinConfig
+
+    profile = ClusterProfile(nodes=n_nodes, pods_per_node=10)
+    events = twin_trace.generate(
+        7,
+        twin_trace.ChurnProfile(
+            minutes=minutes, pods_per_minute=8,
+            reclaim_minutes=(2,), reclaim_count=4, ice_minutes=(4,),
+        ),
+    )
+    slo = SLOConfig(p99_decision_latency_ms=10_000.0)
+    cfg = TwinConfig(
+        seed=7, minutes=minutes, slo=slo, assert_slos=False,
+    )
+    with ClusterTwin(events, profile=profile, config=cfg) as twin:
+        reports = twin.run()
+        # the compare-gated number is pure roster wall per simulated
+        # minute: bootstrap/fabrication cost is setup, not the replay
+        # loop, and folding it in would let a loop regression hide
+        # behind amortized setup (or a setup change trip the gate)
+        wall = twin.roster_wall_s()
+        worst = twin.worst_minute()
+        worst_cost = max(
+            (
+                r.fleet_price / r.cost_lower_bound
+                for r in reports
+                if r.cost_lower_bound > 0
+            ),
+            default=0.0,
+        )
+        return {
+            "config": "twin",
+            "nodes": n_nodes,
+            "pods": n_nodes * profile.pods_per_node,
+            "minutes": minutes,
+            "best_ms": round(wall * 1000 / max(minutes, 1), 1),
+            "pods_per_sec": None,
+            "p99_ms": round(worst.p99_latency_ms, 1) if worst else 0.0,
+            "solves_per_sec": round(twin.solves_per_sec(), 2),
+            "decisions": len(twin.audit.query()),
+            "worst_minute_p99_ms": (
+                round(worst.p99_latency_ms, 1) if worst else 0.0
+            ),
+            "p99_margin_ms": round(
+                slo.p99_decision_latency_ms
+                - (worst.p99_latency_ms if worst else 0.0),
+                1,
+            ),
+            "worst_cost_ratio": round(worst_cost, 3),
+            "cost_margin": round(slo.max_cost_vs_lower_bound - worst_cost, 3),
+            "fallback_solves": sum(r.fallback_solves for r in reports),
+            "delta_fallbacks": sum(r.delta_fallbacks for r in reports),
+            "slo_violations": sum(len(r.violations) for r in reports),
+            "reclaimed": twin.reclaimed,
+            "iced_cells": twin.iced_cells,
+        }
+
+
 def _entry_key(e: Dict) -> tuple:
     return (e.get("config"), e.get("pods"), e.get("types"), e.get("nodes"))
 
@@ -702,6 +769,15 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--record-floors":
         record_floors()
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--twin":
+        # bench.py --twin [nodes] [minutes]: just the twin row, as JSON
+        init_backend()
+        entry = run_twin(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 2000,
+            int(sys.argv[3]) if len(sys.argv) > 3 else 10,
+        )
+        print(json.dumps(entry, indent=1))
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == "--compare":
         # bench.py --compare old_grid.json [new_grid.json]
         old = sys.argv[2]
@@ -757,6 +833,11 @@ def main() -> None:
                 grid.append(run_constraint_churn(cfg, 5_000, ticks=3))
             except Exception as exc:  # pragma: no cover - bench resilience
                 print(f"bench: {cfg} failed: {exc}", file=sys.stderr)
+        # twin row at survival scale: the replay loop itself end-to-end
+        try:
+            grid.append(run_twin(500, minutes=6))
+        except Exception as exc:  # pragma: no cover - bench resilience
+            print(f"bench: twin row failed: {exc}", file=sys.stderr)
         headline = run_config(
             "constrained", N_HEADLINE_PODS, N_HEADLINE_TYPES, trials=1,
             with_oracle=False,
@@ -822,6 +903,13 @@ def main() -> None:
             grid.append(run_constraint_churn(cfg, n_pods))
         except Exception as exc:  # pragma: no cover - bench resilience
             print(f"bench: {cfg}-{n_pods} failed: {exc}", file=sys.stderr)
+
+    # ISSUE 12: the cluster-twin row — sustained roster throughput and
+    # worst-minute SLO margins over a deterministic churn replay
+    try:
+        grid.append(run_twin(2_000, minutes=10))
+    except Exception as exc:  # pragma: no cover - bench resilience
+        print(f"bench: twin row failed: {exc}", file=sys.stderr)
 
     # the north star: 50k constrained pods x 800 types (BASELINE config[2])
     headline = run_config(

@@ -58,6 +58,14 @@ PY
 echo "== trace smoke (bench smoke with tracing) =="
 python hack/trace_smoke.py
 
+# twin smoke: a fixed-seed cluster twin replays a few simulated minutes
+# of churn (spot reclaim + ICE wave included) over the full roster with
+# the per-minute SLO wall asserting, re-runs, and pins the canonical
+# audit artifact byte-identical — all inside a wall-time budget (the
+# replay-determinism fast lane; the day-scale soak is `slow`-marked)
+echo "== twin smoke (fixed seed, SLO wall, budgeted) =="
+python hack/twin_smoke.py
+
 # slow lane: the full analysis over every default target, with the
 # stale-suppression audit (STALE001) on, behind a wall-time budget —
 # analyzer-speed regressions fail here before they bloat every local

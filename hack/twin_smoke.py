@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Presubmit fast-lane twin smoke (ISSUE 12).
+
+A fixed-seed cluster twin replays a few simulated minutes of churn —
+including one spot-reclaim and one ICE wave — over the full operator
+roster with the per-minute SLO wall ASSERTING, then re-runs and pins
+the canonical audit artifact byte-identical (the replay-determinism
+contract), all under a wall-time budget like the analyzer's 60 s lane.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_SECONDS = 90.0  # ~25 s today; headroom for slower hosts
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "cpu") in ("cpu", "axon"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from karpenter_tpu.sim import trace as trace_mod
+    from karpenter_tpu.sim.slo import SLOConfig
+    from karpenter_tpu.sim.twin import ClusterProfile, ClusterTwin, TwinConfig
+
+    t0 = time.perf_counter()
+    profile = ClusterProfile(nodes=80, pods_per_node=6)
+    events = trace_mod.generate(
+        3,
+        trace_mod.ChurnProfile(
+            minutes=4, pods_per_minute=4,
+            reclaim_minutes=(1,), ice_minutes=(2,),
+        ),
+    )
+    cfg = TwinConfig(
+        seed=3, minutes=4, steps_per_minute=2,
+        slo=SLOConfig(cost_check_every=2),
+    )
+
+    def one_run():
+        with ClusterTwin(events, profile=profile, config=cfg) as twin:
+            reports = twin.run()  # SLO wall asserts per minute
+            return twin.canonical_audit(), reports, twin
+
+    audit_a, reports, twin = one_run()
+    audit_b, _, _ = one_run()
+    assert audit_a == audit_b, "twin replay is not byte-deterministic"
+    assert len(reports) == cfg.minutes
+    assert all(not r.violations for r in reports)
+    worst = max(reports, key=lambda r: r.p99_latency_ms)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < BUDGET_SECONDS, (
+        f"twin smoke took {elapsed:.1f}s, over the {BUDGET_SECONDS:.0f}s "
+        "budget — profile the replay loop (binder, scenario.build, "
+        "consolidation probe budget)"
+    )
+    print(
+        f"twin smoke OK in {elapsed:.1f}s (budget {BUDGET_SECONDS:.0f}s): "
+        f"{cfg.minutes} simulated minutes, worst-minute "
+        f"p99={worst.p99_latency_ms:.0f}ms, zero SLO violations, "
+        "byte-identical replay"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -252,6 +252,20 @@ class KwokCloudProvider(CloudProvider):
                 self._pending.append((now + 1.0, inst))
         return created
 
+    def reclaim(self, provider_id: str) -> bool:
+        """The cloud takes an instance back (a spot reclaim): the
+        instance terminates WITHOUT any claim/store involvement — exactly
+        what the control plane sees when real spot capacity vanishes. The
+        garbage-collection controller notices the missing instance on its
+        next pass and reaps the claim. Returns False for ids already
+        gone (idempotent, like the cloud's own eventual consistency)."""
+        inst = self._instances.pop(provider_id, None)
+        if inst is None:
+            return False
+        inst.terminated = True
+        self._tombstones.add(provider_id)
+        return True
+
     def delete(self, node_claim: NodeClaim) -> None:
         pid = node_claim.status.provider_id
         faults.hit(faults.PROVIDER_DELETE, provider_id=pid)
@@ -294,3 +308,37 @@ class KwokCloudProvider(CloudProvider):
 
     def is_drifted(self, node_claim: NodeClaim) -> str:
         return ""
+
+    # -- checkpoint (sim/twin.py) -----------------------------------------
+
+    def export_state(self) -> dict:
+        """The provider-side state the store CANNOT rebuild through
+        ``_rehydrate``: pending-registration due times, tombstones, ICE
+        entries, and the instance-id sequence. A resumed twin constructs a
+        fresh provider over the restored store (rehydration recovers the
+        fleet) and then applies this on top."""
+        seq = next(self._seq)
+        self._seq = itertools.count(seq)  # peeked, not consumed
+        return {
+            "seq": seq,
+            "pending": [(t, inst.provider_id) for t, inst in self._pending],
+            "tombstones": set(self._tombstones),
+            "ice": dict(self.ice_cache._until),
+            "ice_ttl": self.ice_cache.ttl,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self._seq = itertools.count(int(state["seq"]))
+        self._tombstones = set(state["tombstones"])
+        self.ice_cache._until = dict(state["ice"])
+        self.ice_cache.ttl = float(state["ice_ttl"])
+        # _rehydrate queued node-less instances at due=now; re-time them
+        # from the checkpoint (and drop rehydrated entries the checkpoint
+        # says were not pending — e.g. instances that registered between
+        # rehydration's guess and the interrupted run's reality)
+        by_pid = {inst.provider_id: inst for _, inst in self._pending}
+        self._pending = [
+            (t, by_pid[pid])
+            for t, pid in state["pending"]
+            if pid in by_pid
+        ]

@@ -74,12 +74,33 @@ class DisruptionContext:
     # KTPU_SCENARIO_BATCH=0; True/False force. The sequential per-probe
     # loop remains the fallback and the semantic reference either way.
     scenario_batch: object = None
+    # deterministic per-pass probe cap for the consolidation searches.
+    # The reference bounds them by WALL-clock timeouts
+    # (multinodeconsolidation.go:36, singlenodeconsolidation.go:34); under
+    # an injected clock simulated time stands still inside a reconcile
+    # pass, so a twin replaying a 2k-node cluster would sweep every
+    # candidate every pass. A probe budget is the deterministic analog:
+    # the sweep stops after N probes with the same resume semantics a
+    # timeout has (suppress_memoization + previously_unseen_node_pools).
+    # None = unbounded (production wall-clock bounds still apply).
+    probe_budget: object = None
+    # content-keyed cache of built ScenarioSimulator environments
+    # (helpers.ScenarioEnvCache): consolidation searches over an
+    # unchanged cluster/workload reuse the built Topology + solver and
+    # warm encode instead of re-paying the ~130 ms scenario.build per
+    # fresh environment (ISSUE 12 satellite; the dominant fixed cost of
+    # a 2k-node twin minute).
+    scenario_envs: object = None
 
     def __post_init__(self):
         if self.encode_cache is None:
             from ...solver.driver import EncodeCache
 
             self.encode_cache = EncodeCache()
+        if self.scenario_envs is None:
+            from .helpers import ScenarioEnvCache
+
+            self.scenario_envs = ScenarioEnvCache()
 
 
 @dataclass

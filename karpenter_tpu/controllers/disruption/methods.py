@@ -420,10 +420,16 @@ class MultiNodeConsolidation(ConsolidationBase):
         if evaluator is None:
             evaluator = self._sequential_evaluator(candidates, snapshot)
 
+        probe_budget = getattr(self.ctx, "probe_budget", None)
         lo, hi = 1, len(candidates)
         last_valid = Command()
         while lo <= hi:
             if self.ctx.clock.now() >= deadline:
+                break
+            if probe_budget is not None and self.last_probes >= probe_budget:
+                # deterministic per-pass cap (DisruptionContext.probe_budget):
+                # same bail-out as the wall-clock timeout, for harnesses
+                # whose injected clock stands still inside a pass
                 break
             mid = (lo + hi) // 2
             cmd = evaluator(mid, lo, hi)
@@ -493,6 +499,7 @@ class MultiNodeConsolidation(ConsolidationBase):
             encode_cache=self.ctx.encode_cache,
             state_snapshot=snapshot,
             solver_config=self.ctx.solver_config,
+            env_cache=getattr(self.ctx, "scenario_envs", None),
         )
 
         def evaluate_mids(mids: List[int]) -> bool:
@@ -593,8 +600,14 @@ class SingleNodeConsolidation(ConsolidationBase):
         # lazily so budget-exhausted reconciles don't pay the deep copy
         snapshot = self.ctx.cluster.nodes() if budgeted else []
         evaluator = self._sweep_evaluator(budgeted, snapshot)
+        probe_budget = getattr(self.ctx, "probe_budget", None)
         for i, c in enumerate(budgeted):
             if self.ctx.clock.now() >= deadline:
+                timed_out = True
+                break
+            if probe_budget is not None and self.last_probes >= probe_budget:
+                # deterministic per-pass cap — timeout semantics (resume
+                # from unseen pools next pass, no consolidated memo)
                 timed_out = True
                 break
             seen_pools.add(c.node_pool.name)
@@ -620,12 +633,24 @@ class SingleNodeConsolidation(ConsolidationBase):
         cache: Dict[int, Command] = {}
         sim: Optional[ScenarioSimulator] = None
         if _scenario_batching_enabled(self.ctx) and budgeted:
+            # under a probe budget the sweep can only reach the first
+            # budget(+chunk) candidates this pass — building the shared
+            # encoding over the full universe would pay a 20k-pod union
+            # encode for probes that cannot happen (the next pass resumes
+            # from the unseen pools with its own budget)
+            probe_budget = getattr(self.ctx, "probe_budget", None)
+            universe = (
+                budgeted
+                if probe_budget is None
+                else budgeted[: probe_budget + _SINGLE_NODE_BATCH]
+            )
             sim = ScenarioSimulator(
                 self.ctx.client, self.ctx.cluster, self.ctx.cloud_provider,
-                budgeted,
+                universe,
                 encode_cache=self.ctx.encode_cache,
                 state_snapshot=snapshot,
                 solver_config=self.ctx.solver_config,
+                env_cache=getattr(self.ctx, "scenario_envs", None),
             )
 
         def evaluate(i: int) -> Command:

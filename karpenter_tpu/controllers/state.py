@@ -414,6 +414,68 @@ class Cluster:
                 if pid in self._nodes:
                     self._nodes[pid].mark_for_deletion = False
 
+    # -- checkpoint (sim/twin.py) -----------------------------------------
+
+    def export_state(self) -> dict:
+        """The informer layer's in-memory knowledge, for the twin
+        checkpoint. The store alone cannot reproduce it: watch-fed
+        tracking legitimately LAGS the store (an in-place provider-id
+        mutation whose status update hit a conflict is visible to a
+        LIST but was never an event), iteration order of ``_nodes`` is
+        event-arrival order (and feeds encode row order, hence replay
+        determinism), and ``mark_for_deletion``/``nominated_until`` are
+        in-memory flags with no store representation at all."""
+        with self._lock:
+            return {
+                "tracked": [
+                    (
+                        pid,
+                        sn.node is not None,
+                        sn.node_claim is not None,
+                        sn.mark_for_deletion,
+                        sn.nominated_until,
+                    )
+                    for pid, sn in self._nodes.items()
+                ],
+                "claim_map": dict(self._claim_name_to_provider_id),
+                "node_map": dict(self._node_name_to_provider_id),
+                "pod_acks": dict(self._pod_acks),
+                "pods_schedulable": dict(self._pods_schedulable_times),
+                "pods_attempted": dict(self._pods_scheduling_attempted),
+                "consolidated_at": self._consolidated_at,
+                "unconsolidated_at": self._unconsolidated_at,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Reconcile a freshly LIST-built Cluster down to the
+        checkpointed knowledge: drop trackings the interrupted run had
+        not ingested yet (they will re-arrive as the same watch events),
+        restore the in-memory flags, and restore iteration order."""
+        with self._lock:
+            known = {t[0] for t in state["tracked"]}
+            for pid in [p for p in self._nodes if p not in known]:
+                del self._nodes[pid]
+            rebuilt: Dict[str, StateNode] = {}
+            for pid, has_node, has_claim, mark, nominated in state["tracked"]:
+                sn = self._nodes.get(pid)
+                if sn is None:
+                    continue
+                if not has_claim:
+                    sn.node_claim = None
+                if not has_node:
+                    sn.node = None
+                sn.mark_for_deletion = mark
+                sn.nominated_until = nominated
+                rebuilt[pid] = sn
+            self._nodes = rebuilt
+            self._claim_name_to_provider_id = dict(state["claim_map"])
+            self._node_name_to_provider_id = dict(state["node_map"])
+            self._pod_acks = dict(state["pod_acks"])
+            self._pods_schedulable_times = dict(state["pods_schedulable"])
+            self._pods_scheduling_attempted = dict(state["pods_attempted"])
+            self._consolidated_at = state["consolidated_at"]
+            self._unconsolidated_at = state["unconsolidated_at"]
+
     # -- watch handlers (informer controllers; state/informer/*.go) -------
 
     def _on_event(self, event: Event) -> None:

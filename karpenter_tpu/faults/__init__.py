@@ -189,6 +189,29 @@ class FaultInjector:
         for replay assertions."""
         self.enabled = False
 
+    # -- checkpoint (sim/twin.py) -------------------------------------------
+
+    def export_state(self) -> dict:
+        """RNG state + per-site counters + per-rule fired counts + the
+        firing log: a resumed twin replay reconstructs the injector from
+        the SAME rule plan and restores this, so the fault schedule
+        continues exactly where the interrupted run stopped."""
+        return {
+            "rng": self.rng.getstate(),
+            "enabled": self.enabled,
+            "calls": dict(self.calls),
+            "fired": [rule.fired for rule in self.rules],
+            "log": list(self.log),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.rng.setstate(state["rng"])
+        self.enabled = bool(state["enabled"])
+        self.calls = dict(state["calls"])
+        for rule, fired in zip(self.rules, state["fired"]):
+            rule.fired = fired
+        self.log = [tuple(entry) for entry in state["log"]]
+
 
 # -- process-global installation seam ---------------------------------------
 

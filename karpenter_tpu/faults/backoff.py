@@ -58,6 +58,14 @@ class Backoff:
             base *= 1.0 + self.jitter * self._rng.random()
         return base
 
+    def export_rng(self):
+        """Jitter-RNG state for the twin checkpoint: post-resume delays
+        must draw the same jitter the uninterrupted run would have."""
+        return self._rng.getstate()
+
+    def restore_rng(self, state) -> None:
+        self._rng.setstate(state)
+
     def call(self, fn: Callable[[], object], retriable=(Exception,)):
         attempt = 0
         while True:
@@ -124,6 +132,26 @@ class RetryTracker:
         live = set(live_keys)
         for key in [k for k in self._state if k not in live]:
             del self._state[key]
+
+    # -- checkpoint (sim/twin.py) -------------------------------------------
+
+    def export_state(self) -> dict:
+        """Per-key backoff deadlines + jitter-RNG state: a controller that
+        was mid-backoff at checkpoint time must stay backed off exactly as
+        long after resume, or the replay forks."""
+        return {
+            "rng": self._backoff.export_rng(),
+            "state": {
+                k: (st.attempts, st.next_at) for k, st in self._state.items()
+            },
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self._backoff.restore_rng(state["rng"])
+        self._state = {
+            k: _RetryState(attempts, next_at)
+            for k, (attempts, next_at) in state["state"].items()
+        }
 
 
 __all__ = ["Backoff", "RetryTracker"]

@@ -270,6 +270,39 @@ class SolverHealth:
             self._last_level = level
         DEGRADATION_RUNG.set(float(level))
 
+    # -- checkpoint (sim/twin.py) -------------------------------------------
+
+    def export_state(self) -> dict:
+        """Breaker/ladder state a resumed twin replay must carry over —
+        a half-open cool-down or a pending quarantine changes which rung
+        the NEXT solve tries, so losing it would fork the replay."""
+        return {
+            "quarantines": self.quarantines,
+            "delta_fallbacks": self.delta_fallbacks,
+            "last_level": self._last_level,
+            "breakers": {
+                rung: {
+                    "state": b.state,
+                    "failures": b.failures,
+                    "trips": b.trips,
+                    "opened_at": b._opened_at,
+                }
+                for rung, b in self.ladder.breakers.items()
+            },
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.quarantines = int(state["quarantines"])
+        self.delta_fallbacks = int(state["delta_fallbacks"])
+        self._last_level = int(state["last_level"])
+        for rung, bs in state["breakers"].items():
+            b = self.ladder.breakers[rung]
+            b.state = bs["state"]
+            b.failures = int(bs["failures"])
+            b.trips = int(bs["trips"])
+            b._opened_at = float(bs["opened_at"])
+        DEGRADATION_RUNG.set(float(self._level()))
+
     def _publish(self, reason: str, message: str) -> None:
         if self.recorder is None:
             return

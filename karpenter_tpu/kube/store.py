@@ -203,6 +203,34 @@ class Client:
     def deleted(self, obj) -> bool:
         return obj.metadata.deletion_timestamp is not None
 
+    # -- checkpoint (sim/twin.py) -----------------------------------------
+
+    def export_objects(self) -> dict:
+        """Deep-copied objects in insertion order plus the resource-version
+        counter — the store side of a twin checkpoint. Insertion order IS
+        part of cluster state here: list() serves it, and the reconcile
+        roster's iteration (and therefore replay determinism) follows it."""
+        with self._lock:
+            return {
+                "rv": self._rv,
+                "objects": [copy.deepcopy(o) for o in self._objects.values()],
+            }
+
+    def import_objects(self, state: dict) -> None:
+        """Restore an export_objects() dump into an EMPTY store. No watch
+        events fire — informer consumers (controllers/state.Cluster) are
+        constructed AFTER the import and ingest via their LIST pass, the
+        same recovery shape a live informer has after a restart."""
+        with self._lock:
+            if self._objects:
+                raise ValueError("import_objects requires an empty store")
+            for obj in state["objects"]:
+                stored = copy.deepcopy(obj)
+                key = self._key(stored)
+                self._objects[key] = stored
+                self._by_uid[stored.metadata.uid] = key
+            self._rv = int(state["rv"])
+
     @property
     def clock(self) -> Clock:
         return self._clock

@@ -83,7 +83,26 @@ def _audit_now() -> float:
 
 # the process-wide decision trail; always on (records never influence
 # decisions, and one small append per solve is noise next to the solve)
-AUDIT = AuditLog(clock=_audit_now)
+_DEFAULT_AUDIT = AuditLog(clock=_audit_now)
+AUDIT = _DEFAULT_AUDIT
+
+
+def install_audit(log: Optional[AuditLog] = None, maxlen: int = 65536) -> AuditLog:
+    """Swap the process-global decision trail for ``log`` (or a fresh,
+    larger-ring one) and return it — the cluster twin's isolation seam:
+    a twin run starts its audit trail at d000001 regardless of what the
+    process solved before, so canonical audit artifacts from two runs
+    compare byte-for-byte. Pair with :func:`uninstall_audit`. Call sites
+    read ``obs.AUDIT`` per record, so the swap takes effect immediately."""
+    global AUDIT
+    AUDIT = log if log is not None else AuditLog(maxlen=maxlen, clock=_audit_now)
+    return AUDIT
+
+
+def uninstall_audit() -> None:
+    """Restore the default process-wide trail after a twin run."""
+    global AUDIT
+    AUDIT = _DEFAULT_AUDIT
 
 
 def install(tracer: Tracer) -> Tracer:
@@ -126,7 +145,7 @@ def current_span():
 
 __all__ = [
     "Span", "Tracer", "PerfClock", "NOOP_SPAN", "PHASE_DURATION",
-    "AuditLog", "AuditRecord", "AUDIT",
+    "AuditLog", "AuditRecord", "AUDIT", "install_audit", "uninstall_audit",
     "TRACE_ID_METADATA_KEY", "PARENT_ID_METADATA_KEY",
     "install", "uninstall", "active", "span", "event", "current_span",
     "now", "duration_clock", "validate_chrome_trace",

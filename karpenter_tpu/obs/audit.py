@@ -90,6 +90,9 @@ class AuditLog:
         self._lock = threading.Lock()
         self._seq = 0
         self._clock = clock
+        # append observers (the twin's wall-latency sampler): called AFTER
+        # the record lands, outside the lock — observers may query the log
+        self._observers: List = []
 
     def _now(self) -> float:
         if self._clock is not None:
@@ -102,7 +105,21 @@ class AuditLog:
             self._seq += 1
             rec = AuditRecord(decision_id=f"d{self._seq:06d}", **fields)
             self._records.append(rec)
+        for cb in list(self._observers):
+            cb(rec)
         return rec
+
+    def on_record(self, callback) -> None:
+        """Register an append observer ``callback(record)`` — how the
+        cluster twin joins wall-clock latency samples to decision ids
+        without adding non-deterministic fields to the records."""
+        self._observers.append(callback)
+
+    def remove_observer(self, callback) -> None:
+        try:
+            self._observers.remove(callback)
+        except ValueError:
+            pass
 
     def __len__(self) -> int:
         return len(self._records)
@@ -117,7 +134,12 @@ class AuditLog:
         rung: Optional[str] = None,
         trace_id: Optional[str] = None,
         since: Optional[float] = None,
+        until: Optional[float] = None,
     ) -> List[AuditRecord]:
+        """Filtered records. ``since`` is inclusive, ``until`` exclusive —
+        the half-open [since, until) window the twin's per-minute SLO wall
+        slices the trail into (adjacent minutes never double-count a
+        record)."""
         with self._lock:
             records = list(self._records)
         return [
@@ -127,7 +149,32 @@ class AuditLog:
             and (rung is None or r.rung == rung)
             and (trace_id is None or r.trace_id == trace_id)
             and (since is None or r.timestamp >= since)
+            and (until is None or r.timestamp < until)
         ]
+
+    def window(self, since: float, until: float) -> List[AuditRecord]:
+        """All records in the half-open [since, until) window — one
+        simulated minute of the twin's SLO wall."""
+        return self.query(since=since, until=until)
+
+    def export_state(self) -> dict:
+        """Serializable full state (records + sequence counter) — the
+        twin checkpoints this so a resumed replay continues decision ids
+        ("d%06d") exactly where the interrupted run stopped."""
+        with self._lock:
+            return {
+                "seq": self._seq,
+                "maxlen": self._records.maxlen,
+                "records": [asdict(r) for r in self._records],
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            self._seq = int(state["seq"])
+            self._records = deque(
+                (AuditRecord(**r) for r in state["records"]),
+                maxlen=state.get("maxlen") or self._records.maxlen,
+            )
 
     def clear(self) -> None:
         with self._lock:

@@ -8,6 +8,7 @@ requeue loop), and ``run(until)`` advances simulated time.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Optional
 
@@ -236,38 +237,65 @@ class Operator:
             return
         self._requeue.success(name)
 
+    def roster(
+        self, force_provision: bool = False, force_disruption: bool = False
+    ):
+        """The ordered reconcile roster as (name, zero-arg callable)
+        pairs — the stepping seam. ``step()`` consumes it; the cluster
+        twin (sim/twin.py) iterates it directly so it can interleave
+        trace events and sample per-controller wall latency without the
+        roster order ever living in two places."""
+        entries = []
+        if hasattr(self.cloud_provider, "process_registrations"):
+            entries.append(
+                ("registrations", self.cloud_provider.process_registrations)
+            )
+        entries.append(
+            (
+                "provisioner",
+                functools.partial(
+                    self.provisioner.reconcile, force=force_provision
+                ),
+            )
+        )
+        entries.append(("lifecycle", self.lifecycle.reconcile_all))
+        entries.append(("termination", self.termination.reconcile_all))
+        entries.append(
+            ("nodeclaim_disruption", self.nodeclaim_disruption.reconcile_all)
+        )
+        entries.append(
+            ("nodepool_status", self.nodepool_status.reconcile_all)
+        )
+        entries.append(("expiration", self.expiration.reconcile_all))
+        entries.append(
+            ("garbage_collection", self.garbage_collection.reconcile)
+        )
+        if self.options.node_repair:
+            entries.append(("health", self.health.reconcile_all))
+        entries.append(("consistency", self.consistency.reconcile_all))
+        entries.append(
+            (
+                "disruption",
+                functools.partial(
+                    self.disruption.reconcile, force=force_disruption
+                ),
+            )
+        )
+        entries.append(("node_metrics", self.node_metrics.reconcile_all))
+        entries.append(
+            ("nodepool_metrics", self.nodepool_metrics.reconcile_all)
+        )
+        entries.append(("pod_metrics", self.pod_metrics.reconcile_all))
+        return entries
+
     def step(self, force_provision: bool = False, force_disruption: bool = False) -> None:
         """One reconcile pass over the roster. Non-leader replicas keep
         their watch-fed caches warm but do not reconcile
         (operator.go:137-141)."""
         if not self.is_leader():
             return
-        if hasattr(self.cloud_provider, "process_registrations"):
-            self._guarded(
-                "registrations", self.cloud_provider.process_registrations
-            )
-        self._guarded(
-            "provisioner", self.provisioner.reconcile, force=force_provision
-        )
-        self._guarded("lifecycle", self.lifecycle.reconcile_all)
-        self._guarded("termination", self.termination.reconcile_all)
-        self._guarded(
-            "nodeclaim_disruption", self.nodeclaim_disruption.reconcile_all
-        )
-        self._guarded("nodepool_status", self.nodepool_status.reconcile_all)
-        self._guarded("expiration", self.expiration.reconcile_all)
-        self._guarded(
-            "garbage_collection", self.garbage_collection.reconcile
-        )
-        if self.options.node_repair:
-            self._guarded("health", self.health.reconcile_all)
-        self._guarded("consistency", self.consistency.reconcile_all)
-        self._guarded(
-            "disruption", self.disruption.reconcile, force=force_disruption
-        )
-        self._guarded("node_metrics", self.node_metrics.reconcile_all)
-        self._guarded("nodepool_metrics", self.nodepool_metrics.reconcile_all)
-        self._guarded("pod_metrics", self.pod_metrics.reconcile_all)
+        for name, fn in self.roster(force_provision, force_disruption):
+            self._guarded(name, fn)
 
     def run(self, duration: float, tick: float = 1.0) -> None:
         """Advance simulated time, stepping each tick (TestClock only)."""

@@ -1,3 +1,7 @@
 from .binder import Binder
 
 __all__ = ["Binder"]
+
+# trace/slo/twin are imported as submodules (karpenter_tpu.sim.twin etc.)
+# rather than re-exported here: the binder is the only piece the operator
+# path needs, and the twin pulls in the whole controller roster.

@@ -29,36 +29,41 @@ class Binder:
 
     def bind_all(self) -> List[Pod]:
         """One binding pass; returns newly bound pods."""
+        all_pods = self.client.list(Pod)
+        pending = [p for p in all_pods if pod_utils.is_provisionable(p)]
+        if not pending:
+            # day-scale twin ticks hit this constantly: pay nothing when
+            # there is nothing to bind (the used/placement maps below are
+            # O(nodes + pods) but not free at 2k nodes / 20k pods)
+            return []
         nodes = [n for n in self.client.list(Node) if n.metadata.deletion_timestamp is None]
         bound = []
-        all_pods = self.client.list(Pod)
-        used = {
-            n.name: res.merge(
-                *(
-                    p.spec.requests
-                    for p in all_pods
-                    if p.spec.node_name == n.name and pod_utils.is_active(p)
-                )
-            )
-            if any(p.spec.node_name == n.name for p in all_pods)
-            else {}
-            for n in nodes
-        }
-        volume_usage = self._build_volume_usage(nodes, all_pods)
         nodes_by_name = {n.name: n for n in nodes}
+        # one pass over pods (not nodes x pods): group active bound pods
+        # by node, then fold each node's requests
+        by_node: Dict[str, List[Pod]] = {}
+        for p in all_pods:
+            if p.spec.node_name in nodes_by_name and pod_utils.is_active(p):
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        used = {n.name: {} for n in nodes}
+        used.update(
+            {
+                name: res.merge(*(p.spec.requests for p in plist))
+                for name, plist in by_node.items()
+            }
+        )
+        volume_usage = self._build_volume_usage(nodes, all_pods)
         placements = [
-            (p, nodes_by_name[p.spec.node_name])
-            for p in all_pods
-            if p.spec.node_name in nodes_by_name and pod_utils.is_active(p)
+            (p, nodes_by_name[name])
+            for name, plist in by_node.items()
+            for p in plist
         ]
         # only placements with anti-affinity terms can repel new pods; keep
         # the inverse-anti scan off the O(pods x nodes) hot path
         anti_placements = [
             (p, n) for p, n in placements if p.spec.pod_anti_affinity
         ]
-        for pod in all_pods:
-            if not pod_utils.is_provisionable(pod):
-                continue
+        for pod in pending:
             node = self._find_node(
                 pod, nodes, used, volume_usage, placements, anti_placements
             )

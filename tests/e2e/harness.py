@@ -238,26 +238,44 @@ class Scenario:
         )
 
 
-_ARTIFACT = os.path.join(os.path.dirname(__file__), "last_run.json")
+def artifact_dir() -> str:
+    """Where harness artifacts (phase timings, metrics expositions) land:
+    ``$KTPU_E2E_ARTIFACT_DIR`` when set (tests route it through
+    ``tmp_path``; CI points it at its artifact store), else a
+    per-process temp directory — NEVER the tracked tree (stray
+    last_run.json/metrics.prom files under tests/e2e were the failure
+    mode this replaces)."""
+    d = os.environ.get("KTPU_E2E_ARTIFACT_DIR")
+    if not d:
+        import tempfile
+
+        d = os.path.join(
+            tempfile.gettempdir(), f"ktpu-e2e-{os.getpid()}"
+        )
+    os.makedirs(d, exist_ok=True)
+    return d
 
 
 def record(scenario_name: str, timer: PhaseTimer, **extra) -> None:
-    """Append this scenario's phases to the artifact file, and flush the
-    metrics registry's Prometheus exposition next to it (the sim-harness
-    side of the Operator.shutdown dump — scenario runs leave a scrapeable
-    snapshot of every counter/gauge/histogram)."""
+    """Append this scenario's phases to the artifact file
+    (``<artifact_dir>/last_run.json``), and flush the metrics registry's
+    Prometheus exposition next to it (the sim-harness side of the
+    Operator.shutdown dump — scenario runs leave a scrapeable snapshot
+    of every counter/gauge/histogram)."""
+    out_dir = artifact_dir()
+    artifact = os.path.join(out_dir, "last_run.json")
     data = {}
-    if os.path.exists(_ARTIFACT):
+    if os.path.exists(artifact):
         try:
-            with open(_ARTIFACT) as fh:
+            with open(artifact) as fh:
                 data = json.load(fh)
         except Exception:
             data = {}
     entry: Dict[str, object] = dict(timer.phases)
     entry.update(extra)
     data[scenario_name] = entry
-    with open(_ARTIFACT, "w") as fh:
+    with open(artifact, "w") as fh:
         json.dump(data, fh, indent=1)
     from karpenter_tpu.metrics import REGISTRY
 
-    REGISTRY.dump(os.path.join(os.path.dirname(_ARTIFACT), "metrics.prom"))
+    REGISTRY.dump(os.path.join(out_dir, "metrics.prom"))

@@ -188,6 +188,42 @@ class TestCompareGrids:
         ]))
         assert compare_grids(old, new_bad) == 1
 
+    def test_twin_rows_enforced(self, tmp_path):
+        # ISSUE 12's twin row: roster wall time per simulated minute is
+        # the compare-gated number — a replay-loop regression (binder,
+        # scenario.build gone cold, consolidation sweeping unbudgeted)
+        # trips the gate like any solver regression
+        def twin_entry(best_ms):
+            return {
+                "config": "twin", "nodes": 500, "pods": 5000,
+                "minutes": 6, "best_ms": best_ms, "pods_per_sec": None,
+                "solves_per_sec": 2.0, "worst_minute_p99_ms": 1500.0,
+                "p99_margin_ms": 8500.0, "fallback_solves": 0,
+                "slo_violations": 0,
+            }
+
+        old = _write(tmp_path, "old.json", _grid("cpu", [twin_entry(1200.0)]))
+        new_ok = _write(
+            tmp_path, "new_ok.json", _grid("cpu", [twin_entry(1280.0)])
+        )
+        assert compare_grids(old, new_ok) == 0
+        new_bad = _write(
+            tmp_path, "new_bad.json", _grid("cpu", [twin_entry(2400.0)])
+        )
+        assert compare_grids(old, new_bad) == 1
+
+    def test_twin_row_live(self):
+        """The twin bench row, live at a small shape: sustained decision
+        traffic with zero fallbacks and zero SLO violations."""
+        import bench
+
+        row = bench.run_twin(60, minutes=3)
+        assert row["config"] == "twin"
+        assert row["decisions"] > 0
+        assert row["fallback_solves"] == 0
+        assert row["slo_violations"] == 0
+        assert row["best_ms"] > 0
+
     def test_constraint_churn_zero_fallbacks_live(self):
         """The acceptance gate, live at a small shape: the constrained mix
         churns with ZERO sequential fallbacks, rides row deltas, and an

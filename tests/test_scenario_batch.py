@@ -511,3 +511,141 @@ class TestSolveArgNames:
         assert args[enc.SOLVE_ARG_NAMES.index("g_count")] is snap.g_count
         assert args[enc.SOLVE_ARG_NAMES.index("n_tol")] is snap.n_tol
         assert args[enc.SOLVE_ARG_NAMES.index("well_known")] is snap.well_known
+
+
+class TestScenarioEnvCache:
+    """ISSUE 12 satellite: the built simulation environment (Topology +
+    solver + warm encode) is content-keyed and reused across consolidation
+    searches over an unchanged cluster — the scenario.build warm path."""
+
+    def _sim(self, ctx, candidates, snapshot):
+        from karpenter_tpu.controllers.disruption.helpers import (
+            ScenarioSimulator,
+        )
+
+        return ScenarioSimulator(
+            ctx.client, ctx.cluster, ctx.cloud_provider, candidates,
+            encode_cache=ctx.encode_cache, state_snapshot=snapshot,
+            solver_config=ctx.solver_config,
+            env_cache=ctx.scenario_envs,
+        )
+
+    def test_second_search_reuses_environment(self):
+        ctx = build_env(n_nodes=10, seed=3)
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        snapshot = ctx.cluster.nodes()
+        a = self._sim(ctx, candidates, snapshot)
+        assert not a.env_reused
+        b = self._sim(ctx, candidates, snapshot)
+        assert b.env_reused
+        assert b._solver is a._solver
+        # decisions from the reused environment match a fresh build
+        subsets = [candidates[:1], candidates[:2]]
+        res_a = a.solve(subsets)
+        res_b = b.solve(subsets)
+        assert res_a is not None and res_b is not None
+        for ra, rb in zip(res_a, res_b):
+            assert len(ra.new_node_claims) == len(rb.new_node_claims)
+            assert sorted(
+                it.name
+                for c in ra.new_node_claims
+                for it in c.instance_type_options[:1]
+            ) == sorted(
+                it.name
+                for c in rb.new_node_claims
+                for it in c.instance_type_options[:1]
+            )
+
+    def test_cluster_mutation_busts_the_cache(self):
+        from karpenter_tpu.api.objects import Pod
+
+        ctx = build_env(n_nodes=8, seed=5)
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        snapshot = ctx.cluster.nodes()
+        a = self._sim(ctx, candidates, snapshot)
+        assert not a.env_reused
+        # any store change that bumps a workload pod's resource version
+        # must miss: the environment baked the old content
+        pod = next(p for p in ctx.client.list(Pod) if p.spec.node_name)
+        ctx.client.update(pod)
+        snapshot2 = ctx.cluster.nodes()
+        candidates2, _ = _candidates_and_budgets(ctx, method)
+        b = self._sim(ctx, candidates2, snapshot2)
+        assert not b.env_reused
+
+    def test_ice_masked_catalog_busts_the_cache(self):
+        ctx = build_env(n_nodes=8, seed=6)
+        method = MultiNodeConsolidation(ctx)
+        candidates, _ = _candidates_and_budgets(ctx, method)
+        snapshot = ctx.cluster.nodes()
+        a = self._sim(ctx, candidates, snapshot)
+        assert not a.env_reused
+        # an ICE entry makes get_instance_types return fresh masked
+        # copies: identity-keyed catalog signature must miss
+        it = ctx.cloud_provider.get_instance_types(None)[0]
+        o = next(o for o in it.offerings if o.available)
+        ctx.cloud_provider.ice_cache.mark_unavailable(
+            it.name, o.zone(), o.capacity_type()
+        )
+        b = self._sim(ctx, candidates, snapshot)
+        assert not b.env_reused
+
+    def test_full_search_decisions_unchanged_by_cache(self):
+        """End-to-end: the same multi-node search with and without the
+        env cache produces identical commands."""
+        sigs = []
+        for enabled in (True, False):
+            ctx = build_env(n_nodes=12, seed=7)
+            if not enabled:
+                ctx.scenario_envs = None
+            method = MultiNodeConsolidation(ctx)
+            candidates, budgets = _candidates_and_budgets(ctx, method)
+            cmd = method.compute_command(candidates, budgets)
+            # a second search over the unchanged cluster (the twin-tick
+            # shape the cache serves)
+            cmd2 = method.compute_command(candidates, budgets)
+            sigs.append(
+                (_command_signature(cmd), _command_signature(cmd2))
+            )
+        assert sigs[0] == sigs[1]
+
+
+class TestProbeBudget:
+    """DisruptionContext.probe_budget: the deterministic per-pass probe
+    cap (the injected-clock analog of the reference's wall-clock sweep
+    timeouts)."""
+
+    def test_single_node_sweep_stops_at_budget(self):
+        ctx = build_env(n_nodes=16, seed=8)
+        ctx.probe_budget = 4
+        method = SingleNodeConsolidation(ctx)
+        candidates, budgets = _candidates_and_budgets(ctx, method)
+        assert len(candidates) > 4
+        cmd = method.compute_command(candidates, budgets)
+        assert method.last_probes <= 4 + 16  # budget + one chunk
+        if cmd.decision == "no-op":
+            # bailed like a timeout: no consolidated memo, unseen pools
+            # resume next pass
+            assert method.suppress_memoization
+
+    def test_multi_node_search_stops_at_budget(self):
+        ctx = build_env(n_nodes=14, seed=9)
+        ctx.probe_budget = 3
+        method = MultiNodeConsolidation(ctx)
+        candidates, budgets = _candidates_and_budgets(ctx, method)
+        method.compute_command(candidates, budgets)
+        # the batched prime may exceed the cap by one dispatch's worth,
+        # but the search loop itself stops consuming probes past it
+        assert method.last_probes <= 15 + 3
+
+    def test_unbudgeted_behavior_unchanged(self):
+        cmd_a, m_a = _run_multi(dict(n_nodes=12, seed=10), batched=True)
+        ctx = build_env(n_nodes=12, seed=10)
+        ctx.probe_budget = None
+        ctx.scenario_batch = True
+        method = MultiNodeConsolidation(ctx)
+        candidates, budgets = _candidates_and_budgets(ctx, method)
+        cmd_b = method.compute_command(candidates, budgets)
+        assert _command_signature(cmd_a) == _command_signature(cmd_b)

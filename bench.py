@@ -59,6 +59,12 @@ def _probe_tpu() -> bool:
         "import jax; d = jax.devices();"
         "print(d[0].platform, len(d))"
     )
+    # force the log level into the child: the probe replays the persistent
+    # compilation cache and its host-feature-mismatch warning blob
+    # otherwise floods the captured BENCH_*.json stderr tail (a parent
+    # environment that EXPORTS a lower level would win over setdefault)
+    env = dict(os.environ)
+    env["TF_CPP_MIN_LOG_LEVEL"] = "2"
     for attempt in range(2):
         try:
             out = subprocess.run(
@@ -66,6 +72,7 @@ def _probe_tpu() -> bool:
                 capture_output=True,
                 timeout=PROBE_TIMEOUT_S,
                 text=True,
+                env=env,
             )
             if out.returncode == 0:
                 print(f"bench: TPU probe ok: {out.stdout.strip()}", file=sys.stderr)
@@ -209,6 +216,42 @@ def _routed_fraction(solver, pods) -> float:
     return routed / max(len(pods), 1)
 
 
+def group_shape_columns(solver, pods) -> Dict:
+    """Group-axis shape of one encoded batch (ISSUE 13): how fragmented
+    the group axis is, what the pow2 bucket runs at, how many live
+    (group, key) pairs the segment index carries, and the anti-affinity
+    claim demand (pods of self-counted shared-hostname groups — each
+    forces up to cap-many claims, the diverse mix's ~1k one-pod claims).
+    Encodes against a throwaway vocab/cache so the solver's warm state
+    (prior snapshot, row banks, device buffers) is untouched — one cold
+    encode per grid row, outside the timed trials."""
+    import numpy as np
+
+    from karpenter_tpu.solver import encode as enc
+
+    groups, _ = enc.partition_and_group(pods, topology=solver.oracle.topology)
+    if not groups:
+        return {
+            "groups": 0, "bucketed_groups": 0, "live_gt_pairs": 0,
+            "antiaffinity_claims": 0,
+        }
+    templates = solver.oracle.templates
+    snap = enc.encode(
+        groups,
+        templates,
+        {t.node_pool_name: t.instance_type_options for t in templates},
+        daemon_overhead=solver.oracle.daemon_overhead,
+        pool_limits=solver.pool_limits,
+    )
+    anti = (np.asarray(snap.g_hstg) >= 0) & np.asarray(snap.g_hself)
+    return {
+        "groups": len(snap.groups),
+        "bucketed_groups": enc._next_pow2(len(snap.groups), floor=8),
+        "live_gt_pairs": int(np.asarray(snap.gk_w).sum()),
+        "antiaffinity_claims": int(np.asarray(snap.g_count)[anti].sum()),
+    }
+
+
 def run_config(
     config: str, n_pods: int, n_types: int, trials: int, with_oracle: bool
 ) -> Dict:
@@ -258,10 +301,25 @@ def run_config(
         # reference configs (diverse-ref, constrained) must report 0 now
         # that topology/minValues/volumes/reservations ride the kernel
         "fallback_solves": s.fallback_solves if s is not None else 0,
+        # ISSUE 13: relaxation pre-solver telemetry — the fraction of the
+        # batch the bulk pre-solver placed and the residual the exact
+        # kernel kept (0 / full on non-separable shapes), plus guard
+        # rejections (must stay 0: a reject means a full exact re-solve)
+        "relax_routed_fraction": round(
+            (s.last_relax_pods if s is not None else 0) / max(len(pods), 1),
+            4,
+        ),
+        "residual_pods": (
+            s.last_relax_residual_pods
+            if s is not None and s.last_relax_pods
+            else len(pods)
+        ),
+        "relax_rejects": s.relax_rejects if s is not None else 0,
     }
     # phase attribution from one extra traced solve (compiled shapes are
     # already warm, so this costs one execution, not a compile)
     entry.update(_phase_columns(lambda: make_solver().solve(pods)))
+    entry.update(group_shape_columns(solver, pods))
 
     if with_oracle and n_pods <= ORACLE_POD_BUDGET:
         t0 = time.perf_counter()
@@ -400,6 +458,7 @@ def run_churn(
         **warm_phases,
         "cold_encode_ms": cold_phases["encode_ms"],
         "cold_transfer_ms": cold_phases["transfer_ms"],
+        **group_shape_columns(warm_solver, pods),
     }
 
 
@@ -491,6 +550,7 @@ def run_constraint_churn(
         "full_encodes": full_encodes,
         "repeat_reused": repeat_reused,
         "fallback_solves": fallbacks,
+        **group_shape_columns(s2, pods),
     }
 
 

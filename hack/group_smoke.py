@@ -1,0 +1,140 @@
+"""Presubmit group-heavy smoke (ISSUE 13).
+
+A small fixed-seed diverse shape (the group-heavy reference mix: ~5
+classes fragmenting into hundreds of tiny groups with spread /
+anti-affinity topology) must:
+
+- stay fully kernel-routed (``fallback_solves == 0``);
+- produce decisions IDENTICAL between the relax-enabled production path
+  and a forced-exact solve (the relaxation decision-parity gate — on
+  this mix nothing is separable, so the planner must route the full
+  residual), and identical between relax-enabled runs of a separable
+  bulk batch and its forced-exact twin (the routed-path parity gate);
+- finish the warm solve inside a kernel-ms budget (the order-of-
+  magnitude kernel-work regression wall; generous vs the measured
+  number so scheduler jitter cannot flake presubmit).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if (jax.config.jax_platforms or "axon").split(",")[0] == "axon":
+    jax.config.update("jax_platforms", "cpu")
+
+N_PODS = 600
+N_TYPES = 60
+SEED = 13
+# warm end-to-end budget on the CPU fallback host: measured ~42 ms for
+# this shape after the segment/bucketing/NMAX work; ~10x headroom for CI
+# noise (the pre-PR kernel ran this shape at ~5x the budget)
+BUDGET_MS = 400.0
+
+
+def _solve(pods, relax: bool):
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+    from karpenter_tpu.solver.example import example_nodepool
+
+    pools = [example_nodepool()]
+    its = {pools[0].name: corpus.generate(N_TYPES)}
+    cache = EncodeCache()
+
+    def once():
+        topology = Topology(Client(TestClock()), [], pools, its, pods)
+        return TpuSolver(
+            pools, its, topology,
+            config=SolverConfig(relax=relax), encode_cache=cache,
+        )
+
+    once().solve(pods)  # a-priori NMAX compile
+    once().solve(pods)  # adaptive NMAX compile
+    s = once()
+    t0 = time.perf_counter()
+    r = s.solve(pods)
+    return s, r, (time.perf_counter() - t0) * 1000.0
+
+
+def _canon(results):
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(results.pod_errors),
+    )
+
+
+def main() -> int:
+    from karpenter_tpu.api import labels as labels_mod
+    from karpenter_tpu.api import resources as res
+    from karpenter_tpu.api.objects import ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.solver.workloads import diverse_reference_mix
+
+    pods = diverse_reference_mix(N_PODS, seed=SEED)
+    s_relax, r_relax, warm_ms = _solve(pods, relax=True)
+    assert s_relax.fallback_solves == 0, (
+        f"group-heavy smoke fell off the kernel path: "
+        f"{s_relax.last_fallback_reasons}"
+    )
+    assert not r_relax.pod_errors, r_relax.pod_errors
+    assert s_relax.relax_rejects == 0, "relax guard rejected on the smoke"
+    # diverse: nothing separable — the planner must hand the exact kernel
+    # the full batch, and decisions must pin against forced-exact
+    assert s_relax.last_relax_pods == 0
+    s_exact, r_exact, _ = _solve(pods, relax=False)
+    assert _canon(r_relax) == _canon(r_exact), (
+        "relax-enabled diverse decisions diverged from forced-exact"
+    )
+
+    # routed-path parity: a separable bulk (one uniform deployment per
+    # zone) must route through the relaxation and still pin decisions
+    zones = ["test-zone-a", "test-zone-b", "test-zone-c"]
+    bulk = [
+        Pod(
+            metadata=ObjectMeta(name=f"bulk-{i}"),
+            spec=PodSpec(
+                requests={
+                    res.CPU: (1 + i % 3) * 500,
+                    res.MEMORY: 2**30 * res.MILLI,
+                },
+                node_selector={labels_mod.TOPOLOGY_ZONE: zones[i % 3]},
+            ),
+        )
+        for i in range(300)
+    ]
+    sb, rb, _ = _solve(bulk, relax=True)
+    assert sb.last_relax_pods == len(bulk), "separable bulk did not route"
+    sbe, rbe, _ = _solve(bulk, relax=False)
+    assert _canon(rb) == _canon(rbe), (
+        "relax-routed bulk decisions diverged from forced-exact"
+    )
+
+    assert warm_ms < BUDGET_MS, (
+        f"group-heavy warm solve {warm_ms:.0f} ms over the "
+        f"{BUDGET_MS:.0f} ms budget"
+    )
+    print(
+        f"group smoke OK: {N_PODS} diverse pods warm={warm_ms:.0f}ms "
+        f"(budget {BUDGET_MS:.0f}), fallback_solves=0, relax parity "
+        f"pinned (diverse residual=all, bulk routed={sb.last_relax_pods})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

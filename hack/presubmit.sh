@@ -66,6 +66,14 @@ python hack/trace_smoke.py
 echo "== twin smoke (fixed seed, SLO wall, budgeted) =="
 python hack/twin_smoke.py
 
+# group-heavy smoke (ISSUE 13): a fixed-seed diverse shape must stay
+# fully kernel-routed (fallback_solves=0), relaxation-vs-exact decisions
+# must pin (both the routed separable bulk and the all-residual diverse
+# mix), and the warm solve must hold the kernel-ms budget — the
+# order-of-magnitude group-axis work stays honest under regression
+echo "== group-heavy smoke (sparse/segment axis + relax parity) =="
+python hack/group_smoke.py
+
 # slow lane: the full analysis over every default target, with the
 # stale-suppression audit (STALE001) on, behind a wall-time budget —
 # analyzer-speed regressions fail here before they bloat every local

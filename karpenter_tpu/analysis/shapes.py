@@ -388,6 +388,61 @@ class _FunctionChecker(ast.NodeVisitor):
             return AV(kept, dtype)
         if tail == "einsum":
             return self._einsum_av(node)
+        # segment-op vocabulary (the sparse feasibility path,
+        # ops/feasibility.py:*_sparse): without these the abstract values
+        # degrade to unknown and the pass stops checking downstream joins
+        if tail == "segment_sum":
+            data = self.avof(node.args[0]) if node.args else UNKNOWN
+            num = kw.get("num_segments")
+            if num is None and len(node.args) > 2:
+                num = node.args[2]
+            seg_dim = self._axis_of_dim(num) if num is not None else None
+            if data.axes is not None and len(data.axes) >= 1:
+                return AV((seg_dim,) + tuple(data.axes[1:]), data.dtype)
+            return AV(None, data.dtype)
+        if tail == "take_along_axis" and len(node.args) >= 2:
+            arr = self.avof(node.args[0])
+            idx = self.avof(node.args[1])
+            axis = kw.get("axis")
+            if axis is None and len(node.args) > 2:
+                axis = node.args[2]
+            ax = None
+            if isinstance(axis, ast.Constant) and isinstance(axis.value, int):
+                ax = axis.value
+            elif (
+                isinstance(axis, ast.UnaryOp)
+                and isinstance(axis.op, ast.USub)
+                and isinstance(axis.operand, ast.Constant)
+            ):
+                ax = -axis.operand.value
+            if (
+                arr.axes is not None
+                and idx.axes is not None
+                and len(arr.axes) == len(idx.axes)
+                and ax is not None
+            ):
+                out = list(arr.axes)
+                out[ax % len(out)] = idx.axes[ax % len(out)]
+                return AV(tuple(out), arr.dtype)
+            return AV(None, arr.dtype)
+        if tail == "take" and len(node.args) >= 2:
+            arr = self.avof(node.args[0])
+            idx = self.avof(node.args[1])
+            axis = kw.get("axis")
+            if (
+                arr.axes is not None
+                and idx.axes is not None
+                and isinstance(axis, ast.Constant)
+                and isinstance(axis.value, int)
+            ):
+                ax = axis.value % len(arr.axes)
+                return AV(
+                    arr.axes[:ax] + idx.axes + arr.axes[ax + 1:], arr.dtype
+                )
+            return AV(None, arr.dtype)
+        if tail == "broadcast_to" and len(node.args) >= 2:
+            base = self.avof(node.args[0])
+            return AV(self._shape_axes(node.args[1]), base.dtype)
         return UNKNOWN
 
     def _method_av(self, node: ast.Call) -> AV:
@@ -683,6 +738,25 @@ class _FunctionChecker(ast.NodeVisitor):
                         )
             elif tail == "einsum":
                 self._einsum_av(node)  # flags letter conflicts
+            elif tail == "segment_sum" and len(node.args) >= 2:
+                data = self.avof(node.args[0])
+                ids = self.avof(node.args[1])
+                if (
+                    data.axes is not None
+                    and ids.axes is not None
+                    and len(ids.axes) == 1
+                ):
+                    da, ia = data.axes[0], ids.axes[0]
+                    both_named = isinstance(da, str) and isinstance(ia, str)
+                    both_lits = isinstance(da, int) and isinstance(ia, int)
+                    if (both_named or both_lits) and da != ia:
+                        self._flag(
+                            "SHP601", node,
+                            f"segment_sum ids ride axis '{ia}' but the "
+                            f"data's segment axis is '{da}' — the "
+                            "compacted index and its payload are "
+                            "misaligned",
+                        )
         if isinstance(node.func, ast.Attribute):
             if node.func.attr == "astype" and node.args:
                 self._check_dtype_64(

@@ -118,9 +118,60 @@ def worst_launch_price(offerings: Sequence[Offering], reqs: Requirements) -> flo
     return _MAX_PRICE
 
 
-def min_compatible_price(it: InstanceType, reqs: Requirements) -> float:
+def _min_compatible_price_general(it: InstanceType, reqs: Requirements) -> float:
     ofs = compatible_offerings(available(it.offerings), reqs)
     return cheapest(ofs).price if ofs else _MAX_PRICE
+
+
+def min_compatible_price(
+    it: InstanceType, reqs: Requirements, _memo: Optional[dict] = None
+) -> float:
+    """Cheapest available compatible offering's price.
+
+    ``_memo`` (an order_by_price-scoped dict) caches the per-(key,
+    value-set) admission verdicts: one claim signature's catalog sort
+    asks the same few questions of hundreds of types' offerings.
+
+    Fast path: offering requirements are concrete In-sets (zone /
+    capacity-type / reservation id), so ``reqs.is_compatible(offering)``
+    folds to per-key ``Requirement.has`` membership — the general
+    Requirements walk costs ~5us per offering and dominates group-heavy
+    decodes (Results.truncate_instance_types sorts every distinct claim
+    signature's catalog through here; the diverse mix paid ~0.7 s/solve).
+    Offerings carrying complements or empty value sets take the exact
+    general path. Semantics are identical: compatible() only tests the
+    offering's keys for definedness (well-known allowance) and
+    intersects() only shared keys, and has_intersection against an In-set
+    is exactly any(existing.has(v))."""
+    best = _MAX_PRICE
+    wk = labels_mod.WELL_KNOWN_LABELS
+    for o in it.offerings:
+        if not o.available or o.price >= best:
+            continue
+        ok = True
+        for orq in o.requirements:
+            if orq.complement or not orq.values:
+                return min(best, _min_compatible_price_general(it, reqs))
+            mk = (orq.key, *sorted(orq.values)) if len(orq.values) > 1 \
+                else (orq.key, next(iter(orq.values)))
+            adm = _memo.get(mk) if _memo is not None else None
+            if adm is None:
+                if orq.key in reqs:
+                    rr = reqs.get(orq.key)
+                    adm = any(rr.has(v) for v in orq.values)
+                else:
+                    # custom label positively constrained offering-side
+                    # with no claim-side definition: Compatible's
+                    # asymmetry (requirements.py:compatible) rejects it
+                    adm = orq.key in wk
+                if _memo is not None:
+                    _memo[mk] = adm
+            if not adm:
+                ok = False
+                break
+        if ok:
+            best = o.price
+    return best
 
 
 def order_by_price(
@@ -128,7 +179,11 @@ def order_by_price(
 ) -> List[InstanceType]:
     """Sort by cheapest compatible available offering, name tie-break
     (reference: types.go:125-142)."""
-    return sorted(instance_types, key=lambda it: (min_compatible_price(it, reqs), it.name))
+    memo: dict = {}
+    return sorted(
+        instance_types,
+        key=lambda it: (min_compatible_price(it, reqs, memo), it.name),
+    )
 
 
 def compatible_instance_types(

@@ -25,7 +25,7 @@ from ...api.objects import (
     Taint,
 )
 from ...events import Event, Recorder
-from ...kube import Client
+from ...kube import Client, NotFoundError
 from ...metrics import Counter, Gauge
 from ..state import Cluster
 from .helpers import build_budget_mapping, get_candidates
@@ -160,12 +160,21 @@ class OrchestrationQueue:
                 )
                 return False
         for candidate in item.command.candidates:
-            claim = self.ctx.client.try_get(NodeClaim, candidate.node_claim.name)
-            if claim is not None and claim.metadata.deletion_timestamp is None:
-                self.ctx.client.delete(claim)
-            node = self.ctx.client.try_get(Node, candidate.node.name)
-            if node is not None and node.metadata.deletion_timestamp is None:
-                self.ctx.client.delete(node)
+            # try_get -> delete races the lifecycle thread's finalizer
+            # removal; a candidate vanishing mid-step is the desired
+            # outcome, not an error (queue.go runs client.IgnoreNotFound)
+            try:
+                claim = self.ctx.client.try_get(NodeClaim, candidate.node_claim.name)
+                if claim is not None and claim.metadata.deletion_timestamp is None:
+                    self.ctx.client.delete(claim)
+            except NotFoundError:
+                pass
+            try:
+                node = self.ctx.client.try_get(Node, candidate.node.name)
+                if node is not None and node.metadata.deletion_timestamp is None:
+                    self.ctx.client.delete(node)
+            except NotFoundError:
+                pass
         DECISIONS.inc(
             labels={
                 "decision": item.command.decision,
@@ -192,7 +201,10 @@ def _remove_disruption_taint(client: Client, node: Node) -> None:
         t for t in node.taints if t.key != labels_mod.DISRUPTED_TAINT_KEY
     ]
     if len(node.taints) != before:
-        client.update(node)
+        try:
+            client.update(node)
+        except NotFoundError:
+            pass  # terminated concurrently; taint is moot
 
 
 class DisruptionController:
@@ -315,11 +327,17 @@ class DisruptionController:
                         effect=taints_mod.NO_SCHEDULE,
                     )
                 )
-                self.ctx.client.update(node)
+                try:
+                    self.ctx.client.update(node)
+                except NotFoundError:
+                    pass  # terminated concurrently
             candidate.node_claim.conds().set(
                 COND_DISRUPTION_REASON, "True", command.reason, now=now
             )
-            self.ctx.client.update_status(candidate.node_claim)
+            try:
+                self.ctx.client.update_status(candidate.node_claim)
+            except NotFoundError:
+                pass  # finalized concurrently
             self.ctx.cluster.mark_for_deletion(candidate.provider_id)
             self.ctx.recorder.publish(
                 Event(
@@ -344,7 +362,10 @@ class DisruptionController:
                         for t in node.taints
                         if t.key != labels_mod.DISRUPTED_TAINT_KEY
                     ]
-                    self.ctx.client.update(node)
+                    try:
+                        self.ctx.client.update(node)
+                    except NotFoundError:
+                        pass  # terminated concurrently
                 self.ctx.cluster.unmark_for_deletion(candidate.provider_id)
                 self.ctx.recorder.publish(
                     Event(
@@ -373,6 +394,9 @@ class DisruptionController:
             # all-or-nothing: reap the replacements already created so a
             # partial launch doesn't orphan unneeded capacity
             for claim in created:
-                self.ctx.client.delete(claim)
+                try:
+                    self.ctx.client.delete(claim)
+                except NotFoundError:
+                    pass  # reaped concurrently
             raise
         return names

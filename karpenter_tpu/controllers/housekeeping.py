@@ -29,7 +29,7 @@ from ..api.objects import (
 )
 from ..cloudprovider.types import CloudProviderError, NodeClaimNotFoundError
 from ..events import Event, Recorder
-from ..kube import Client
+from ..kube import Client, NotFoundError
 from ..metrics import Counter
 from .nodeclaim_disruption import nodepool_hash
 from .state import Cluster
@@ -66,7 +66,10 @@ class ExpirationController:
                 self.recorder.publish(
                     Event(claim.uid, "Normal", "Expired", "nodeclaim expired")
                 )
-                self.client.delete(claim)
+                try:
+                    self.client.delete(claim)
+                except NotFoundError:
+                    pass  # finalized concurrently; already gone
 
 
 class GarbageCollectionController:
@@ -159,8 +162,11 @@ class HealthController:
             if pool_nodes and repairing >= allowed:
                 continue
             if node.metadata.deletion_timestamp is None:
+                try:
+                    self.client.delete(node)
+                except NotFoundError:
+                    continue  # terminated concurrently; nothing to repair
                 NODES_REPAIRED.inc(labels={"nodepool": pool})
-                self.client.delete(node)
                 pool_marked.add(node.name)
 
 
@@ -197,7 +203,10 @@ class ConsistencyController:
                 "True" if consistent else "False",
                 now=self.client.clock.now(),
             )
-            self.client.update_status(claim)
+            try:
+                self.client.update_status(claim)
+            except NotFoundError:
+                pass  # finalized concurrently; condition is moot
 
 
 class NodePoolStatusController:

@@ -32,7 +32,7 @@ from ..cloudprovider.types import (
 from ..events import Event, Recorder
 from ..faults.backoff import RetryTracker
 from ..kube import Client
-from ..kube.store import ConflictError
+from ..kube.store import ConflictError, NotFoundError
 from ..metrics import Counter
 
 LIVENESS_TTL = 15 * 60.0  # liveness.go:44
@@ -63,9 +63,10 @@ class LifecycleController:
         for claim in claims:
             try:
                 self.reconcile(claim)
-            except ConflictError:
-                # transient store conflict: the level-triggered loop
-                # retries this claim on the next pass with fresh state
+            except (ConflictError, NotFoundError):
+                # transient store conflict (or the claim finalized
+                # concurrently): the level-triggered loop retries this
+                # claim on the next pass with fresh state
                 continue
 
     def reconcile(self, claim: NodeClaim) -> None:

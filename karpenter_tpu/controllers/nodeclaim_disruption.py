@@ -22,7 +22,7 @@ from ..api.objects import (
     NodePool,
 )
 from ..api.requirements import Requirements
-from ..kube import Client
+from ..kube import Client, NotFoundError
 
 DRIFT_RECHECK = 300.0  # 5-min provider re-check
 
@@ -86,7 +86,10 @@ class NodeClaimDisruptionController:
             return
         self._consolidatable(claim, pool)
         self._drifted(claim, pool)
-        self.client.update_status(claim)
+        try:
+            self.client.update_status(claim)
+        except NotFoundError:
+            pass  # finalized concurrently; conditions are moot
 
     # -- Consolidatable (disruption/consolidation.go:38-79) ---------------
 

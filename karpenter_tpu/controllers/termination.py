@@ -23,7 +23,7 @@ from ..api.objects import (
 )
 from ..events import Event, Recorder
 from ..kube import Client
-from ..kube.store import ConflictError
+from ..kube.store import ConflictError, NotFoundError
 from ..metrics import Histogram
 from ..utils import pod as pod_utils
 from ..utils.pdb import Limits
@@ -75,9 +75,10 @@ class TerminationController:
             if node.metadata.deletion_timestamp is not None:
                 try:
                     self.reconcile(node)
-                except ConflictError:
-                    # transient store conflict mid-drain: termination is
-                    # re-entrant per step, the next pass resumes this node
+                except (ConflictError, NotFoundError):
+                    # transient store conflict (or a concurrent deleter
+                    # won) mid-drain: termination is re-entrant per step,
+                    # the next pass resumes this node
                     continue
 
     def reconcile(self, node: Node) -> None:

@@ -55,13 +55,17 @@ ENCODE_DELTA = "solver.encode_delta"
 DISPATCH_QUEUE = "solver.dispatch_queue"
 REMOTE_SOLVE = "remote.solve"
 NATIVE_LOAD = "native.load"
+# relaxation bulk pre-solver (ops/relax.py): mutate corrupts the bulk
+# outputs before the merge — the combined solve must trip the invariant
+# guard and shed to the full exact kernel, never commit
+RELAX_OUTPUT = "solver.relax_output"
 
 ALL_SITES = (
     STORE_CREATE, STORE_UPDATE, STORE_DELETE,
     PROVIDER_CREATE, PROVIDER_DELETE, PROVIDER_REGISTER,
     SOLVER_DISPATCH, SOLVER_OUTPUT, SOLVER_SCENARIOS,
     ENCODE_DELTA, DISPATCH_QUEUE,
-    REMOTE_SOLVE, NATIVE_LOAD,
+    REMOTE_SOLVE, NATIVE_LOAD, RELAX_OUTPUT,
 )
 
 
@@ -253,7 +257,7 @@ __all__ = [
     "install", "uninstall", "active", "hit", "mutate",
     "STORE_CREATE", "STORE_UPDATE", "STORE_DELETE",
     "PROVIDER_CREATE", "PROVIDER_DELETE", "PROVIDER_REGISTER",
-    "SOLVER_DISPATCH", "SOLVER_OUTPUT", "SOLVER_SCENARIOS",
+    "SOLVER_DISPATCH", "SOLVER_OUTPUT", "SOLVER_SCENARIOS", "RELAX_OUTPUT",
     "ENCODE_DELTA", "DISPATCH_QUEUE",
     "REMOTE_SOLVE", "NATIVE_LOAD", "ALL_SITES",
 ]

@@ -97,19 +97,25 @@ def solve_core_native(
     nh_cnt0, dd0, dtg_key,
     well_known,
     p_mvmin, t_mvoh,
-    nmax: int,
-    zone_kid: int,
-    ct_kid: int,
+    gk_g=None, gk_k=None, gk_w=None, goff_idx=None,
+    nmax: int = 0,
+    zone_kid: int = 0,
+    ct_kid: int = 0,
     has_domains: bool = True,  # trace-time gate for the JAX twin; unused here
     has_contrib: bool = False,  # trace-time gate for the JAX twin; unused here
     tile_feasibility: bool = False,  # JAX execution strategy; unused here
     wf_iters: int = 32,  # JAX bisection budget; the C++ core is exact
+    sparse_groups: bool = False,  # JAX table strategy; the core is sparse-always
 ) -> Tuple[np.ndarray, ...]:
     """Same contract as ops/solve.py::solve_core (and solve_all), on host.
 
     ``has_domains`` is accepted for call-site symmetry with the jitted
     kernel; the C++ core branches on g_dmode at runtime, so no gating is
-    needed."""
+    needed. The compacted segment index (gk_*/goff_idx) is likewise
+    accepted for tuple symmetry but not marshalled: the core derives the
+    same neutral-row mask internally (solve_core.cc feasibility section)
+    and applies the identical hoisted-base + live-pair-correction
+    structure unconditionally."""
     lib = _load()
 
     g_count = _as(g_count, np.int32)

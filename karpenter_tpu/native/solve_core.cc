@@ -68,41 +68,6 @@ struct Dims {
   int G, T, P, N, R, K, V1, O, NMAX, zone_kid, ct_kid;
 };
 
-// Requirements.Intersects over one (K,V1) mask pair
-// (ops/feasibility.py:19-30).
-inline bool req_intersect(const uint8_t* a_def, const uint8_t* a_neg,
-                          const uint8_t* a_mask, const uint8_t* b_def,
-                          const uint8_t* b_neg, const uint8_t* b_mask, int K,
-                          int V1) {
-  for (int k = 0; k < K; ++k) {
-    bool overlap = false;
-    const uint8_t* am = a_mask + k * V1;
-    const uint8_t* bm = b_mask + k * V1;
-    for (int v = 0; v < V1; ++v)
-      if (am[v] && bm[v]) {
-        overlap = true;
-        break;
-      }
-    bool exempt = a_neg[k] && b_neg[k];
-    bool both = a_def[k] && b_def[k];
-    if (!(overlap || exempt || !both)) return false;
-  }
-  return true;
-}
-
-// Requirements.Compatible with the well-known allowance
-// (ops/feasibility.py:33-42).
-inline bool req_compatible(const uint8_t* n_def, const uint8_t* n_neg,
-                           const uint8_t* n_mask, const uint8_t* p_def,
-                           const uint8_t* p_neg, const uint8_t* p_mask,
-                           const uint8_t* well_known, int K, int V1) {
-  for (int k = 0; k < K; ++k) {
-    bool wk = well_known ? well_known[k] : false;
-    if (p_def[k] && !wk && !n_def[k] && !p_neg[k]) return false;
-  }
-  return req_intersect(n_def, n_neg, n_mask, p_def, p_neg, p_mask, K, V1);
-}
-
 // Type t has an available offering in domain slot d of the constrained axis
 // (dkey 0 = zone-major of a_tzc, 1 = capacity-type) under `other` on the
 // other axis. Callers separately require the constrained-axis mask to admit
@@ -282,45 +247,126 @@ int kt_solve(
 
   // ---- feasibility tables (ops/feasibility.py) ------------------------
   // compat_pg [P,G], type_ok_pgt [P,G,T], n_fit_pgt [P,G,T]
+  //
+  // Sparse/segment mirror of fresh_claim_feasibility_sparse: a (group,
+  // key) pair is *live* when its requirement row differs from the neutral
+  // (undefined, non-negated, all-true) row — the same live set the
+  // encoder's compacted nonzero-mask index (encode.build_segment_index)
+  // names for the JAX twins. Neutral rows collapse every intersect term
+  // to the group-independent template-vs-type base, so the base is
+  // hoisted out of the G loop and each group touches only its live keys;
+  // cost scales with live pairs instead of G x K x V1.
   std::vector<uint8_t> compat_pg(P * G);
   std::vector<uint8_t> type_ok_pgt(static_cast<size_t>(P) * G * T);
   std::vector<int32_t> n_fit_pgt(static_cast<size_t>(P) * G * T);
-  // merged claim requirement state per (p,g)
-  std::vector<uint8_t> c_def_pg(K), c_neg_pg(K), c_mask_pg(KV);
-  std::vector<uint8_t> t_neg_zero(K, 0);
+
+  // live (group, key) pairs, CSR-style per group
+  std::vector<int32_t> live_start(G + 1, 0);
+  std::vector<int32_t> live_key;
+  live_key.reserve(G);
+  for (int g = 0; g < G; ++g) {
+    for (int k = 0; k < K; ++k) {
+      bool neutral = !g_def[g * K + k] && !g_neg[g * K + k];
+      if (neutral) {
+        const uint8_t* gm = g_mask + g * KV + k * V1;
+        for (int v = 0; v < V1 && neutral; ++v) neutral = gm[v];
+      }
+      if (!neutral) live_key.push_back(k);
+    }
+    live_start[g + 1] = static_cast<int32_t>(live_key.size());
+  }
+
+  // hoisted per-(p,t,k) base term + per-(p,t) failure totals
+  // base_ok = any_v(t_mask & p_mask) | !(t_def & p_def)
+  std::vector<uint8_t> base_fail_ptk(static_cast<size_t>(P) * T * K);
+  std::vector<int32_t> base_total_pt(static_cast<size_t>(P) * T, 0);
+  std::vector<uint8_t> off_base_pt(static_cast<size_t>(P) * T);
+  for (int p = 0; p < P; ++p) {
+    for (int t = 0; t < T; ++t) {
+      int32_t total = 0;
+      for (int k = 0; k < K; ++k) {
+        bool overlap = false;
+        const uint8_t* tm = t_mask + t * KV + k * V1;
+        const uint8_t* pm = p_mask + p * KV + k * V1;
+        for (int v = 0; v < V1 && !overlap; ++v) overlap = tm[v] && pm[v];
+        bool ok = overlap || !(t_def[t * K + k] && p_def[p * K + k]);
+        base_fail_ptk[(static_cast<size_t>(p) * T + t) * K + k] = !ok;
+        total += !ok;
+      }
+      base_total_pt[static_cast<size_t>(p) * T + t] = total;
+      // template-only offering base (neutral zone/ct rows leave the
+      // merged mask equal to the template's)
+      bool off = false;
+      const uint8_t* pzm = p_mask + p * KV + zone_kid * V1;
+      const uint8_t* pcm = p_mask + p * KV + ct_kid * V1;
+      for (int o = 0; o < O && !off; ++o) {
+        if (!o_avail[t * O + o]) continue;
+        int32_t z = o_zone[t * O + o], c = o_ct[t * O + o];
+        off = ((z < 0) || pzm[z]) && ((c < 0) || pcm[c]);
+      }
+      off_base_pt[static_cast<size_t>(p) * T + t] = off;
+    }
+  }
 
   for (int p = 0; p < P; ++p) {
     for (int g = 0; g < G; ++g) {
-      bool compat =
-          p_tol[p * G + g] &&
-          req_compatible(p_def + p * K, p_neg + p * K, p_mask + p * KV,
-                         g_def + g * K, g_neg + g * K, g_mask + g * KV,
-                         well_known, K, V1);
-      compat_pg[p * G + g] = compat;
-      // merged = template ∪ group (merge_requirements)
-      for (int k = 0; k < K; ++k) {
-        c_def_pg[k] = p_def[p * K + k] || g_def[g * K + k];
-        c_neg_pg[k] = p_neg[p * K + k] && g_neg[g * K + k];
-        for (int v = 0; v < V1; ++v)
-          c_mask_pg[k * V1 + v] =
-              p_mask[p * KV + k * V1 + v] && g_mask[g * KV + k * V1 + v];
+      int32_t ls = live_start[g], le = live_start[g + 1];
+      // pod-vs-template compatibility: neutral keys are vacuous for both
+      // the intersect term and the custom-label allowance
+      bool compat = p_tol[p * G + g];
+      for (int32_t l = ls; l < le && compat; ++l) {
+        int k = live_key[l];
+        bool overlap = false;
+        const uint8_t* pm = p_mask + p * KV + k * V1;
+        const uint8_t* gm = g_mask + g * KV + k * V1;
+        for (int v = 0; v < V1 && !overlap; ++v) overlap = pm[v] && gm[v];
+        bool exempt = p_neg[p * K + k] && g_neg[g * K + k];
+        bool both = p_def[p * K + k] && g_def[g * K + k];
+        bool custom = !g_def[g * K + k] || well_known[k] ||
+                      p_def[p * K + k] || g_neg[g * K + k];
+        compat = (overlap || exempt || !both) && custom;
       }
+      compat_pg[p * G + g] = compat;
+      // merged zone/ct offering rows only differ when those rows are live
+      bool zc_live = false;
+      for (int32_t l = ls; l < le && !zc_live; ++l)
+        zc_live = live_key[l] == zone_kid || live_key[l] == ct_kid;
       for (int t = 0; t < T; ++t) {
         size_t idx = (static_cast<size_t>(p) * G + g) * T + t;
         int32_t nf = fits_count(t_alloc + t * R, p_daemon + p * R,
                                 g_req + g * R, R);
         n_fit_pgt[idx] = nf;
-        bool tc = req_intersect(t_def + t * K, t_neg_zero.data(),
-                                t_mask + t * KV, c_def_pg.data(),
-                                c_neg_pg.data(), c_mask_pg.data(), K, V1);
-        // offering_ok against merged zone/ct masks
-        bool off = false;
-        for (int o = 0; o < O && !off; ++o) {
-          if (!o_avail[t * O + o]) continue;
-          int32_t z = o_zone[t * O + o], c = o_ct[t * O + o];
-          bool z_ok = (z < 0) || c_mask_pg[zone_kid * V1 + z];
-          bool c_ok = (c < 0) || c_mask_pg[ct_kid * V1 + c];
-          off = z_ok && c_ok;
+        // type intersect: hoisted base failures +/- live-pair corrections
+        int32_t fail = base_total_pt[static_cast<size_t>(p) * T + t];
+        for (int32_t l = ls; l < le; ++l) {
+          int k = live_key[l];
+          bool overlap3 = false;
+          const uint8_t* tm = t_mask + t * KV + k * V1;
+          const uint8_t* pm = p_mask + p * KV + k * V1;
+          const uint8_t* gm = g_mask + g * KV + k * V1;
+          for (int v = 0; v < V1 && !overlap3; ++v)
+            overlap3 = tm[v] && pm[v] && gm[v];
+          bool cdef = p_def[p * K + k] || g_def[g * K + k];
+          bool pair_ok = overlap3 || !(t_def[t * K + k] && cdef);
+          fail += static_cast<int32_t>(!pair_ok) -
+                  base_fail_ptk[(static_cast<size_t>(p) * T + t) * K + k];
+        }
+        bool tc = fail == 0;
+        bool off;
+        if (zc_live) {
+          off = false;
+          const uint8_t* pzm = p_mask + p * KV + zone_kid * V1;
+          const uint8_t* pcm = p_mask + p * KV + ct_kid * V1;
+          const uint8_t* gzm = g_mask + g * KV + zone_kid * V1;
+          const uint8_t* gcm = g_mask + g * KV + ct_kid * V1;
+          for (int o = 0; o < O && !off; ++o) {
+            if (!o_avail[t * O + o]) continue;
+            int32_t z = o_zone[t * O + o], c = o_ct[t * O + o];
+            off = ((z < 0) || (pzm[z] && gzm[z])) &&
+                  ((c < 0) || (pcm[c] && gcm[c]));
+          }
+        } else {
+          off = off_base_pt[static_cast<size_t>(p) * T + t];
         }
         type_ok_pgt[idx] = tc && off && (nf >= 1) &&
                            p_titype_ok[p * T + t] && compat;
@@ -328,16 +374,25 @@ int kt_solve(
     }
   }
 
-  // cap_ng [N, G] (existing_node_feasibility; strict compatibility)
+  // cap_ng [N, G] (existing_node_feasibility; strict compatibility —
+  // same live-pair contraction: neutral keys are vacuous node-side too)
   std::vector<int32_t> cap_ng(static_cast<size_t>(N) * G, 0);
-  std::vector<uint8_t> n_neg_zero(K, 0);
   for (int n = 0; n < N; ++n) {
     for (int g = 0; g < G; ++g) {
       if (!n_tol[n * G + g]) continue;
-      if (!req_compatible(n_def + n * K, n_neg_zero.data(), n_mask + n * KV,
-                          g_def + g * K, g_neg + g * K, g_mask + g * KV,
-                          nullptr, K, V1))
-        continue;
+      bool compat = true;
+      for (int32_t l = live_start[g]; l < live_start[g + 1] && compat; ++l) {
+        int k = live_key[l];
+        bool overlap = false;
+        const uint8_t* nm = n_mask + n * KV + k * V1;
+        const uint8_t* gm = g_mask + g * KV + k * V1;
+        for (int v = 0; v < V1 && !overlap; ++v) overlap = nm[v] && gm[v];
+        bool both = n_def[n * K + k] && g_def[g * K + k];
+        bool custom = !g_def[g * K + k] || n_def[n * K + k] ||
+                      g_neg[g * K + k];
+        compat = (overlap || !both) && custom;
+      }
+      if (!compat) continue;
       cap_ng[static_cast<size_t>(n) * G + g] =
           fits_count(n_avail + n * R, n_base + n * R, g_req + g * R, R);
     }

@@ -137,6 +137,163 @@ def fresh_claim_feasibility(
     return compat_pg, type_ok, n_fit
 
 
+@partial(jax.jit, static_argnames=("zone_kid", "ct_kid"))
+def fresh_claim_feasibility_sparse(
+    g_def, g_neg, g_mask, g_req,
+    p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+    t_def, t_mask, t_alloc,
+    o_avail, o_zone, o_ct,
+    well_known,
+    gk_g, gk_k, gk_w, goff_idx,
+    zone_kid: int,
+    ct_kid: int,
+):
+    """fresh_claim_feasibility restructured as a segment contraction over
+    the encoder's compacted nonzero-mask index (encode.build_segment_index)
+    — bit-exact with the dense twin (tests/test_sparse_feasibility.py).
+
+    The dense form materializes the [P, G, T, K, V1] requirement join even
+    though almost every (group, key) row is *neutral* (undefined,
+    non-negated, all-true mask) on fragmented batches: a neutral row's
+    intersect term collapses to the group-independent template-vs-type
+    base. So the sparse form computes the base once per (p, t, k), counts
+    base failures, and corrects only the L live pairs: per pair, the
+    exact merged term replaces the base term via a +/-1 failure delta
+    summed back onto the group axis with segment_sum. Cost scales with
+    live (group, key) pairs — O(P*T*L*V1) — instead of O(P*G*T*K*V1).
+    Offerings get the same treatment: only groups whose zone/ct row is
+    non-neutral (goff_idx) have a merged offering row different from the
+    template's, so their true rows are recomputed and scattered over the
+    template-only base (idempotent under goff_idx's repeat-group-0 pad).
+    """
+    P, K, V1 = p_mask.shape
+    G = g_mask.shape[0]
+    T = t_mask.shape[0]
+
+    # ---- group-independent per-key base: template ∪ neutral-group vs type
+    # base_ok[p,t,k] = any_v(t_mask & p_mask) | ~(t_def & p_def)
+    ov_base = (
+        jnp.einsum(
+            "tkv,pkv->ptk",
+            t_mask.astype(jnp.float32), p_mask.astype(jnp.float32),
+        )
+        > 0
+    )  # [P, T, K]
+    base_ok = ov_base | ~(t_def[None, :, :] & p_def[:, None, :])
+    base_fail = (~base_ok).astype(jnp.int32)
+    base_total = jnp.sum(base_fail, axis=-1)  # [P, T]
+
+    # ---- live-pair corrections (type axis) ------------------------------
+    # exact merged term for pair l = (g, k): c_def = p_def | g_def (True
+    # when g defines; p_def otherwise), exempt = t_neg(=0) & c_neg = 0
+    gm_l = g_mask[gk_g, gk_k]  # [L, V1]
+    tm_l = jnp.take(t_mask, gk_k, axis=1)  # [T, L, V1]
+    pm_l = jnp.take(p_mask, gk_k, axis=1)  # [P, L, V1]
+    ov3 = (
+        jnp.einsum(
+            "tlv,plv->ptl",
+            (tm_l & gm_l[None, :, :]).astype(jnp.float32),
+            pm_l.astype(jnp.float32),
+        )
+        > 0
+    )  # [P, T, L]
+    cdef_l = jnp.take(p_def, gk_k, axis=1) | g_def[gk_g, gk_k][None, :]  # [P, L]
+    pair_ok = ov3 | ~(
+        jnp.take(t_def, gk_k, axis=1)[None, :, :] & cdef_l[:, None, :]
+    )
+    delta = ((~pair_ok).astype(jnp.int32) - jnp.take(base_fail, gk_k, axis=2)) * gk_w[None, None, :]
+    adj = jax.ops.segment_sum(
+        jnp.moveaxis(delta, -1, 0), gk_g, num_segments=G
+    )  # [G, P, T]
+    type_compat = (base_total[:, None, :] + jnp.transpose(adj, (1, 0, 2))) == 0
+
+    # ---- pod-vs-template compatibility over live pairs only -------------
+    # neutral keys never fail Compatible (the both-defined gate and the
+    # custom-label allowance are vacuous), so compat is a pure segment sum
+    pneg_l = jnp.take(p_neg, gk_k, axis=1)  # [P, L]
+    gneg_l = g_neg[gk_g, gk_k]  # [L]
+    pdef_l = jnp.take(p_def, gk_k, axis=1)
+    gdef_l = g_def[gk_g, gk_k]
+    ov2 = (
+        jnp.einsum(
+            "plv,lv->pl",
+            pm_l.astype(jnp.float32), gm_l.astype(jnp.float32),
+        )
+        > 0
+    )  # [P, L]
+    term_c = ov2 | (pneg_l & gneg_l[None, :]) | ~(pdef_l & gdef_l[None, :])
+    custom_c = (
+        ~gdef_l[None, :] | well_known[gk_k][None, :] | pdef_l | gneg_l[None, :]
+    )
+    fail_c = (~(term_c & custom_c)).astype(jnp.int32) * gk_w[None, :]
+    cfail = jax.ops.segment_sum(fail_c.T, gk_g, num_segments=G)  # [G, P]
+    compat_pg = p_tol & (cfail.T == 0)
+
+    # ---- offerings: template-only base + non-neutral-group rows ---------
+    off_base = offering_ok(
+        p_mask[:, None, zone_kid, :], p_mask[:, None, ct_kid, :],
+        o_avail[None, :, :], o_zone[None, :, :], o_ct[None, :, :],
+    )  # [P, T]
+    gz_off = g_mask[goff_idx, zone_kid]  # [LZ, V1]
+    gc_off = g_mask[goff_idx, ct_kid]
+    off_corr = offering_ok(
+        (p_mask[:, None, zone_kid, :] & gz_off[None, :, :])[:, :, None, :],
+        (p_mask[:, None, ct_kid, :] & gc_off[None, :, :])[:, :, None, :],
+        o_avail[None, None, :, :], o_zone[None, None, :, :],
+        o_ct[None, None, :, :],
+    )  # [P, LZ, T]
+    off = (
+        jnp.broadcast_to(off_base[:, None, :], (P, G, T))
+        .at[:, goff_idx, :]
+        .set(off_corr)
+    )
+
+    n_fit = fits_count(
+        t_alloc[None, None, :, :], p_daemon[:, None, None, :],
+        g_req[None, :, None, :],
+    )  # [P, G, T]
+
+    type_ok = (
+        type_compat & off & (n_fit >= 1) & p_titype_ok[:, None, :]
+        & compat_pg[:, :, None]
+    )
+    return compat_pg, type_ok, n_fit
+
+
+@jax.jit
+def existing_node_feasibility_sparse(
+    g_def, g_neg, g_mask, g_req,
+    n_def, n_mask, n_avail, n_base, n_tol,
+    gk_g, gk_k, gk_w,
+):
+    """existing_node_feasibility over the compacted live-pair index —
+    bit-exact with the dense twin. Strict compatibility (no well-known
+    allowance) makes every neutral key vacuous node-side too, so node
+    compatibility is a pure segment sum over live pairs."""
+    G = g_mask.shape[0]
+    gm_l = g_mask[gk_g, gk_k]  # [L, V1]
+    nm_l = jnp.take(n_mask, gk_k, axis=1)  # [N, L, V1]
+    ov = (
+        jnp.einsum(
+            "nlv,lv->nl",
+            nm_l.astype(jnp.float32), gm_l.astype(jnp.float32),
+        )
+        > 0
+    )  # [N, L]
+    ndef_l = jnp.take(n_def, gk_k, axis=1)  # [N, L]
+    gdef_l = g_def[gk_g, gk_k]
+    gneg_l = g_neg[gk_g, gk_k]
+    term = ov | ~(ndef_l & gdef_l[None, :])
+    custom = ~gdef_l[None, :] | ndef_l | gneg_l[None, :]
+    fail = (~(term & custom)).astype(jnp.int32) * gk_w[None, :]
+    nfail = jax.ops.segment_sum(fail.T, gk_g, num_segments=G)  # [G, N]
+    compat = nfail.T == 0  # [N, G]
+    cap = fits_count(
+        n_avail[:, None, :], n_base[:, None, :], g_req[None, :, :]
+    )  # [N, G]
+    return jnp.where(compat & n_tol, cap, 0)
+
+
 @jax.jit
 def existing_node_feasibility(
     g_def, g_neg, g_mask, g_req,

@@ -682,9 +682,6 @@ def pack(
             scap = jnp.minimum(
                 jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0), count
             )
-            q_spread = waterfill(
-                jnp.where(reg, D0, _BIGI), scap, count, iters=wf_iters
-            )  # [V1]
 
             # AFFINITY bootstrap: all pods pin to ONE viable domain. The
             # oracle's bootstrap pod walks the normal FFD order — existing
@@ -755,18 +752,22 @@ def pack(
             scap_gate = jnp.where(
                 allowed_gate, jnp.minimum(realcap, count), 0
             )
-            q_gate = waterfill(
-                jnp.where(reg, D0, _BIGI), scap_gate, count, iters=wf_iters
+            # ONE waterfill serves both quota modes: spread and gate only
+            # differ in the per-domain cap vector, so select the caps and
+            # bisect once (each bisection trip is a serial reduction on
+            # the scan-step critical path)
+            is_gate = mode >= DMODE_GATE_SPREAD
+            q_wf = waterfill(
+                jnp.where(reg, D0, _BIGI),
+                jnp.where(is_gate, scap_gate, scap),
+                count,
+                iters=wf_iters,
             )
 
             q_dom = jnp.where(
-                mode == DMODE_SPREAD,
-                q_spread,
-                jnp.where(
-                    mode == DMODE_AFFINITY,
-                    q_aff,
-                    jnp.where(mode >= DMODE_GATE_SPREAD, q_gate, 0),
-                ),
+                mode == DMODE_AFFINITY,
+                q_aff,
+                jnp.where((mode == DMODE_SPREAD) | is_gate, q_wf, 0),
             )
             qd = (
                 jnp.zeros((NSLOT,), jnp.int32)
@@ -1620,9 +1621,6 @@ def pack_classed(
                 scap = jnp.minimum(
                     jnp.where(reg, jnp.clip(lstar - D0, 0, realcap), 0), count
                 )
-                q_spread = waterfill1(
-                    jnp.where(reg, D0, _BIGI), scap, count, iters=wf_iters
-                )
 
                 if N:
                     n_elig = (e_cap >= 1) & (nd_slot < V1)
@@ -1680,19 +1678,19 @@ def pack_classed(
                 scap_gate = jnp.where(
                     allowed_gate, jnp.minimum(realcap, count), 0
                 )
-                q_gate = waterfill1(
-                    jnp.where(reg, D0, _BIGI), scap_gate, count,
+                # one waterfill for both quota modes (see pack())
+                is_gate = mode >= DMODE_GATE_SPREAD
+                q_wf = waterfill1(
+                    jnp.where(reg, D0, _BIGI),
+                    jnp.where(is_gate, scap_gate, scap),
+                    count,
                     iters=wf_iters,
                 )
 
                 q_dom = jnp.where(
-                    mode == DMODE_SPREAD,
-                    q_spread,
-                    jnp.where(
-                        mode == DMODE_AFFINITY,
-                        q_aff,
-                        jnp.where(mode >= DMODE_GATE_SPREAD, q_gate, 0),
-                    ),
+                    mode == DMODE_AFFINITY,
+                    q_aff,
+                    jnp.where((mode == DMODE_SPREAD) | is_gate, q_wf, 0),
                 )
                 qd = (
                     jnp.zeros((NSLOT,), jnp.int32)
@@ -1770,18 +1768,46 @@ def pack_classed(
                     )[:, 0]
                     claim_cap = _clamp(jnp.where(c_slot < V1, cap_dom, 0))
 
-                    def wf_slot(slot_idx, slot_budget):
-                        m = c_slot == slot_idx
-                        return waterfill(
-                            jnp.where(m, state.c_npods, _BIGI),
-                            jnp.where(m, claim_cap, 0),
-                            slot_budget,
-                            iters=wf_iters,
+                    def _single(_):
+                        # count <= 1: at most ONE slot carries quota, so
+                        # the vmapped per-slot bisection collapses to a
+                        # single least-loaded pick — waterfill1's n <= 1
+                        # equivalence (bisection's deficit hand-out ties
+                        # by slot index, exactly argmin's rule). Dominant
+                        # shape for fragmented spread mixes (diverse-ref:
+                        # ~54% singleton groups).
+                        s_star = jnp.argmax(qrem)
+                        elig = (c_slot == s_star) & (claim_cap >= 1)
+                        tstar = jnp.argmin(
+                            jnp.where(elig, state.c_npods, _BIGI)
+                        )
+                        take = jnp.where(
+                            (qrem[s_star] >= 1) & jnp.any(elig), 1, 0
+                        )
+                        fills = (
+                            jax.nn.one_hot(tstar, nmax, dtype=jnp.int32)
+                            * take
+                        )
+                        return c_slot, fills, qrem.at[s_star].add(-take)
+
+                    def _full(_):
+                        def wf_slot(slot_idx, slot_budget):
+                            m = c_slot == slot_idx
+                            return waterfill(
+                                jnp.where(m, state.c_npods, _BIGI),
+                                jnp.where(m, claim_cap, 0),
+                                slot_budget,
+                                iters=wf_iters,
+                            )
+
+                        fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)
+                        claim_fill = jnp.sum(fills_sd, axis=0)
+                        return (
+                            c_slot, claim_fill,
+                            qrem - jnp.sum(fills_sd, axis=1),
                         )
 
-                    fills_sd = jax.vmap(wf_slot)(jnp.arange(NSLOT), qrem)
-                    claim_fill = jnp.sum(fills_sd, axis=0)
-                    return c_slot, claim_fill, qrem - jnp.sum(fills_sd, axis=1)
+                    return jax.lax.cond(count <= 1, _single, _full, None)
 
                 c_slot, claim_fill, qrem = jax.lax.cond(
                     dyn, _tier2_domains, _tier2_any, None

@@ -1,0 +1,378 @@
+"""Convex-relaxation bulk pre-solver for separable easy mass.
+
+CvxCluster-style split (PAPERS.md): granular allocation problems place
+their *easy bulk* orders of magnitude faster under a convex relaxation,
+leaving only a residual for the exact method. Here the easy bulk is the
+set of **separable plain runs**: FFD-contiguous signature runs of groups
+that carry no topology state at all (no domain mode, no hostname cap or
+affinity, no shared-constraint slots, no contributor rows) in a batch
+with no existing nodes, no reservation ledger, no minValues floors and no
+pool limits, AND whose claims provably cannot exchange pods with any
+other run's claims (the pairwise compatibility wall in ``plan_bulk``).
+
+For such a run the exact kernel's sequential scan has a closed form. Its
+LP relaxation — pour the run's fractional pod mass into claim-sized bins
+of capacity ``n_per`` — has the concentration fill as its extreme point,
+and the exact kernel maintains exactly that extreme point across members:
+
+- tier 3 opens bulks full-then-partial (``bulk_takes``' ANY-bulk
+  concentration fill), so all claims but the run's last are saturated at
+  ``n_per`` (their surviving types fit exactly ``n_per``, so add-capacity
+  is zero);
+- tier 2's least-loaded waterfill therefore only ever has ONE eligible
+  claim — the run's partial — and tops it up before a new bulk opens.
+
+So member j's fills are the overlap of its cumulative pod interval
+[S_{j-1}, S_j) with the claim grid — pure interval arithmetic, computed
+for every group and claim at once in ``relax_fill`` (one batched jit
+dispatch, no scan). The *conservative rounding* is exact: fractional
+mass only ever splits on claim boundaries, which is precisely where the
+exact kernel splits it, so relaxation-routed decisions are identical to
+the exact kernel's by construction (tests/test_relax.py pins this
+against forced-exact solves). Anything the wall cannot prove separable
+stays residual and rides the exact pack kernel unchanged; a combined
+solve that fails the post-solve invariant guard (faults/guard.py) is
+discarded and the driver re-solves fully exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..solver import encode as enc
+
+# block edge for the pairwise join wall's [P, Bx, By, K] temporaries:
+# 256x256 blocks keep the per-block einsum under ~32 MB at P=4, K=32
+_JOIN_BLOCK = 256
+
+
+@dataclass
+class BulkPlan:
+    """Host-side routing plan for one batch (all arrays numpy)."""
+
+    easy_gids: np.ndarray  # [Ge] group ids (into the padded snapshot)
+    ge_run: np.ndarray  # [Ge] run index per easy group
+    run_head: np.ndarray  # [CRr] head group id per easy run
+    ge_count: np.ndarray  # [Ge] pod counts
+    ge_a: np.ndarray  # [Ge] cumulative pod offset within the run
+    run_total: np.ndarray  # [CRr] total pods per run
+    easy_pods: int = 0
+
+
+def plan_bulk(
+    snap_run,
+    *,
+    res_cap0: np.ndarray,
+    n_exist: int,
+) -> Optional[BulkPlan]:
+    """The separability wall. Returns a BulkPlan naming the easy runs, or
+    None when nothing can be proven separable.
+
+    Routing conditions (each is load-bearing for the closed form —
+    PARITY.md "Relaxation pre-solver"):
+
+    - batch level: no existing nodes, empty reservation ledger, no
+      minValues floors, no pool limits (limit debits couple bulks across
+      groups through the shared ledger);
+    - group level (every member of a routed run): positive count, no
+      domain mode, unbounded per-entity cap, no hostname affinity, no
+      shared-constraint slot, no contributor rows (contributions feed
+      carries that *other* groups' quotas read mid-scan);
+    - pair level: no group of any other run may ever join a routed run's
+      claims, and no routed group may join anyone else's — checked
+      against the most permissive claim state either side could reach
+      (single-group merge for the intersect term, maximal defined set
+      for the custom-label allowance, so multi-merged claims are covered
+      a fortiori).
+    """
+    if n_exist:
+        return None
+    if res_cap0.shape[0]:
+        return None
+    if snap_run.p_mvmin.shape[1]:
+        return None
+    if np.asarray(snap_run.p_has_limit).any():
+        return None
+    g_count = np.asarray(snap_run.g_count)
+    G = len(g_count)
+    if not G:
+        return None
+    easy_g = (
+        (g_count > 0)
+        & (np.asarray(snap_run.g_dmode) == 0)
+        & (np.asarray(snap_run.g_hcap) >= enc.HCAP_NONE)
+        & (~np.asarray(snap_run.g_haff))
+        & (np.asarray(snap_run.g_hstg) < 0)
+        & (np.asarray(snap_run.g_dtg) < 0)
+        & (~np.asarray(snap_run.g_hcontrib).any(axis=1))
+        & (~np.asarray(snap_run.g_dcontrib).any(axis=1))
+    )
+    if not easy_g.any():
+        return None
+
+    # signature runs (the class_partition adjacency, minus n_tol: N == 0)
+    same = np.zeros((G,), bool)
+    if G > 1:
+        same[1:] = (
+            (snap_run.g_req[1:] == snap_run.g_req[:-1]).all(axis=1)
+            & (snap_run.g_def[1:] == snap_run.g_def[:-1]).all(axis=1)
+            & (snap_run.g_neg[1:] == snap_run.g_neg[:-1]).all(axis=1)
+            & (snap_run.g_mask[1:] == snap_run.g_mask[:-1]).all(axis=(1, 2))
+            & (snap_run.p_tol[:, 1:] == snap_run.p_tol[:, :-1]).all(axis=0)
+        )
+    run_of = np.cumsum(~same) - 1  # [G]
+    n_runs = int(run_of[-1]) + 1
+    run_start = np.flatnonzero(~same)
+    # a run is easy only when EVERY member is (mixed runs interleave easy
+    # members with topology members inside one claim-sharing class)
+    run_easy = np.ones((n_runs,), bool)
+    np.minimum.at(run_easy, run_of, easy_g)
+    run_pods = np.bincount(run_of, weights=g_count)[:n_runs] > 0
+    run_easy &= run_pods
+
+    if not run_easy.any():
+        return None
+
+    # ---- pairwise join wall --------------------------------------------
+    # join_ok[x, y]: could a group of run y EVER join a claim opened for
+    # run x (under any template)? Computed against the most permissive
+    # claim state (see docstring). Bail any easy run out of the plan when
+    # it can exchange pods with any other run, either direction. Only
+    # pairs with an easy side are computed, so fragmented batches pay
+    # O(easy_runs x runs), never O(runs^2).
+    heads = run_start  # [n_runs] head group id per run
+    hd = snap_run.g_def[heads]  # [Rn, K]
+    hn = snap_run.g_neg[heads]
+    hm = snap_run.g_mask[heads]  # [Rn, K, V1]
+    p_def = snap_run.p_def  # [P, K]
+    p_neg = snap_run.p_neg
+    p_mask = snap_run.p_mask
+    wk = snap_run.well_known  # [K]
+    # custom-label allowance against the maximal defined set any claim
+    # could accumulate (multi-merged claims only grow c_def)
+    c_def_max = p_def | hd.any(axis=0)[None, :]  # [P, K]
+    custom_ok = (
+        ~hd[None, :, :] | wk[None, None, :] | c_def_max[:, None, :]
+        | hn[None, :, :]
+    ).all(axis=2)  # [P, Ry]
+
+    def _join(xs: np.ndarray, ys: np.ndarray) -> np.ndarray:
+        """[Rx', Ry'] — some template's run-x claim admits run-y pods.
+
+        Blocked over both run axes: the [P, Rx', Ry', K] overlap
+        temporary would otherwise spike to hundreds of MB on exactly the
+        many-small-deployment shapes this pre-solver targets."""
+        out = np.zeros((len(xs), len(ys)), bool)
+        for i in range(0, len(xs), _JOIN_BLOCK):
+            xb = xs[i:i + _JOIN_BLOCK]
+            # claim of run x under template p: c_def = p_def | hd[x],
+            # c_neg = p_neg & hn[x], c_mask = p_mask & hm[x]
+            c_def = p_def[:, None, :] | hd[None, xb, :]  # [P, Bx, K]
+            c_neg = p_neg[:, None, :] & hn[None, xb, :]
+            c_mask = p_mask[:, None, :, :] & hm[None, xb, :, :]
+            c_mask_i = c_mask.astype(np.int32)
+            for j in range(0, len(ys), _JOIN_BLOCK):
+                yb = ys[j:j + _JOIN_BLOCK]
+                # int32 accumulator: an int8 einsum wraps past 127
+                # overlapping value slots (wide complement masks on a
+                # V1 >= 128 vocab) and a wrapped-negative sum would
+                # silently report "no overlap", letting a joinable run
+                # into the plan
+                overlap = np.einsum(
+                    "prkv,ykv->pryk",
+                    c_mask_i, hm[yb].astype(np.int32),
+                ) > 0  # [P, Bx, By, K]
+                key_ok = (
+                    overlap
+                    | (c_neg[:, :, None, :] & hn[None, None, yb, :])
+                    | ~(c_def[:, :, None, :] & hd[None, None, yb, :])
+                )
+                join_ok = key_ok.all(axis=3) & custom_ok[:, None, yb]
+                out[i:i + _JOIN_BLOCK, j:j + _JOIN_BLOCK] = join_ok.any(axis=0)
+        return out
+
+    easy_ids = np.flatnonzero(run_easy)
+    # only runs with pods can exchange them: padding runs (all counts 0)
+    # and emptied runs never open claims and never place, so they are no
+    # coupling partner (the kernel cond-skips their every member)
+    other_ids = np.flatnonzero(run_pods)
+    fwd = _join(easy_ids, other_ids)  # easy claims admitting anyone
+    bwd = _join(other_ids, easy_ids)  # anyone's claims admitting easy pods
+    self_x = np.searchsorted(other_ids, easy_ids)
+    fwd[np.arange(len(easy_ids)), self_x] = False  # within-run = closed form
+    bwd[self_x, np.arange(len(easy_ids))] = False
+    coupled = fwd.any(axis=1) | bwd.any(axis=0)
+    run_easy[easy_ids[coupled]] = False
+    if not run_easy.any():
+        return None
+
+    easy_runs = np.flatnonzero(run_easy)
+    run_index = np.full((n_runs,), -1, np.int64)
+    run_index[easy_runs] = np.arange(len(easy_runs))
+    gids = np.flatnonzero(run_easy[run_of] & (g_count > 0))
+    ge_run = run_index[run_of[gids]].astype(np.int32)
+    ge_count = g_count[gids].astype(np.int64)
+    # cumulative pod offset within each run (groups are run-contiguous in
+    # FFD order, so a plain segmented cumsum over the gathered axis works)
+    cum = np.cumsum(ge_count) - ge_count
+    run_base = np.zeros((len(easy_runs),), np.int64)
+    first = np.unique(ge_run, return_index=True)[1]
+    run_base[ge_run[first]] = cum[first]
+    ge_a = cum - run_base[ge_run]
+    run_total = np.bincount(
+        ge_run, weights=ge_count, minlength=len(easy_runs)
+    ).astype(np.int64)
+    return BulkPlan(
+        easy_gids=gids.astype(np.int32),
+        ge_run=ge_run,
+        run_head=heads[easy_runs].astype(np.int32),
+        ge_count=ge_count,
+        ge_a=ge_a,
+        run_total=run_total,
+        easy_pods=int(ge_count.sum()),
+    )
+
+
+def _jit_relax_fill():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def relax_fill(
+        ge_count,  # [GE] int32 (0 on padding)
+        ge_a,  # [GE] int32 within-run pod offset
+        ge_off,  # [GE] int32 first claim slot of the group's run
+        ge_nper,  # [GE] int32 pods per claim of the run (0 = infeasible)
+        ge_kc,  # [GE] int32 claim count of the run
+        cl_run_pool,  # [NR] int32 template id per claim slot
+        cl_fill,  # [NR] int32 total pods per claim slot
+        cl_avail,  # [NR, T] bool p_star availability row per claim slot
+        cl_nfit,  # [NR, T] int32 n_fit row per claim slot
+    ):
+        """One batched dispatch: the interval-arithmetic rounding of the
+        relaxed bulk. claim_fills[i, j] is the overlap of group i's
+        cumulative pod interval with claim j's capacity window; claim
+        type masks keep exactly the types whose fit survives the claim's
+        total fill (the composition of the exact kernel's per-fill
+        survival updates)."""
+        NR = cl_fill.shape[0]
+        slots = jnp.arange(NR, dtype=jnp.int32)
+        rel = slots[None, :] - ge_off[:, None]  # [GE, NR]
+        nper = jnp.maximum(ge_nper, 1)[:, None]
+        lo = ge_a[:, None]
+        hi = (ge_a + ge_count)[:, None]
+        win_lo = rel * nper
+        win_hi = win_lo + nper
+        fill = jnp.clip(
+            jnp.minimum(hi, win_hi) - jnp.maximum(lo, win_lo),
+            0,
+            nper,
+        )
+        in_run = (rel >= 0) & (rel < ge_kc[:, None]) & (ge_nper[:, None] > 0)
+        claim_fills = jnp.where(in_run, fill, 0).astype(jnp.int32)
+        c_tmask = cl_avail & (cl_nfit >= cl_fill[:, None])
+        unplaced = jnp.where(ge_nper > 0, 0, ge_count).astype(jnp.int32)
+        return claim_fills, c_tmask, cl_run_pool, unplaced
+
+    return relax_fill
+
+
+_relax_fill = None
+
+
+def solve_bulk(plan: BulkPlan, snap_run):
+    """Solve the planned easy bulk. Returns (n_r, c_pool, c_tmask_bool,
+    claim_fills_ge, unplaced_ge) — claim slots on a fresh axis the driver
+    appends after the exact kernel's, rows aligned with plan.easy_gids.
+
+    Head feasibility runs the dense tables over the gathered run heads
+    (a handful of rows); the heavy fill/type-mask arrays come from ONE
+    ``relax_fill`` dispatch.
+    """
+    global _relax_fill
+    import jax.numpy as jnp
+
+    from .feasibility import fresh_claim_feasibility
+
+    heads = plan.run_head
+    CRr = len(heads)
+    # pow2-bucket the gathered head axis so the jitted feasibility kernel
+    # compiles per bucket, not per distinct easy-run count (the layer-2
+    # compile-cache discipline); pad rows repeat group 0 and are sliced
+    # off before any of their results are read
+    CRp = enc._next_pow2(CRr, floor=1)
+    hpad = np.zeros((CRp,), heads.dtype)
+    hpad[:CRr] = heads
+    _, type_ok, n_fit = fresh_claim_feasibility(
+        snap_run.g_def[hpad], snap_run.g_neg[hpad],
+        snap_run.g_mask[hpad], snap_run.g_req[hpad],
+        snap_run.p_def, snap_run.p_neg, snap_run.p_mask,
+        snap_run.p_daemon, snap_run.p_tol[:, hpad], snap_run.p_titype_ok,
+        snap_run.t_def, snap_run.t_mask, snap_run.t_alloc,
+        snap_run.o_avail, snap_run.o_zone, snap_run.o_ct,
+        snap_run.well_known,
+        zone_kid=snap_run.zone_kid, ct_kid=snap_run.ct_kid,
+    )
+    type_ok = np.asarray(type_ok)[:, :CRr]
+    n_fit = np.asarray(n_fit)[:, :CRr]
+    feas_p = type_ok.any(axis=2)  # [P, CRr]
+    any_feas = feas_p.any(axis=0)
+    p_star = np.argmax(feas_p, axis=0)  # first feasible template (weight order)
+    avail = type_ok[p_star, np.arange(CRr)]  # [CRr, T]
+    nf = n_fit[p_star, np.arange(CRr)]  # [CRr, T]
+    n_per = np.where(avail, nf, 0).max(axis=1)  # [CRr]
+    n_per = np.where(any_feas, n_per, 0).astype(np.int64)
+    kc = np.zeros((CRr,), np.int64)
+    live = n_per > 0
+    kc[live] = -(-plan.run_total[live] // n_per[live])
+    off = np.cumsum(kc) - kc  # claim slot offset per run
+    n_r = int(kc.sum())
+
+    GE = enc._next_pow2(len(plan.easy_gids), floor=1)
+    NR = enc._next_pow2(max(n_r, 1), floor=1)
+    T = avail.shape[1]
+
+    def padg(a, fill=0):
+        out = np.full((GE,), fill, a.dtype)
+        out[: len(a)] = a
+        return out
+
+    # per-claim-slot run attributes
+    cl_run = np.zeros((NR,), np.int64)
+    if n_r:
+        cl_run[:n_r] = np.repeat(np.arange(CRr), kc)
+    cl_rel = np.arange(NR, dtype=np.int64) - off[cl_run]
+    last = cl_rel == kc[cl_run] - 1
+    fill_full = n_per[cl_run]
+    fill_last = plan.run_total[cl_run] - (kc[cl_run] - 1) * n_per[cl_run]
+    cl_fill = np.where(last, fill_last, fill_full)
+    cl_fill[n_r:] = 0
+    cl_avail = avail[cl_run]
+    cl_avail[n_r:] = False
+    cl_nfit = nf[cl_run]
+    cl_pool = p_star[cl_run].astype(np.int32)
+
+    if _relax_fill is None:
+        _relax_fill = _jit_relax_fill()
+    claim_fills, c_tmask, c_pool, unplaced = _relax_fill(
+        padg(plan.ge_count.astype(np.int32)),
+        padg(plan.ge_a.astype(np.int32)),
+        padg(off[plan.ge_run].astype(np.int32)),
+        padg(n_per[plan.ge_run].astype(np.int32)),
+        padg(kc[plan.ge_run].astype(np.int32)),
+        jnp.asarray(cl_pool),
+        jnp.asarray(cl_fill.astype(np.int32)),
+        jnp.asarray(cl_avail),
+        jnp.asarray(cl_nfit.astype(np.int32)),
+    )
+    ge = len(plan.easy_gids)
+    return (
+        n_r,
+        np.asarray(c_pool)[:n_r],
+        np.asarray(c_tmask)[:n_r],
+        np.asarray(claim_fills)[:ge, :n_r],
+        np.asarray(unplaced)[:ge],
+    )

@@ -16,7 +16,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .feasibility import existing_node_feasibility, fresh_claim_feasibility
+from .feasibility import (
+    existing_node_feasibility,
+    existing_node_feasibility_sparse,
+    fresh_claim_feasibility,
+    fresh_claim_feasibility_sparse,
+)
 from .packing import pack, pack_classed
 from ..solver.encode import SOLVE_ARG_NAMES
 
@@ -28,13 +33,22 @@ def _feasibility_tables(
     o_avail, o_zone, o_ct,
     n_def, n_mask, n_avail, n_base, n_tol,
     well_known,
+    gk_g, gk_k, gk_w, goff_idx,
     zone_kid: int,
     ct_kid: int,
     tile_feasibility: bool,
+    sparse_groups: bool,
 ):
     """The precomputed [P,G(,T)] / [N,G] tables both kernels consume — or
     zero-G placeholders in the tiled HBM-scaling mode (SURVEY §7.4.6),
-    where the scan computes its own rows per step/class."""
+    where the scan computes its own rows per step/class.
+
+    ``sparse_groups`` (static) routes to the segment-contraction twins:
+    the encoder's compacted nonzero index (gk_*/goff_idx) replaces the
+    dense [P, G, T, K, V1] requirement join so cost scales with live
+    (group, key) pairs — the group-heavy fragmented shapes where the
+    dense join dominated. Tables are bit-exact either way
+    (tests/test_sparse_feasibility.py)."""
     if tile_feasibility:
         P, T = p_titype_ok.shape
         N = n_avail.shape[0]
@@ -43,21 +57,40 @@ def _feasibility_tables(
         n_fit = jnp.zeros((P, 0, T), jnp.int32)
         cap_ng = jnp.zeros((N, 0), jnp.int32)
         return compat_pg, type_ok, n_fit, cap_ng
-    compat_pg, type_ok, n_fit = fresh_claim_feasibility(
-        g_def, g_neg, g_mask, g_req,
-        p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
-        t_def, t_mask, t_alloc,
-        o_avail, o_zone, o_ct,
-        well_known,
-        zone_kid=zone_kid,
-        ct_kid=ct_kid,
-    )
-    if n_avail.shape[0]:
-        cap_ng = existing_node_feasibility(
+    if sparse_groups:
+        compat_pg, type_ok, n_fit = fresh_claim_feasibility_sparse(
             g_def, g_neg, g_mask, g_req,
-            n_def, n_mask, n_avail, n_base, n_tol,
+            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+            t_def, t_mask, t_alloc,
+            o_avail, o_zone, o_ct,
             well_known,
+            gk_g, gk_k, gk_w, goff_idx,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
         )
+    else:
+        compat_pg, type_ok, n_fit = fresh_claim_feasibility(
+            g_def, g_neg, g_mask, g_req,
+            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
+            t_def, t_mask, t_alloc,
+            o_avail, o_zone, o_ct,
+            well_known,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+        )
+    if n_avail.shape[0]:
+        if sparse_groups:
+            cap_ng = existing_node_feasibility_sparse(
+                g_def, g_neg, g_mask, g_req,
+                n_def, n_mask, n_avail, n_base, n_tol,
+                gk_g, gk_k, gk_w,
+            )
+        else:
+            cap_ng = existing_node_feasibility(
+                g_def, g_neg, g_mask, g_req,
+                n_def, n_mask, n_avail, n_base, n_tol,
+                well_known,
+            )
     else:
         cap_ng = jnp.zeros((0, g_count.shape[0]), jnp.int32)
     return compat_pg, type_ok, n_fit, cap_ng
@@ -92,6 +125,7 @@ def _solve_with(
     nh_cnt0, dd0, dtg_key,
     well_known,
     p_mvmin, t_mvoh,
+    gk_g, gk_k, gk_w, goff_idx,
     *extra_args,
     zone_kid: int,
     ct_kid: int,
@@ -99,6 +133,7 @@ def _solve_with(
     has_contrib: bool,
     tile_feasibility: bool,
     wf_iters: int,
+    sparse_groups: bool = False,
     **packer_statics,
 ):
     # named scopes ride into the lowered HLO metadata so XProf/TensorBoard
@@ -112,9 +147,11 @@ def _solve_with(
             o_avail, o_zone, o_ct,
             n_def, n_mask, n_avail, n_base, n_tol,
             well_known,
+            gk_g, gk_k, gk_w, goff_idx,
             zone_kid=zone_kid,
             ct_kid=ct_kid,
             tile_feasibility=tile_feasibility,
+            sparse_groups=sparse_groups,
         )
     with jax.named_scope("ktpu.pack"):
         state, exist_fills, claim_fills, unplaced = packer(
@@ -158,12 +195,14 @@ def solve_core(
     has_contrib: bool = False,
     tile_feasibility: bool = False,
     wf_iters: int = 32,
+    sparse_groups: bool = False,
 ):
     return _solve_with(
         pack, *args,
         zone_kid=zone_kid, ct_kid=ct_kid,
         has_domains=has_domains, has_contrib=has_contrib,
         tile_feasibility=tile_feasibility, wf_iters=wf_iters,
+        sparse_groups=sparse_groups,
         nmax=nmax,
     )
 
@@ -178,6 +217,7 @@ def solve_core_classed(
     has_contrib: bool = False,
     tile_feasibility: bool = False,
     wf_iters: int = 32,
+    sparse_groups: bool = False,
 ):
     """solve_core over the class-batched scan (ops/packing.py:pack_classed)
     — one scan step per feasibility class, members placed by an inner loop.
@@ -189,6 +229,7 @@ def solve_core_classed(
         zone_kid=zone_kid, ct_kid=ct_kid,
         has_domains=has_domains, has_contrib=has_contrib,
         tile_feasibility=tile_feasibility, wf_iters=wf_iters,
+        sparse_groups=sparse_groups,
         nmax=nmax, lmax=lmax,
     )
 
@@ -197,7 +238,7 @@ solve_all = jax.jit(
     solve_core,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility", "wf_iters",
+        "tile_feasibility", "wf_iters", "sparse_groups",
     ),
 )
 
@@ -244,7 +285,7 @@ solve_all_packed = jax.jit(
     solve_core_packed,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility", "wf_iters", "fills_dtype",
+        "tile_feasibility", "wf_iters", "sparse_groups", "fills_dtype",
     ),
 )
 
@@ -252,7 +293,7 @@ solve_all_classed_packed = jax.jit(
     solve_core_classed_packed,
     static_argnames=(
         "nmax", "lmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility", "wf_iters", "fills_dtype",
+        "tile_feasibility", "wf_iters", "sparse_groups", "fills_dtype",
     ),
 )
 
@@ -316,7 +357,7 @@ solve_all_scenarios_packed = jax.jit(
     solve_scenarios_core_packed,
     static_argnames=(
         "nmax", "zone_kid", "ct_kid", "has_domains", "has_contrib",
-        "tile_feasibility", "wf_iters", "fills_dtype", "batch_topo",
+        "tile_feasibility", "wf_iters", "sparse_groups", "fills_dtype", "batch_topo",
     ),
 )
 

@@ -112,6 +112,10 @@ def snapshot_shardings(mesh) -> Tuple:
         rep,  # well_known [K]
         rep,  # p_mvmin [P, MV]
         S("model"),  # t_mvoh [T, MV, W]
+        rep,  # gk_g [L]
+        rep,  # gk_k [L]
+        rep,  # gk_w [L]
+        rep,  # goff_idx [LZ]
     )
 
 
@@ -125,7 +129,7 @@ _SHARDED_FNS = {}
 def sharded_solve_fn(
     mesh, nmax: int, zone_kid: int, ct_kid: int, has_domains: bool = True,
     has_contrib: bool = False, tile_feasibility: bool = False,
-    wf_iters: int = 32,
+    wf_iters: int = 32, sparse_groups: bool = False,
 ):
     """The full solve step jitted over the mesh. Group/type-sharded inputs,
     replicated outputs; XLA/GSPMD inserts the ICI collectives."""
@@ -135,7 +139,7 @@ def sharded_solve_fn(
 
     key = (
         mesh, nmax, zone_kid, ct_kid, has_domains, has_contrib,
-        tile_feasibility, wf_iters,
+        tile_feasibility, wf_iters, sparse_groups,
     )
     fn = _SHARDED_FNS.get(key)
     if fn is None:
@@ -149,6 +153,7 @@ def sharded_solve_fn(
                 has_contrib=has_contrib,
                 tile_feasibility=tile_feasibility,
                 wf_iters=wf_iters,
+                sparse_groups=sparse_groups,
             ),
             in_shardings=snapshot_shardings(mesh),
             out_shardings=jax.sharding.NamedSharding(
@@ -180,6 +185,7 @@ def pad_args_for_mesh(args, mesh):
         nh_cnt0, dd0, dtg_key,
         well_known,
         p_mvmin, t_mvoh,
+        gk_g, gk_k, gk_w, goff_idx,
     ) = args
 
     def pad_axis(arr, axis, mult, fill=0):
@@ -234,4 +240,7 @@ def pad_args_for_mesh(args, mesh):
         nh_cnt0, dd0, dtg_key,
         well_known,
         p_mvmin, t_mvoh,
+        # the segment index names REAL group rows; G-axis padding appends
+        # neutral rows with no live pairs, so the index is already valid
+        gk_g, gk_k, gk_w, goff_idx,
     )

@@ -16,7 +16,7 @@ from ..api import resources as res
 from ..api import taints as taints_mod
 from ..api.objects import CSINode, Node, Pod
 from ..api.requirements import Requirements, pod_requirements
-from ..kube import Client
+from ..kube import Client, NotFoundError
 from ..scheduling.volumetopology import VolumeTopology
 from ..scheduling.volumeusage import VolumeUsage
 from ..utils import pod as pod_utils
@@ -69,6 +69,13 @@ class Binder:
             )
             if node is not None:
                 pod.spec.node_name = node.name
+                try:
+                    self.client.update(pod)
+                except NotFoundError:
+                    # evicted concurrently; not bound — and none of the
+                    # pass-local state below may see the phantom pod
+                    pod.spec.node_name = None
+                    continue
                 used[node.name] = res.merge(used[node.name], pod.spec.requests)
                 if pod.spec.volumes:
                     resolved, _ = self.volume_topology.resolver.resolve(pod)
@@ -76,7 +83,6 @@ class Binder:
                 placements.append((pod, node))
                 if pod.spec.pod_anti_affinity:
                     anti_placements.append((pod, node))
-                self.client.update(pod)
                 bound.append(pod)
         return bound
 

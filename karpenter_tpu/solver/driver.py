@@ -18,6 +18,7 @@ from __future__ import annotations
 
 
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -277,6 +278,11 @@ class SolverConfig:
     health: Optional[object] = None
     # per-call gRPC deadline for RemoteSolver dispatches (seconds)
     solve_deadline: float = 30.0
+    # convex-relaxation bulk pre-solver (ops/relax.py): closed-form bulk
+    # placement of separable plain runs in one batched dispatch, residual
+    # on the exact kernel. None = auto (on for the plain single-device
+    # jit path; KTPU_RELAX=0 disables); True/False force.
+    relax: Optional[bool] = None
 
 
 def _clone_existing_node(en):
@@ -377,6 +383,14 @@ class TpuSolver:
         # scheduler_sequential_fallback_total in the provisioner).
         self.fallback_solves = 0
         self.last_fallback_reasons: List[str] = []
+        # relaxation pre-solver telemetry of the last solve (bench
+        # relax_routed_fraction / residual_pods columns): pods the bulk
+        # pre-solver placed, claims it opened, and guard rejections that
+        # shed the combined solve back to the full exact kernel
+        self.last_relax_pods = 0
+        self.last_relax_claims = 0
+        self.last_relax_residual_pods = 0
+        self.relax_rejects = 0
         # per-solve volume routing state (prepare_volume_routing)
         self._vol_resolved: Dict[str, list] = {}
         # two-slot async dispatch window: a submitted kernel computes
@@ -1340,10 +1354,10 @@ class TpuSolver:
         fit = self._fit_matrix(snap)
         # adaptive sizing inside _select_nmax: the a-priori estimate sums
         # per-group worst cases and overshoots shared packing by 2-4x; once
-        # a solve of this catalog has run, size off the observed claim count
-        # instead (x1.5 headroom, floored at the hard pods-capacity bound).
-        # Every [NMAX, T] op in the scan scales with this. Undershoot is
-        # caught by the overflow-doubling retry below.
+        # a solve of this catalog has run, size off the observed claim
+        # count's own pow2 bucket (floored at the hard pods-capacity
+        # bound). Every [NMAX, T] op in the scan scales with this.
+        # Undershoot is caught by the overflow-doubling retry below.
         nmax = self._select_nmax(snap, fit, nmax_hint)
         P = len(snap.templates)
         T = len(snap.instance_types)
@@ -1387,6 +1401,8 @@ class TpuSolver:
                     # dispatch overlap the transfer with host work
                     jax.block_until_ready(args)
             self._last_incremental = store.last_incremental or delta.reused
+
+        relax_plan = None  # set on the plain single-device jit path only
 
         if self.config.backend == "native":
             from .. import native
@@ -1446,6 +1462,33 @@ class TpuSolver:
 
             classed_args = self._classed_partition(snap_run, res_cap0)
 
+            # relaxation bulk pre-solver (ops/relax.py): when the planner
+            # proves part of the batch is separable easy mass, its counts
+            # are zeroed for the exact dispatch and the bulk is placed by
+            # the closed-form relaxed solve, merged before guard/decode.
+            # Only the g_count ARG is overridden (scenario-style): the
+            # device-resident buffers keep staging the true encode, so
+            # warm REUSE/row-delta is untouched.
+            use_relax = self.config.relax
+            if use_relax is None:
+                use_relax = os.environ.get("KTPU_RELAX") != "0"
+            if use_relax:
+                from ..ops import relax as relax_mod
+
+                relax_plan = relax_mod.plan_bulk(
+                    snap_run,
+                    res_cap0=res_cap0,
+                    n_exist=len(snap.existing_names),
+                )
+            else:
+                relax_plan = None
+            args = list(args)
+            true_g_count = args[0]
+            if relax_plan is not None:
+                g_count_res = np.asarray(snap_run.g_count).copy()
+                g_count_res[relax_plan.easy_gids] = 0
+                args[0] = g_count_res
+
             def call(nmax):
                 # the dispatch rides the two-slot queue: submit is async
                 # (XLA computes while any remaining host work runs), and
@@ -1487,29 +1530,88 @@ class TpuSolver:
                 " (expected 'tpu' or 'native')"
             )
 
-        while True:
-            with obs.span("solve.dispatch", nmax=nmax):
-                (c_pool, c_tmask, n_open, overflow,
-                 exist_fills, claim_fills, unplaced, c_dzone, c_dct,
-                 c_resv) = call(nmax)
-            self.last_dispatches += 1
-            if not overflow:
-                break
-            nmax *= 2
+        def run_dispatch():
+            nonlocal nmax
+            while True:
+                with obs.span("solve.dispatch", nmax=nmax):
+                    outs = call(nmax)
+                self.last_dispatches += 1
+                if not outs[3]:  # overflow
+                    return outs
+                nmax *= 2
+
+        outs = run_dispatch()
+        self.last_relax_pods = 0
+        self.last_relax_claims = 0
+        self.last_relax_residual_pods = 0
+        total_nmax = nmax
+        if relax_plan is not None:
+            from .. import faults
+            from ..ops import relax as relax_mod
+
+            try:
+                with obs.span("solve.relax", pods=relax_plan.easy_pods):
+                    bulk = relax_mod.solve_bulk(relax_plan, snap_run)
+                # chaos seam: a corrupt bulk must trip the combined guard
+                # below and shed to the full exact solve, never commit
+                bulk = faults.mutate(faults.RELAX_OUTPUT, bulk)
+                outs_c, total_nmax = self._merge_relax(
+                    outs, relax_plan, bulk, nmax
+                )
+                # invariant guard over the COMBINED solve (exact residual
+                # + relaxed bulk), against the TRUE group counts
+                with obs.span("solve.guard"):
+                    self._verify_solution(
+                        snap, snap_run, outs_c[0], outs_c[1],
+                        int(outs_c[2]), outs_c[4], outs_c[5], outs_c[6],
+                        total_nmax, c_dzone=outs_c[7], c_dct=outs_c[8],
+                    )
+                outs = outs_c
+                self.last_relax_pods = relax_plan.easy_pods
+                self.last_relax_claims = int(bulk[0])
+                self.last_relax_residual_pods = int(
+                    np.asarray(true_g_count).sum()
+                ) - relax_plan.easy_pods
+                obs.event(
+                    "solve.relax",
+                    pods=relax_plan.easy_pods,
+                    claims=int(bulk[0]),
+                    runs=len(relax_plan.run_head),
+                )
+            except SolverIntegrityError:
+                # rejected rounding: shed the whole batch to the full
+                # exact solve (the documented guard interaction). The
+                # exact re-solve runs against the true counts and the
+                # normal guard below.
+                self.relax_rejects += 1
+                obs.event("solve.relax_rejected")
+                args[0] = true_g_count
+                relax_plan = None
+                outs = run_dispatch()
+                total_nmax = nmax
+        (c_pool, c_tmask, n_open, overflow,
+         exist_fills, claim_fills, unplaced, c_dzone, c_dct,
+         c_resv) = outs
         # invariant guard BEFORE decode: a violating solve is discarded
         # with zero state mutated (faults/guard.py — conservation,
         # capacity, pool limits, domain-pin ranges), so the oracle
-        # fallback is exact
+        # fallback is exact. (Relax-combined solves were already guarded
+        # above — re-checking the merged arrays is a few host matmuls.)
         with obs.span("solve.guard"):
             self._verify_solution(
                 snap, snap_run, c_pool, c_tmask, int(n_open),
-                exist_fills, claim_fills, unplaced, nmax,
+                exist_fills, claim_fills, unplaced, total_nmax,
                 c_dzone=c_dzone, c_dct=c_dct,
             )
         if self.config.max_claims is None:
+            # the hint sizes the EXACT kernel's NMAX bucket, so bulk
+            # claims the relaxation placed are excluded — a relax-reject
+            # re-solve that needs the full count is covered by the
+            # overflow-doubling retry
             with self._shared_cache.lock:
                 lease_cache["nmax_hint"] = max(
-                    lease_cache.get("nmax_hint", 0), int(n_open)
+                    lease_cache.get("nmax_hint", 0),
+                    int(n_open) - self.last_relax_claims,
                 )
         try:
             with obs.span("solve.decode", claims=int(n_open)):
@@ -1525,6 +1627,64 @@ class TpuSolver:
             raise DecodeCommitError(
                 f"decode aborted mid-commit: {type(exc).__name__}: {exc}"
             ) from exc
+
+    @staticmethod
+    def _merge_relax(outs, plan, bulk, nmax):
+        """Append the relaxed bulk's claims after the exact residual's.
+
+        Claim slot NUMBERING differs from a pure-exact interleaved solve
+        (slots are anonymous — decode mints claim identities from slot
+        order), but the decisions — which pods land on which claims of
+        which template with which surviving type sets — are identical by
+        the separability proof in ops/relax.py (pinned by
+        tests/test_relax.py). Returns (combined outs, combined nmax)."""
+        (c_pool, packed, n_open, overflow, exist_fills, claim_fills,
+         unplaced, c_dzone, c_dct, c_resv) = outs
+        n_open = int(n_open)
+        n_r, r_pool, r_tmask, r_fills, r_unplaced = bulk
+        c_pool = np.asarray(c_pool)
+        packed = np.asarray(packed)
+        claim_fills = np.asarray(claim_fills)
+        G = claim_fills.shape[0]
+        # bit-pack the bulk's type masks exactly like ops/solve._wire_pack
+        # (MSB-first uint8 rows) so decode's lazy unpack sees one layout.
+        # Relax only routes on the plain single-device jit path, whose
+        # outputs are always uint8-packed (native/mesh set relax_plan to
+        # None), so no raw-bool layout can reach this merge.
+        assert packed.dtype == np.uint8, "relax merge requires packed masks"
+        r_tmask = np.asarray(r_tmask)
+        T = r_tmask.shape[1]
+        pad = (-T) % 8
+        r_packed = np.packbits(
+            np.pad(r_tmask, ((0, 0), (0, pad))), axis=1
+        )
+        c_pool_c = np.concatenate(
+            [c_pool[:n_open], np.asarray(r_pool).astype(c_pool.dtype)]
+        )
+        packed_c = np.concatenate([packed[:n_open], r_packed], axis=0)
+        fills_r = np.zeros((G, int(n_r)), claim_fills.dtype)
+        fills_r[plan.easy_gids] = np.asarray(r_fills)
+        claim_fills_c = np.concatenate(
+            [claim_fills[:, :n_open], fills_r], axis=1
+        )
+        unplaced_c = np.asarray(unplaced).copy()
+        unplaced_c[plan.easy_gids] += np.asarray(r_unplaced)
+        c_dzone_c = np.concatenate(
+            [np.asarray(c_dzone)[:n_open],
+             np.full((int(n_r),), -1, np.asarray(c_dzone).dtype)]
+        )
+        c_dct_c = np.concatenate(
+            [np.asarray(c_dct)[:n_open],
+             np.full((int(n_r),), -1, np.asarray(c_dct).dtype)]
+        )
+        c_resv_c = np.concatenate(
+            [np.asarray(c_resv)[:n_open], np.zeros((int(n_r),), bool)]
+        )
+        return (
+            (c_pool_c, packed_c, n_open + int(n_r), overflow, exist_fills,
+             claim_fills_c, unplaced_c, c_dzone_c, c_dct_c, c_resv_c),
+            nmax + int(n_r),
+        )
 
     @staticmethod
     def _vocab_bound(snap, kid: int) -> int:
@@ -1623,8 +1783,16 @@ class TpuSolver:
         been recorded for this catalog."""
         nmax = self.config.max_claims or self._estimate_nmax(snap, fit)
         if self.config.max_claims is None and nmax_hint:
+            # size to the observed claim count's own power-of-two bucket
+            # (+8 slack), not 1.5x it: the old headroom pushed any hint in
+            # (0.66, 1.0] of a bucket into the NEXT one, doubling every
+            # [NMAX] op in the scan (diverse-ref: 1000 claims ran at 2048).
+            # Claim-count growth past the bucket is caught by the
+            # overflow-doubling retry — one extra dispatch on the rare
+            # solve that crosses a boundary, instead of 2x kernel cost on
+            # every solve that doesn't.
             adaptive = max(
-                enc._next_pow2(int(nmax_hint * 1.5) + 8, floor=8),
+                enc._next_pow2(int(nmax_hint) + 8, floor=8),
                 enc._next_pow2(self._nmax_floor(snap, fit), floor=8),
             )
             nmax = min(nmax, adaptive)
@@ -1635,6 +1803,12 @@ class TpuSolver:
         ``G`` is the bucketed group-axis size the kernel will run at."""
         P = len(snap.templates)
         T = len(snap.instance_types)
+        # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
+        # feasibility tables, the scan computes per-group rows instead.
+        # Computed ONCE: sparse_groups must stay its inverse (the tiled
+        # mode passes zero-G placeholder tables the sparse index never
+        # consults).
+        tiled = P * G * T * 5 > (3 << 29)
         return dict(
             zone_kid=snap.zone_kid,
             ct_kid=snap.ct_kid,
@@ -1644,13 +1818,19 @@ class TpuSolver:
             # static gate: contributor counting (cross-group shared
             # constraints) traced out unless some group feeds a carry
             has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
-            # HBM-scaling gate (SURVEY §7.4.6): beyond ~1.5 GiB of
-            # feasibility tables, the scan computes per-group rows instead
-            tile_feasibility=P * G * T * 5 > (3 << 29),
+            tile_feasibility=tiled,
             # waterfill bisection budget: every trip is a serial reduction
             # on the scan-step critical path, so prove the tightest level
             # bound the snapshot allows (see _wf_iters)
             wf_iters=self._wf_iters(snap),
+            # segment-contraction feasibility (ops/feasibility.py:*_sparse):
+            # cost scales with the encoder's live (group, key) pairs instead
+            # of the dense G x K join — always on outside the tiled mode,
+            # which computes its own per-step rows (KTPU_SPARSE_FEAS=0
+            # pins the dense twins for A/B verification)
+            sparse_groups=(
+                not tiled and os.environ.get("KTPU_SPARSE_FEAS") != "0"
+            ),
         )
 
     # below this mean (real groups per feasibility class), per-class head
@@ -1666,8 +1846,6 @@ class TpuSolver:
         so NRES > 0 always uses pack(). KTPU_CLASSED=1/0 overrides auto
         (the test suite uses it to force every scenario through the
         classed kernel for equivalence coverage)."""
-        import os
-
         cfg = self.config.classed
         if cfg is None:
             env = os.environ.get("KTPU_CLASSED")

@@ -85,6 +85,10 @@ _GN_FREE_FIELDS = frozenset({
     "p_titype_ok",
     "p_mvmin", "t_mvoh",
     "dd0", "dtg_key", "well_known",
+    # the compacted segment index rides its own live-pair axis (L / LZ,
+    # power-of-two bucketed); entries name REAL group rows, which padding
+    # never moves, so the arrays are valid for any padded G
+    "gk_g", "gk_k", "gk_w", "goff_idx",
 })
 
 
@@ -513,6 +517,18 @@ class EncodedSnapshot:
     ct_kid: int
     well_known: np.ndarray  # [K] bool
 
+    # compacted nonzero-mask segment index over the group requirement axis
+    # (build_segment_index): the (group, key) pairs whose requirement row
+    # differs from the neutral all-true row. The sparse feasibility kernels
+    # (ops/feasibility.py:*_sparse) contract over these L live pairs with
+    # segment_sum instead of materializing the dense [P, G, T, K, V1] join,
+    # so feasibility cost scales with live pairs, not G x K. Both axes are
+    # power-of-two bucketed so group churn shares compiled programs.
+    gk_g: np.ndarray  # [L] int32 group id per live pair (0 on padding)
+    gk_k: np.ndarray  # [L] int32 key id per live pair (0 on padding)
+    gk_w: np.ndarray  # [L] int32 1 live / 0 padding
+    goff_idx: np.ndarray  # [LZ] int32 groups whose zone/ct row is non-neutral
+
     def padded(self, g_target: int, n_target: int) -> "EncodedSnapshot":
         """A copy with the group and existing-node axes padded to bucket
         sizes, so repeat solves of nearby shapes (e.g. consolidation's
@@ -616,6 +632,7 @@ class EncodedSnapshot:
             self.nh_cnt0, self.dd0, self.dtg_key,
             self.well_known,
             self.p_mvmin, self.t_mvoh,
+            self.gk_g, self.gk_k, self.gk_w, self.goff_idx,
         )
 
 
@@ -641,7 +658,49 @@ SOLVE_ARG_NAMES = (
     "nh_cnt0", "dd0", "dtg_key",
     "well_known",
     "p_mvmin", "t_mvoh",
+    "gk_g", "gk_k", "gk_w", "goff_idx",
 )
+
+
+def build_segment_index(
+    g_def: np.ndarray,
+    g_neg: np.ndarray,
+    g_mask: np.ndarray,
+    zone_kid: int,
+    ct_kid: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Compacted nonzero-mask index over the group requirement axis.
+
+    A (group, key) pair is *live* when its requirement row differs from the
+    neutral row (undefined, non-negated, all-true mask) — the only rows
+    that can change a feasibility term away from the group-independent
+    base. Returns (gk_g, gk_k, gk_w, goff_idx):
+
+    - ``gk_g``/``gk_k``/``gk_w`` [L]: group id, key id, and 0/1 live weight
+      per pair. L is power-of-two bucketed (floor 8) so group churn of
+      nearby live-pair counts shares one compiled program; padding rows
+      carry weight 0 and never contribute to a segment sum.
+    - ``goff_idx`` [LZ]: ids of groups whose zone or capacity-type row is
+      non-neutral — the only groups whose merged offering row differs from
+      the template's. Padding repeats group 0: the sparse kernel scatters
+      each listed group's *recomputed true row*, so duplicate writes are
+      idempotent by construction.
+    """
+    neutral = (~g_def) & (~g_neg) & g_mask.all(axis=2)
+    live = ~neutral  # [G, K]
+    gg, kk = np.nonzero(live)
+    L = _next_pow2(max(len(gg), 1), floor=8)
+    gk_g = np.zeros((L,), np.int32)
+    gk_k = np.zeros((L,), np.int32)
+    gk_w = np.zeros((L,), np.int32)
+    gk_g[: len(gg)] = gg
+    gk_k[: len(gg)] = kk
+    gk_w[: len(gg)] = 1
+    offl = np.flatnonzero(live[:, zone_kid] | live[:, ct_kid])
+    LZ = _next_pow2(max(len(offl), 1), floor=8)
+    goff_idx = np.zeros((LZ,), np.int32)
+    goff_idx[: len(offl)] = offl
+    return gk_g, gk_k, gk_w, goff_idx
 
 
 # -- incremental (delta) encoding -------------------------------------------
@@ -1790,6 +1849,16 @@ def encode(
                 else g.topo.host_counts.get(domain, 0)
             )
 
+    if p_tol_reuse is not None and cluster is not None:
+        # group shapes unchanged: the index is a pure function of the
+        # reused g_def/g_neg/g_mask rows, so the prior arrays are exact
+        ps = cluster._prior_snap
+        gk_g, gk_k, gk_w, goff_idx = ps.gk_g, ps.gk_k, ps.gk_w, ps.goff_idx
+    else:
+        gk_g, gk_k, gk_w, goff_idx = build_segment_index(
+            g_def, g_neg, g_mask, zone_kid, ct_kid
+        )
+
     snap = EncodedSnapshot(
         vocab=vocab,
         resource_names=resource_names,
@@ -1850,6 +1919,10 @@ def encode(
         zone_kid=zone_kid,
         ct_kid=ct_kid,
         well_known=vocab.well_known_mask(K),
+        gk_g=gk_g,
+        gk_k=gk_k,
+        gk_w=gk_w,
+        goff_idx=goff_idx,
     )
     if cluster is not None:
         cluster.finish(snap)

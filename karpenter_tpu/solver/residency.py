@@ -46,6 +46,10 @@ GROUP_ARGS = frozenset(
         "g_dmode", "g_dkey", "g_dskew", "g_dmin0", "g_dprior", "g_dreg",
         "g_drank", "g_hstg", "g_hscap", "g_dtg", "g_hself", "g_hcontrib",
         "g_dcontrib", "dd0", "dtg_key", "p_tol",
+        # the compacted segment index is a pure function of the group
+        # requirement rows, so it versions with the group class; its
+        # leading axis is the live-pair bucket L, not G (NO_ROW_DELTA)
+        "gk_g", "gk_k", "gk_w", "goff_idx",
     }
 )
 # g_count is its own class: count-only churn (the steady-state reconcile
@@ -56,7 +60,9 @@ GCOUNT_ARGS = frozenset({"g_count"})
 # dtg_key ride the shared-constraint slot axis, p_tol carries G on axis 1):
 # they restage whole on a version bump, never row-by-row — a group-axis
 # index applied to them would silently clamp
-NO_ROW_DELTA = frozenset({"dd0", "dtg_key", "p_tol"})
+NO_ROW_DELTA = frozenset(
+    {"dd0", "dtg_key", "p_tol", "gk_g", "gk_k", "gk_w", "goff_idx"}
+)
 
 
 class DeviceResidentArgs:

@@ -4,6 +4,7 @@ Each function below carries exactly the hazard its name says; the pass
 must flag every rule at least once and test_analysis.py pins the set.
 """
 
+import jax
 import jax.numpy as jnp
 
 
@@ -49,3 +50,17 @@ def unbucketed_scratch(n):
     pad = jnp.zeros((n, 1000), jnp.float32)  # SHP603: 1000 is not a bucket
     flat = pad.reshape(n, 40, 25)  # SHP603: literal 40/25 dims
     return flat
+
+
+def misaligned_segment_ids(l, m, g):
+    data = jnp.zeros((l, m), jnp.float32)
+    ids = jnp.zeros((m,), jnp.int32)
+    # SHP601: ids ride axis m but data's segment axis is l
+    return jax.ops.segment_sum(data, ids, num_segments=g)
+
+
+def segment_result_misjoined(l, m, g):
+    data = jnp.zeros((l, m), jnp.float32)
+    ids = jnp.zeros((l,), jnp.int32)
+    seg = jax.ops.segment_sum(data, ids, num_segments=g)  # [g, m]
+    return seg + jnp.zeros((l, m), jnp.float32)  # SHP601: g joined with l

@@ -2,6 +2,7 @@
 order, dtypes, and bucketed literal dims done right — the SHP6xx pass
 must stay silent."""
 
+import jax
 import jax.numpy as jnp
 
 
@@ -44,3 +45,25 @@ def bucketed_scratch(n):
     pad = jnp.zeros((n, 1024), jnp.float32)
     flat = pad.reshape(n, 32, 32)
     return flat
+
+
+def segment_contraction(l, m, g):
+    """The sparse feasibility shape: compacted live pairs summed back to
+    the group axis; the result's axes are (g, m) and join silently."""
+    data = jnp.zeros((l, m), jnp.float32)
+    ids = jnp.zeros((l,), jnp.int32)
+    seg = jax.ops.segment_sum(data, ids, num_segments=g)  # [g, m]
+    return seg + jnp.zeros((g, m), jnp.float32)
+
+
+def gather_along_group_axis(g, m):
+    seg = jnp.zeros((g, m), jnp.float32)
+    idx = jnp.zeros((g, m), jnp.int32)
+    picked = jnp.take_along_axis(seg, idx, axis=1)  # axes preserved
+    return picked + jnp.zeros((g, m), jnp.float32)
+
+
+def bucketed_broadcast(g, m):
+    row = jnp.zeros((m,), jnp.float32)
+    wide = jnp.broadcast_to(row[None, :], (g, m))
+    return wide + jnp.zeros((g, m), jnp.float32)

@@ -417,9 +417,11 @@ class TestShapesPass:
         findings, _ = shapes.check_paths([fixture("bad_shapes.py")])
         assert rules_of(findings) == {"SHP601", "SHP602", "SHP603"}
         messages = "\n".join(f.message for f in findings)
-        # the four seeded SHP601 shapes: operator join, where join,
-        # einsum, transposed matmul contraction
-        assert len([f for f in findings if f.rule == "SHP601"]) == 4
+        # the six seeded SHP601 shapes: operator join, where join,
+        # einsum, transposed matmul contraction, misaligned segment ids,
+        # and a segment_sum result joined against the pre-segment axis
+        assert len([f for f in findings if f.rule == "SHP601"]) == 6
+        assert "segment_sum" in messages
         assert "einsum" in messages
         assert "matmul contracts" in messages
         # widening via constructor, astype, join, and a positional

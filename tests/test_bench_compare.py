@@ -250,3 +250,64 @@ class TestCompareGrids:
         )
         assert out.returncode == 0, out.stderr
         assert "mixed-5000x400" in out.stderr
+
+
+class TestGroupShapeColumns:
+    """ISSUE 13: every grid row carries the group-axis shape (groups,
+    bucketed_groups, live_gt_pairs, antiaffinity_claims) and relaxation
+    telemetry (relax_routed_fraction, residual_pods), and entries
+    carrying the new columns ride the compare gate unchanged."""
+
+    def _solver_pods(self, n=120):
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import constrained_mix
+
+        pods = constrained_mix(n, seed=3)
+        pools = [example_nodepool()]
+        its = {pools[0].name: corpus.generate(16)}
+        topology = Topology(Client(TestClock()), [], pools, its, pods)
+        return TpuSolver(pools, its, topology), pods
+
+    def test_columns_present_and_bucketed(self):
+        from bench import group_shape_columns
+
+        solver, pods = self._solver_pods()
+        cols = group_shape_columns(solver, pods)
+        assert set(cols) == {
+            "groups", "bucketed_groups", "live_gt_pairs",
+            "antiaffinity_claims",
+        }
+        assert cols["groups"] > 0
+        b = cols["bucketed_groups"]
+        assert b >= cols["groups"] and (b & (b - 1)) == 0
+        # constrained pods carry node selectors: live pairs must exist
+        assert cols["live_gt_pairs"] > 0
+
+    def test_empty_batch_zero_columns(self):
+        from bench import group_shape_columns
+
+        solver, _ = self._solver_pods()
+        cols = group_shape_columns(solver, [])
+        assert cols["groups"] == 0 and cols["live_gt_pairs"] == 0
+
+    def test_compare_tolerates_new_columns(self, tmp_path):
+        def wide(config, best_ms):
+            e = _entry(config, 5000, 400, best_ms)
+            e.update(
+                groups=1897, bucketed_groups=2048, live_gt_pairs=64,
+                antiaffinity_claims=1000, relax_routed_fraction=0.0,
+                residual_pods=5000, relax_rejects=0,
+            )
+            return e
+
+        old = _write(tmp_path, "old.json", _grid("tpu", [wide("diverse-ref", 100.0)]))
+        new = _write(tmp_path, "new.json", _grid("tpu", [wide("diverse-ref", 101.0)]))
+        assert compare_grids(old, new) == 0
+        worse = _write(
+            tmp_path, "worse.json", _grid("tpu", [wide("diverse-ref", 190.0)])
+        )
+        assert compare_grids(old, worse) == 1

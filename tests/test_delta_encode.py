@@ -654,3 +654,109 @@ class TestTopologyResidencyContract:
             "warm topology solve must reuse device-resident buffers"
         )
         assert store.last_full_puts == 0
+
+
+class TestGroupChurnCompileCache:
+    """ISSUE 13: power-of-two group bucketing must keep the XLA compile
+    cache flat under group churn. Groups appearing and disappearing
+    across ticks change the REAL group count every solve; because the
+    kernel runs at the padded pow2 bucket (and the segment index rides
+    pow2 live-pair buckets), every tick reuses one compiled program, and
+    the delta encoder keeps serving REUSE / row-level deltas — no full
+    re-encodes, no recompiles."""
+
+    def _palette(self):
+        shapes = []
+        for cpu in ("250m", "500m", "750m", "1", "1250m", "1500m"):
+            for mem in ("1Gi", "2Gi", "3Gi"):
+                shapes.append(dict(cpu=cpu, memory=mem))
+        # two selector shapes keep a stable nonzero live-pair set (their
+        # counts churn, their GROUPS never vanish, so the segment-index
+        # bucket is exercised without vocab growth)
+        shapes.append(
+            dict(cpu="2", memory="4Gi",
+                 node_selector={labels_mod.TOPOLOGY_ZONE: "test-zone-a"})
+        )
+        shapes.append(
+            dict(cpu="2", memory="2Gi",
+                 node_selector={labels_mod.TOPOLOGY_ZONE: "test-zone-b"})
+        )
+        return shapes
+
+    def test_group_churn_compile_count_flat_and_warm(self):
+        from karpenter_tpu.ops.solve import (
+            solve_all_classed_packed,
+            solve_all_packed,
+        )
+
+        rng = random.Random(1234)
+        palette = self._palette()
+        # every palette shape present once at warmup: the vocab and the
+        # static side intern everything up front, so later churn can only
+        # move counts and add/remove GROUPS, never grow the vocab
+        counts = {i: 2 for i in range(len(palette))}
+        cache = EncodeCache()
+
+        def pods_now():
+            out = []
+            for i in sorted(counts):
+                out.extend(
+                    make_pod(**palette[i]) for _ in range(counts[i])
+                )
+            return out
+
+        def solve_once():
+            pods = pods_now()
+            pools = [make_nodepool()]
+            its_by_pool = {pools[0].name: list(_ITS)}
+            topo = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+            s = TpuSolver(pools, its_by_pool, topo, encode_cache=cache)
+            r = s.solve(pods)
+            assert not r.pod_errors
+            return s
+
+        dead: set = set()
+
+        def churn():
+            # swap which plain shapes are ABSENT (groups removed AND
+            # re-added every tick) and move counts around; selector
+            # shapes only ever change counts. The real group count moves
+            # inside one pow2 bucket — crossing a bucket boundary is a
+            # legitimate recompile and not what this test exercises.
+            plain = list(range(len(palette) - 2))
+            for i in dead:
+                counts[i] = rng.randrange(1, 3)
+            dead.clear()
+            dead.update(rng.sample(plain, 2))
+            for i in dead:
+                counts[i] = 0
+            for i in rng.sample(plain, 3):
+                if counts[i]:
+                    counts[i] += rng.randrange(1, 3)
+            for i in (len(palette) - 2, len(palette) - 1):
+                counts[i] = rng.randrange(1, 4)
+
+        # warmup: a-priori NMAX + adaptive NMAX shapes compile here
+        solve_once()
+        solve_once()
+        churn()
+        solve_once()  # first churned shape, still within the warm buckets
+
+        def cache_sizes():
+            return (
+                solve_all_packed._cache_size()
+                + solve_all_classed_packed._cache_size()
+            )
+
+        baseline = cache_sizes()
+        for _ in range(6):
+            churn()
+            s = solve_once()
+            # warm path intact: the encoder served the solve from the
+            # banks (row-delta or verbatim REUSE), never a full restage
+            assert s._last_incremental, "group churn lost the warm path"
+            assert cache.cluster.last_delta.full is False
+        assert cache_sizes() == baseline, (
+            "group churn forked the XLA compile cache despite pow2 "
+            "bucketing"
+        )

@@ -403,8 +403,14 @@ class TestSolverIntegration:
         rule = faults.FaultRule(faults.SOLVER_OUTPUT, mutate=corrupt)
         faults.install(faults.FaultInjector([rule]))
         try:
+            # relax=False pins the EXACT route: these identical plain pods
+            # would otherwise ride the relaxation bulk, leaving the exact
+            # dispatch empty (its corrupted rows are dead padding — the
+            # relax-route corruption twin lives in tests/test_relax.py)
             with pytest.raises(SolverIntegrityError):
-                build_solver(copy.deepcopy(pods)).solve(copy.deepcopy(pods))
+                build_solver(
+                    copy.deepcopy(pods), config=SolverConfig(relax=False)
+                ).solve(copy.deepcopy(pods))
         finally:
             faults.uninstall()
 
@@ -417,7 +423,8 @@ class TestSolverIntegration:
         )
         try:
             results = build_solver(
-                copy.deepcopy(pods), config=SolverConfig(health=health)
+                copy.deepcopy(pods),
+                config=SolverConfig(health=health, relax=False),
             ).solve(copy.deepcopy(pods))
         finally:
             faults.uninstall()
@@ -441,8 +448,12 @@ class TestSolverIntegration:
             )
         )
         try:
+            # relax=False: pin the exact route (see the corrupt-output
+            # test above; relax-route coverage in tests/test_relax.py)
             with pytest.raises(SolverIntegrityError):
-                build_solver(copy.deepcopy(pods)).solve(copy.deepcopy(pods))
+                build_solver(
+                    copy.deepcopy(pods), config=SolverConfig(relax=False)
+                ).solve(copy.deepcopy(pods))
         finally:
             faults.uninstall()
 

@@ -300,7 +300,12 @@ class TestAuditTrail:
             )
         )
         pods = make_pods(10, cpu="1", memory="1Gi")
-        solver = build_solver(pods, config=SolverConfig(health=health))
+        # relax=False pins the exact route: identical plain pods would
+        # otherwise ride the relaxation bulk and the corrupted exact rows
+        # would be dead padding (relax-route twin: tests/test_relax.py)
+        solver = build_solver(
+            pods, config=SolverConfig(health=health, relax=False)
+        )
         results = solver.solve(pods)
         faults.uninstall()
         assert not results.pod_errors  # oracle re-solve succeeded

@@ -363,7 +363,7 @@ class TestStoreAndClusterRaces:
         from karpenter_tpu.cloudprovider import corpus
         from karpenter_tpu.scheduling.topology import Topology
         from karpenter_tpu.solver import TpuSolver
-        from karpenter_tpu.solver.driver import EncodeCache
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
 
         from helpers import make_nodepool, make_pods
 
@@ -382,7 +382,16 @@ class TestStoreAndClusterRaces:
                     topo = Topology(
                         Client(TestClock()), [], pools, its, pods
                     )
-                    solver = TpuSolver(pools, its, topo, encode_cache=cache)
+                    # relax=False pins the exact route: the hint records
+                    # the EXACT kernel's claim count (bulk claims the
+                    # relaxation places are excluded by design), and this
+                    # plain identical-pod batch would otherwise route
+                    # entirely through the bulk pre-solver, recording 0
+                    solver = TpuSolver(
+                        pools, its, topo,
+                        config=SolverConfig(relax=False),
+                        encode_cache=cache,
+                    )
                     r = solver.solve(pods)
                     assert r.all_pods_scheduled(), r.pod_errors
                     results.append(r.node_count())

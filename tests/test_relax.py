@@ -1,0 +1,192 @@
+"""Relaxation bulk pre-solver (ops/relax.py): oracle-parity suite.
+
+The contract: for every batch, a relax-enabled solve and a forced-exact
+solve produce IDENTICAL decisions — which pods land on which claims of
+which template with which surviving type options. Separable batches
+route their easy mass through the closed-form bulk; non-separable ones
+(the diverse / constrained / anti-affinity reference mixes) must route
+the full residual to the exact kernel, and a corrupted bulk must trip
+the invariant guard and shed to the full exact solve.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_platforms", "cpu")
+
+from karpenter_tpu import faults  # noqa: E402
+from karpenter_tpu.api import labels as labels_mod  # noqa: E402
+from karpenter_tpu.api import resources as res  # noqa: E402
+from karpenter_tpu.api.objects import (  # noqa: E402
+    LabelSelector, ObjectMeta, Pod, PodAffinityTerm, PodSpec,
+)
+from karpenter_tpu.cloudprovider import corpus  # noqa: E402
+from karpenter_tpu.kube import Client, TestClock  # noqa: E402
+from karpenter_tpu.scheduling.topology import Topology  # noqa: E402
+from karpenter_tpu.solver import TpuSolver  # noqa: E402
+from karpenter_tpu.solver.driver import (  # noqa: E402
+    EncodeCache, SolverConfig,
+)
+from karpenter_tpu.solver.example import example_nodepool  # noqa: E402
+
+ZONES = ["test-zone-a", "test-zone-b", "test-zone-c"]
+
+
+def _pod(name, cpu_m, zone=None, labels=None, anti=None):
+    spec = PodSpec(
+        requests={res.CPU: cpu_m, res.MEMORY: 2**30 * res.MILLI},
+        node_selector=(
+            {labels_mod.TOPOLOGY_ZONE: zone} if zone is not None else None
+        ),
+    )
+    if anti is not None:
+        spec.pod_anti_affinity = [
+            PodAffinityTerm(
+                topology_key=labels_mod.HOSTNAME,
+                label_selector=LabelSelector(match_labels=anti),
+            )
+        ]
+    return Pod(metadata=ObjectMeta(name=name, labels=labels or {}), spec=spec)
+
+
+def _separable_pods(n=600):
+    """One uniform deployment per zone: three signature runs with
+    mutually exclusive zone masks — provably separable easy mass."""
+    return [
+        _pod(f"sep-{i}", (1 + i % 3) * 500, zone=ZONES[i % 3])
+        for i in range(n)
+    ]
+
+
+def _partial_pods(n_easy=300, n_anti=40):
+    """A separable zone-a deployment plus a zone-b anti-affinity class:
+    the bulk routes, the anti-affinity residual rides the exact kernel,
+    and the disjoint zone masks keep the two from sharing claims."""
+    pods = [_pod(f"easy-{i}", 500, zone=ZONES[0]) for i in range(n_easy)]
+    lbl = {"app": "nginx"}
+    pods += [
+        _pod(f"anti-{i}", 700, zone=ZONES[1], labels=lbl, anti=lbl)
+        for i in range(n_anti)
+    ]
+    return pods
+
+
+def _solve(pods, relax, n_types=24, cache=None):
+    pools = [example_nodepool()]
+    its = {pools[0].name: corpus.generate(n_types)}
+    topology = Topology(Client(TestClock()), [], pools, its, pods)
+    s = TpuSolver(
+        pools, its, topology,
+        config=SolverConfig(relax=relax),
+        encode_cache=cache or EncodeCache(),
+    )
+    return s, s.solve(pods)
+
+
+def _canon(results):
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(results.pod_errors),
+    )
+
+
+class TestRelaxParity:
+    def test_separable_bulk_routes_and_matches_exact(self):
+        pods = _separable_pods()
+        s1, r1 = _solve(pods, relax=True)
+        s0, r0 = _solve(pods, relax=False)
+        assert s1.last_relax_pods == len(pods)
+        assert s1.last_relax_claims == len(r1.new_node_claims)
+        assert s1.last_relax_residual_pods == 0
+        assert s1.relax_rejects == 0
+        assert _canon(r1) == _canon(r0)
+
+    def test_partial_routing_residual_exact(self):
+        pods = _partial_pods()
+        s1, r1 = _solve(pods, relax=True)
+        s0, r0 = _solve(pods, relax=False)
+        assert s1.last_relax_pods == 300  # the easy deployment only
+        assert s1.last_relax_residual_pods == 40
+        assert _canon(r1) == _canon(r0)
+        # one claim per anti-affinity pod came from the exact kernel
+        assert len(r1.new_node_claims) == len(r0.new_node_claims)
+
+    @pytest.mark.parametrize("mix", ["diverse", "constrained", "anti"])
+    def test_reference_mixes_route_full_residual(self, mix):
+        from karpenter_tpu.solver.workloads import (
+            constrained_mix, diverse_reference_mix,
+        )
+
+        if mix == "diverse":
+            pods = diverse_reference_mix(250, seed=7)
+        elif mix == "constrained":
+            pods = constrained_mix(250, seed=5)
+        else:
+            lbl = {"app": "nginx"}
+            pods = [
+                _pod(f"an-{i}", 500, labels=lbl, anti=lbl) for i in range(60)
+            ]
+        s1, r1 = _solve(pods, relax=True)
+        s0, r0 = _solve(pods, relax=False)
+        # nothing provably separable: the WHOLE batch is the residual
+        assert s1.last_relax_pods == 0
+        assert _canon(r1) == _canon(r0)
+
+    def test_mixed_shapes_same_selector_not_routed(self):
+        # same zone, different shapes: the exact kernel lets the smaller
+        # class top up the bigger class's partial claims, so the wall
+        # must keep BOTH on the exact path
+        pods = [
+            _pod(f"m-{i}", 500 + (i % 2) * 700, zone=ZONES[0])
+            for i in range(80)
+        ]
+        s1, r1 = _solve(pods, relax=True)
+        s0, r0 = _solve(pods, relax=False)
+        assert s1.last_relax_pods == 0
+        assert _canon(r1) == _canon(r0)
+
+    def test_warm_churn_keeps_reuse_with_relax(self):
+        # the relax path must not disturb the device-residency warm path:
+        # only the g_count ARG is overridden, so count-churn still rides
+        # REUSE / row-delta staging
+        cache = EncodeCache()
+        pods = _separable_pods(300)
+        s, _ = _solve(pods, relax=True, cache=cache)
+        s, _ = _solve(pods, relax=True, cache=cache)
+        s2, _ = _solve(pods[:-6] + _separable_pods(300)[:6], relax=True,
+                       cache=cache)
+        assert s2.last_relax_pods == 300
+        assert s2._last_incremental, "relax broke the warm staging path"
+
+    def test_corrupt_bulk_sheds_to_full_exact(self):
+        # chaos: zero the bulk's fills — conservation fails, the guard
+        # rejects the combined solve, and the driver re-solves fully
+        # exact with the true counts (decisions still correct)
+        def corrupt(bulk):
+            n_r, r_pool, r_tmask, r_fills, r_unplaced = bulk
+            return (n_r, r_pool, r_tmask, np.zeros_like(r_fills), r_unplaced)
+
+        inj = faults.FaultInjector(
+            [faults.FaultRule(faults.RELAX_OUTPUT, mutate=corrupt)]
+        )
+        faults.install(inj)
+        try:
+            pods = _separable_pods(240)
+            s1, r1 = _solve(pods, relax=True)
+        finally:
+            faults.uninstall()
+        s0, r0 = _solve(pods, relax=False)
+        assert s1.relax_rejects == 1
+        assert s1.last_relax_pods == 0  # the committed solve was exact
+        assert inj.fired(faults.RELAX_OUTPUT) == 1
+        assert _canon(r1) == _canon(r0)

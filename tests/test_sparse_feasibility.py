@@ -1,0 +1,136 @@
+"""Sparse/segment feasibility twins vs the dense tables: bit-exact.
+
+The encoder's compacted nonzero-mask index (encode.build_segment_index)
+drives segment-sum feasibility (ops/feasibility.py:*_sparse); every entry
+of (compat_pg, type_ok, n_fit, cap_ng) must match the dense kernels on
+real encoded snapshots — including groups with node selectors (defined
+keys), negated requirements, zone/capacity-type constraints (the merged
+offering correction), padded group rows, and existing nodes.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from karpenter_tpu.ops.feasibility import (  # noqa: E402
+    existing_node_feasibility,
+    existing_node_feasibility_sparse,
+    fresh_claim_feasibility,
+    fresh_claim_feasibility_sparse,
+)
+from karpenter_tpu.solver import encode as enc  # noqa: E402
+
+
+def _snap_for(pods, existing_nodes=()):
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver.example import example_nodepool
+
+    pools = [example_nodepool()]
+    its = {pools[0].name: corpus.generate(24)}
+    topology = Topology(Client(TestClock()), [], pools, its, pods)
+    solver = TpuSolver(pools, its, topology)
+    groups, _ = enc.partition_and_group(pods, topology=solver.oracle.topology)
+    snap, avail, *_rest = solver._encode_batch(groups)
+    return solver, snap
+
+
+def _dense_vs_sparse(snap):
+    dense = fresh_claim_feasibility(
+        snap.g_def, snap.g_neg, snap.g_mask, snap.g_req,
+        snap.p_def, snap.p_neg, snap.p_mask, snap.p_daemon, snap.p_tol,
+        snap.p_titype_ok,
+        snap.t_def, snap.t_mask, snap.t_alloc,
+        snap.o_avail, snap.o_zone, snap.o_ct,
+        snap.well_known,
+        zone_kid=snap.zone_kid, ct_kid=snap.ct_kid,
+    )
+    sparse = fresh_claim_feasibility_sparse(
+        snap.g_def, snap.g_neg, snap.g_mask, snap.g_req,
+        snap.p_def, snap.p_neg, snap.p_mask, snap.p_daemon, snap.p_tol,
+        snap.p_titype_ok,
+        snap.t_def, snap.t_mask, snap.t_alloc,
+        snap.o_avail, snap.o_zone, snap.o_ct,
+        snap.well_known,
+        snap.gk_g, snap.gk_k, snap.gk_w, snap.goff_idx,
+        zone_kid=snap.zone_kid, ct_kid=snap.ct_kid,
+    )
+    for name, d, s in zip(("compat_pg", "type_ok", "n_fit"), dense, sparse):
+        d, s = np.asarray(d), np.asarray(s)
+        assert d.shape == s.shape, name
+        mism = np.argwhere(d != s)
+        assert not mism.size, f"{name} diverges at {mism[:5]}"
+
+
+class TestSparseFeasibility:
+    def test_constrained_mix_bit_exact(self):
+        from karpenter_tpu.solver.workloads import constrained_mix
+
+        _, snap = _snap_for(constrained_mix(300, seed=5))
+        assert int(snap.gk_w.sum()) > 0  # selectors define keys
+        _dense_vs_sparse(snap)
+
+    def test_diverse_mix_bit_exact(self):
+        from karpenter_tpu.solver.workloads import diverse_reference_mix
+
+        _, snap = _snap_for(diverse_reference_mix(250, seed=7))
+        _dense_vs_sparse(snap)
+
+    def test_padded_groups_bit_exact(self):
+        from karpenter_tpu.solver.workloads import constrained_mix
+
+        _, snap = _snap_for(constrained_mix(200, seed=11))
+        G = enc._next_pow2(len(snap.groups) + 5, floor=8)
+        _dense_vs_sparse(snap.padded(G, 0))
+
+    def test_zone_constrained_offering_correction(self):
+        # pods pinned to one zone: the merged offering row must differ
+        # from the template base, exercising the goff scatter path
+        from karpenter_tpu.api import labels as labels_mod
+        from karpenter_tpu.solver.workloads import mixed_pods
+
+        pods = mixed_pods(60, gpu_fraction=0.0)
+        for p in pods[:20]:
+            p.spec.node_selector = {labels_mod.TOPOLOGY_ZONE: "zone-a"}
+        _, snap = _snap_for(pods)
+        assert int((snap.goff_idx > 0).sum()) > 0
+        _dense_vs_sparse(snap)
+
+    def test_existing_nodes_bit_exact(self):
+        from karpenter_tpu.solver.workloads import constrained_mix
+
+        solver, snap = _snap_for(constrained_mix(150, seed=3))
+        # synthesize node rows from the type side so no cluster is needed:
+        # strict node compatibility only reads def/mask/avail/base/tol
+        T = snap.t_def.shape[0]
+        N = min(6, T)
+        rng = np.random.default_rng(0)
+        n_def = snap.t_def[:N].copy()
+        n_mask = snap.t_mask[:N].copy()
+        n_avail = snap.t_alloc[:N].copy()
+        n_base = np.zeros_like(n_avail)
+        n_tol = rng.random((N, len(snap.g_count))) < 0.8
+        dense = existing_node_feasibility(
+            snap.g_def, snap.g_neg, snap.g_mask, snap.g_req,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            snap.well_known,
+        )
+        sparse = existing_node_feasibility_sparse(
+            snap.g_def, snap.g_neg, snap.g_mask, snap.g_req,
+            n_def, n_mask, n_avail, n_base, n_tol,
+            snap.gk_g, snap.gk_k, snap.gk_w,
+        )
+        assert (np.asarray(dense) == np.asarray(sparse)).all()
+
+    def test_index_is_pow2_bucketed(self):
+        from karpenter_tpu.solver.workloads import constrained_mix
+
+        _, snap = _snap_for(constrained_mix(120, seed=9))
+        for arr in (snap.gk_g, snap.gk_k, snap.gk_w):
+            n = len(arr)
+            assert n >= 8 and (n & (n - 1)) == 0
+        n = len(snap.goff_idx)
+        assert n >= 8 and (n & (n - 1)) == 0

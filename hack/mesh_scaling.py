@@ -1,12 +1,15 @@
-"""Multi-chip scaling measurement over the virtual CPU mesh.
+"""Multi-chip scaling measurement over the virtual CPU mesh (r06 layout).
 
 Runs the constrained north-star snapshot through the sharded solve at
-1/2/4/8 devices and every (data, model) factorization, asserting output
-equality against the single-device program and timing (a) the full fused
-solve and (b) the feasibility stage alone under the same shardings. CPU
-virtual devices share the host's cores, so the numbers measure GSPMD
-partitioning + collective overhead (the scaling *shape*), not real ICI
-speedup — exactly what can be validated without multi-chip hardware.
+1/2/4/8 devices over the r06 factorizations — data (the segment live-pair
+axis), model (types), and mixed — asserting output equality against the
+single-device program and recording, per configuration, the wall time AND
+the compiled scan structure (collectives inside the packing scan's while
+bodies, parallel.mesh.scan_collective_report). CPU virtual devices share
+the host's cores, so wall times measure GSPMD partitioning + collective
+overhead (the scaling *shape*), not real ICI speedup — the structure
+columns are the host-independent signal: the r05 G-sharded layout paid an
+all-gather per scan step (12x); the r06 data axis pays zero.
 
 Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
            python hack/mesh_scaling.py [n_pods] [n_types]
@@ -25,6 +28,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
 import jax  # noqa: E402
 
@@ -69,6 +73,7 @@ def build_snapshot(n_pods: int, n_types: int):
         has_domains=bool((snap.g_dmode > 0).any()),
         has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
         wf_iters=solver._wf_iters(snap),
+        sparse_groups=True,
     )
     args = snap.solve_args(a_tzc, res_cap0, a_res)
     return args, statics
@@ -77,6 +82,7 @@ def build_snapshot(n_pods: int, n_types: int):
 def time_fn(run, reps=3):
     run()  # warm (compile)
     best = float("inf")
+    out = None
     for _ in range(reps):
         t0 = time.perf_counter()
         out = run()
@@ -85,57 +91,17 @@ def time_fn(run, reps=3):
     return best, out
 
 
-def feasibility_only_fn(mesh, statics):
-    """The feasibility stage alone, under the same input shardings — the
-    embarrassingly-parallel part whose scaling the mesh exists for."""
-    from karpenter_tpu.ops.solve import _feasibility_tables
-    from karpenter_tpu.parallel.mesh import snapshot_shardings
-
-    def feas(*args):
-        (
-            g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
-            g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
-            g_hstg, g_hscap, g_dtg, g_hself, g_hcontrib, g_dcontrib,
-            p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
-            p_titype_ok,
-            t_def, t_mask, t_alloc, t_cap,
-            o_avail, o_zone, o_ct, a_tzc, res_cap0, a_res,
-            n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
-            nh_cnt0, dd0, dtg_key, well_known,
-        ) = args
-        return _feasibility_tables(
-            g_count, g_def, g_neg, g_mask, g_req,
-            p_def, p_neg, p_mask, p_daemon, p_tol, p_titype_ok,
-            t_def, t_mask, t_alloc,
-            o_avail, o_zone, o_ct,
-            n_def, n_mask, n_avail, n_base, n_tol,
-            well_known,
-            zone_kid=statics["zone_kid"],
-            ct_kid=statics["ct_kid"],
-            tile_feasibility=False,
-        )
-
-    if mesh is None:
-        return jax.jit(feas)
-    return jax.jit(
-        feas,
-        in_shardings=snapshot_shardings(mesh),
-        out_shardings=jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec()
-        ),
-    )
-
-
 def main():
     n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     n_types = int(sys.argv[2]) if len(sys.argv) > 2 else 800
     from karpenter_tpu.ops.solve import solve_all
     from karpenter_tpu.parallel.mesh import (
-        make_mesh, pad_args_for_mesh, sharded_solve_fn,
+        make_mesh, pad_args_for_mesh, scan_collective_report,
+        sharded_solve_fn,
     )
 
     args, statics = build_snapshot(n_pods, n_types)
-    G, T = args[0].shape[0], args[30].shape[0]
+    G, T = args[0].shape[0], args[28].shape[0]
     print(
         f"snapshot: pods={n_pods} types={n_types} G={G} T={T}"
         f" nmax={statics['nmax']}",
@@ -143,18 +109,13 @@ def main():
     )
 
     base_t, base_out = time_fn(lambda: solve_all(*args, **statics))
-    feas1 = feasibility_only_fn(None, statics)
-    base_feas_t, _ = time_fn(lambda: feas1(*args))
     rows = [{
-        "devices": 1, "data": 1, "model": 1,
+        "devices": 1, "scenario": 1, "data": 1, "model": 1,
         "solve_ms": round(base_t * 1000, 1),
-        "feas_ms": round(base_feas_t * 1000, 1),
+        "scan_collectives": 0, "scan_collectives_scalar": 0,
+        "total_collectives": 0,
     }]
-    print(
-        f"single-device: solve={base_t * 1000:.0f}ms"
-        f" feas={base_feas_t * 1000:.0f}ms",
-        file=sys.stderr,
-    )
+    print(f"single-device: solve={base_t * 1000:.0f}ms", file=sys.stderr)
 
     ref = [np.asarray(x) for x in jax.device_get(base_out)]
     n_open = int(ref[2])
@@ -184,21 +145,18 @@ def main():
             ref[5],
             err_msg="claim_fills",
         )
-        feas = feasibility_only_fn(mesh, statics)
-
-        def run_feas():
-            with mesh:
-                return feas(*margs)
-
-        ft, _ = time_fn(run_feas)
+        report = scan_collective_report(fn.lower(*margs).compile().as_text())
         rows.append({
-            "devices": n, "data": data, "model": model,
+            "devices": n, "scenario": 1, "data": data, "model": model,
             "solve_ms": round(t * 1000, 1),
-            "feas_ms": round(ft * 1000, 1),
+            "scan_collectives": report["collectives_in_scan_data"],
+            "scan_collectives_scalar": report["collectives_in_scan_scalar"],
+            "total_collectives": report["collectives_total"],
         })
         print(
-            f"mesh {data}x{model} ({n} dev): solve={t * 1000:.0f}ms"
-            f" feas={ft * 1000:.0f}ms (outputs equal)",
+            f"mesh d{data}xm{model} ({n} dev): solve={t * 1000:.0f}ms"
+            f" scan_coll={report['collectives_in_scan_data']}"
+            f" total_coll={report['collectives_total']} (outputs equal)",
             file=sys.stderr,
         )
 
@@ -206,15 +164,17 @@ def main():
     with open(out_path, "w") as fh:
         json.dump(
             {"pods": n_pods, "types": n_types, "G": G, "T": T,
-             "platform": "cpu-virtual", "rows": rows},
+             "platform": "cpu-virtual", "layout": "r06", "rows": rows},
             fh, indent=1,
         )
-    print(f"\n| devices | data x model | solve ms | feasibility ms |")
-    print("|---|---|---|---|")
+    print("\n| devices | data x model | solve ms | scan data-collectives |"
+          " program collectives |")
+    print("|---|---|---|---|---|")
     for r in rows:
         print(
             f"| {r['devices']} | {r['data']}x{r['model']} |"
-            f" {r['solve_ms']} | {r['feas_ms']} |"
+            f" {r['solve_ms']} | {r['scan_collectives']} |"
+            f" {r['total_collectives']} |"
         )
 
 

@@ -1,0 +1,149 @@
+"""Presubmit fleet-sharding smoke (ISSUE 14).
+
+A fixed-seed constrained shape solved THROUGH the driver on the virtual
+8-device mesh (r06 layout: segment live-pair axis on 'data', scan state
+replicated) must:
+
+- produce decisions IDENTICAL to the single-device solver (the mesh
+  parity gate);
+- stay fully kernel-routed on both paths (``fallback_solves == 0``);
+- hit the content-hash REUSE outcome on an unchanged warm re-solve with
+  the staged buffers still mesh-resident, and ride a ROW DELTA (not a
+  full re-encode) across a small churn tick (the sharding-aware warm
+  path);
+- keep a scenario batch at <= 2 dispatches under the scenario-major mesh;
+- finish the whole smoke inside a wall-time budget (compile included —
+  generous so CI scheduler jitter cannot flake it).
+
+The per-scan-step-collective structure itself is pinned host-independently
+by tests/test_parallel.py (compiled-HLO audit); this smoke is the
+end-to-end driver-path gate.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if (jax.config.jax_platforms or "axon").split(",")[0] == "axon":
+    jax.config.update("jax_platforms", "cpu")
+
+N_PODS = 800
+N_TYPES = 40
+SEED = 11  # constrained_mix's default seed — the parity suites' shape
+BUDGET_S = 180.0  # measured ~35 s cold on the fallback host; ~5x headroom
+
+
+def _canon(results):
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(results.pod_errors),
+    )
+
+
+def main() -> int:
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import Client, TestClock
+    from karpenter_tpu.parallel.mesh import make_mesh
+    from karpenter_tpu.scheduling.topology import Topology
+    from karpenter_tpu.solver import TpuSolver
+    from karpenter_tpu.solver.driver import (
+        EncodeCache, Scenario, SolverConfig,
+    )
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.workloads import constrained_mix
+
+    t_start = time.perf_counter()
+    if len(jax.devices()) < 8:
+        print("mesh smoke SKIP: needs 8 virtual devices", file=sys.stderr)
+        return 0
+    mesh = make_mesh(8)
+    pods = constrained_mix(N_PODS, seed=SEED)
+    pools = [example_nodepool()]
+    its = {pools[0].name: corpus.generate(N_TYPES)}
+
+    def solver_for(cfg, cache, current_pods):
+        topology = Topology(Client(TestClock()), [], pools, its, current_pods)
+        return TpuSolver(
+            pools, its, topology, config=cfg, encode_cache=cache
+        )
+
+    # -- parity gate: mesh decisions == single-device decisions -----------
+    mesh_cache = EncodeCache()
+    s_mesh = solver_for(SolverConfig(mesh=mesh), mesh_cache, pods)
+    r_mesh = s_mesh.solve(pods)
+    s_one = solver_for(SolverConfig(), EncodeCache(), pods)
+    r_one = s_one.solve(pods)
+    assert _canon(r_mesh) == _canon(r_one), (
+        "mesh decisions diverged from single-device"
+    )
+    assert s_mesh.fallback_solves == 0, s_mesh.last_fallback_reasons
+    assert s_one.fallback_solves == 0, s_one.last_fallback_reasons
+    assert not r_mesh.pod_errors, r_mesh.pod_errors
+
+    # -- sharding-aware warm path: REUSE, then a row delta ----------------
+    s2 = solver_for(SolverConfig(mesh=mesh), mesh_cache, pods)
+    s2.solve(pods)
+    assert s2.last_encode_reused, "unchanged mesh re-solve missed REUSE"
+    assert s2.fallback_solves == 0
+    churned = list(pods)
+    churned[0], churned[1] = churned[1], churned[0]
+    churned[2] = constrained_mix(N_PODS, seed=SEED + 1)[0]
+    s3 = solver_for(SolverConfig(mesh=mesh), mesh_cache, churned)
+    s3.solve(churned)
+    assert not s3.last_encode_reused
+    full_encode = not s3.last_encode_reused and s3.last_delta_rows == 0
+    assert not full_encode, "churn tick forced a FULL re-encode on the mesh"
+    store = mesh_cache.device_store
+    assert store is not None and store._mesh_key == mesh, (
+        "staged buffers are not mesh-resident"
+    )
+
+    # -- scenario axis: a consolidation-shaped batch in <= 2 dispatches ---
+    # (mixed shape: constrained_mix's self-anti-affinity groups are a
+    # documented scenario-batch decline remnant, PARITY.md)
+    from karpenter_tpu.solver.workloads import mixed_pods
+
+    mpods = mixed_pods(400, gpu_fraction=0.0)
+    scens = [Scenario(pods=mpods[: 80 * (i + 1)]) for i in range(5)]
+    s4 = solver_for(SolverConfig(mesh=mesh), mesh_cache, mpods)
+    r4 = s4.solve_scenarios(scens)
+    assert r4 is not None, "scenario batch declined under the mesh"
+    assert s4.last_scenario_dispatches <= 2, s4.last_scenario_dispatches
+    assert s4.fallback_solves == 0
+
+    elapsed = time.perf_counter() - t_start
+    assert elapsed < BUDGET_S, (
+        f"mesh smoke took {elapsed:.1f}s, over the {BUDGET_S:.0f}s budget"
+    )
+    print(
+        f"mesh smoke OK in {elapsed:.1f}s (budget {BUDGET_S:.0f}s):"
+        f" {N_PODS} constrained pods on"
+        f" {dict(zip(mesh.axis_names, mesh.devices.shape))},"
+        f" parity pinned, fallback_solves=0, warm REUSE +"
+        f" delta_rows={s3.last_delta_rows} mesh-resident,"
+        f" scenario dispatches={s4.last_scenario_dispatches}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,14 @@ python hack/twin_smoke.py
 echo "== group-heavy smoke (sparse/segment axis + relax parity) =="
 python hack/group_smoke.py
 
+# fleet-sharding smoke (ISSUE 14): a fixed-seed constrained shape solved
+# through the driver on the virtual 8-device mesh must pin decisions
+# against single-device, stay fully kernel-routed, keep the warm path
+# (REUSE + row deltas) mesh-resident, and hold the scenario batch at
+# <= 2 dispatches — all inside a wall-time budget
+echo "== mesh smoke (virtual 8-device mesh, parity + warm path) =="
+python hack/mesh_smoke.py
+
 # slow lane: the full analysis over every default target, with the
 # stale-suppression audit (STALE001) on, behind a wall-time budget —
 # analyzer-speed regressions fail here before they bloat every local

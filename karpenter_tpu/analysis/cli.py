@@ -9,7 +9,8 @@ Default targets mirror the hazards each pass exists for:
             solver/service.py, kube/leader.py
 - schema:   api/schema.py vs api/crds/
 - parity:   ops/packing.py vs native/solve_core.cc (kernel-twin skeletons)
-- shapes:   karpenter_tpu/ops, karpenter_tpu/solver (axis/dtype walker)
+- shapes:   karpenter_tpu/ops, karpenter_tpu/solver, karpenter_tpu/parallel
+            (axis/dtype walker + sharding shard-divisibility)
 - retry:    karpenter_tpu/controllers, karpenter_tpu/solver, operator.py
             (swallowed exceptions, unbounded retry loops)
 - device:   karpenter_tpu/ops, solver/driver.py, faults/guard.py
@@ -94,7 +95,9 @@ PASS_TARGETS = {
         "karpenter_tpu/ops/packing.py",
         "karpenter_tpu/native/solve_core.cc",
     ],
-    "shapes": ["karpenter_tpu/ops", "karpenter_tpu/solver"],
+    "shapes": [
+        "karpenter_tpu/ops", "karpenter_tpu/solver", "karpenter_tpu/parallel",
+    ],
     # retry/except hygiene where the degradation ladder lives: the
     # reconcile roster, the solver path, and the operator's requeue loop
     "retry": [

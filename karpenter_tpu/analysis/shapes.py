@@ -28,6 +28,14 @@ Rules:
   device code (f32→f64 promotion is a TPU hazard; x64 is off everywhere)
 - SHP603: a literal dimension that bypasses the power-of-two bucket
   ladder (compile-cache buster; see PARITY.md §2.3 on bucketing)
+- SHP604: a ``NamedSharding``/``PartitionSpec`` partitions an array axis
+  whose literal dimension is not a power of two — after the encoder's
+  pow2 padding every shardable axis IS a pow2 >= the (pow2) mesh axis it
+  divides; a non-pow2 dim under a mesh-axis entry means the buffer skipped
+  ``parallel.mesh.pad_args_for_mesh`` and GSPMD will reject or silently
+  repad it (constructor sites: ``PartitionSpec(...)`` tuples tracked
+  through local names and ``NamedSharding(mesh, spec)``; sinks:
+  ``jax.device_put(x, s)`` / ``jax.lax.with_sharding_constraint(x, s)``)
 
 Host-side numpy is out of scope on purpose: only ``jax``/``jax.numpy``
 origins construct tracked values, so encode-time ``np.int64`` index math
@@ -48,6 +56,7 @@ RULES = {
     "SHP601": "axis-order mismatch in a broadcast join",
     "SHP602": "silent 64-bit dtype widening in device code",
     "SHP603": "literal dimension bypasses the power-of-two bucket ladder",
+    "SHP604": "sharded axis dimension is not shard-divisible after pow2 padding",
 }
 
 # axes: tuple of str (named axis) | int (literal dim) | None (unknown dim);
@@ -205,6 +214,9 @@ class _FunctionChecker(ast.NodeVisitor):
         self.findings = findings
         self.env = env
         self._flagged: set = set()
+        # names bound to PartitionSpec / NamedSharding values in this
+        # frame: name -> partition tuple (mesh-axis str | None per dim)
+        self._specs: Dict[str, Tuple[object, ...]] = {}
 
     # -- reporting --------------------------------------------------------
 
@@ -283,6 +295,67 @@ class _FunctionChecker(ast.NodeVisitor):
         if isinstance(node, ast.Subscript):
             return self._subscript_av(node)
         return UNKNOWN
+
+    def _spec_of(self, node: ast.AST) -> Optional[Tuple[object, ...]]:
+        """The partition tuple ``node`` denotes, or None when it is not a
+        statically-known sharding. Entries: a mesh-axis name (str) for a
+        partitioned dim, None for a replicated one. Starred/dynamic
+        constructor args poison to None — the pass never guesses."""
+        if isinstance(node, ast.Name):
+            return self._specs.get(node.id)
+        if not isinstance(node, ast.Call):
+            return None
+        cname = call_name(node, self.aliases)
+        if not cname.startswith("jax."):
+            return None
+        tail = cname.rpartition(".")[2]
+        if tail == "NamedSharding" and len(node.args) >= 2:
+            return self._spec_of(node.args[1])
+        if tail == "PartitionSpec":
+            out: List[object] = []
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    out.append(a.value)
+                elif isinstance(a, ast.Constant) and a.value is None:
+                    out.append(None)
+                elif isinstance(a, ast.Tuple) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in a.elts
+                ):
+                    # multi-axis entry ('data','model'): still partitioned
+                    out.append(
+                        "+".join(e.value for e in a.elts)  # type: ignore
+                    )
+                else:
+                    return None
+            return tuple(out)
+        return None
+
+    def _check_shard_divisible(self, node: ast.Call, tail: str) -> None:
+        """SHP604 at the array-meets-sharding sinks: every partitioned
+        spec entry must sit over a pow2 (or unknown/named) array dim."""
+        spec = self._spec_of(node.args[1])
+        arr = self.avof(node.args[0])
+        if not spec or arr.axes is None:
+            return
+        for i, entry in enumerate(spec):
+            if entry is None or i >= len(arr.axes):
+                continue
+            dim = arr.axes[i]
+            if (
+                isinstance(dim, int)
+                and not isinstance(dim, bool)
+                and dim > 1
+                and not _is_pow2(dim)
+            ):
+                self._flag(
+                    "SHP604", node,
+                    f"jax.{tail} partitions axis {i} (dim {dim}) over mesh"
+                    f" axis '{entry}', but {dim} is not a power of two —"
+                    " the buffer skipped the pow2 shard padding"
+                    " (parallel.mesh.pad_args_for_mesh) and cannot divide"
+                    " the mesh axis",
+                )
 
     def _shape_axes(self, node: ast.AST) -> Axes:
         if isinstance(node, (ast.Tuple, ast.List)):
@@ -443,6 +516,10 @@ class _FunctionChecker(ast.NodeVisitor):
         if tail == "broadcast_to" and len(node.args) >= 2:
             base = self.avof(node.args[0])
             return AV(self._shape_axes(node.args[1]), base.dtype)
+        if tail in ("device_put", "with_sharding_constraint") and node.args:
+            # sharding transfers preserve the abstract value; divisibility
+            # is checked at the sink (visit_Call -> SHP604)
+            return self.avof(node.args[0])
         return UNKNOWN
 
     def _method_av(self, node: ast.Call) -> AV:
@@ -585,6 +662,10 @@ class _FunctionChecker(ast.NodeVisitor):
     def _bind(self, target: ast.AST, av: AV) -> None:
         if isinstance(target, ast.Name):
             self.env.set(target.id, av)
+            # every rebind clears a tracked PartitionSpec (visit_Assign
+            # re-records it when the new value IS one): a tuple-unpacked
+            # reassignment must poison the spec, never keep guessing
+            self._specs.pop(target.id, None)
         elif isinstance(target, (ast.Tuple, ast.List)):
             for e in target.elts:
                 self._bind(e, UNKNOWN)
@@ -601,6 +682,7 @@ class _FunctionChecker(ast.NodeVisitor):
             for stmt in body:
                 for name in _assigned_names(stmt):
                     self.env.set(name, UNKNOWN)
+                    self._specs.pop(name, None)
 
     def visit_If(self, node: ast.If) -> None:
         self.visit(node.test)
@@ -633,8 +715,14 @@ class _FunctionChecker(ast.NodeVisitor):
     def visit_Assign(self, node: ast.Assign) -> None:
         self.generic_visit(node)
         av = self.avof(node.value)
+        spec = self._spec_of(node.value)
         for t in node.targets:
             self._bind(t, av)
+            if isinstance(t, ast.Name):
+                if spec is not None:
+                    self._specs[t.id] = spec
+                else:
+                    self._specs.pop(t.id, None)
 
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
         self.generic_visit(node)
@@ -738,6 +826,10 @@ class _FunctionChecker(ast.NodeVisitor):
                         )
             elif tail == "einsum":
                 self._einsum_av(node)  # flags letter conflicts
+            elif tail in (
+                "device_put", "with_sharding_constraint"
+            ) and len(node.args) >= 2:
+                self._check_shard_divisible(node, tail)
             elif tail == "segment_sum" and len(node.args) >= 2:
                 data = self.avof(node.args[0])
                 ids = self.avof(node.args[1])

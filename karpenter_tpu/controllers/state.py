@@ -639,8 +639,13 @@ class Cluster:
         csinode = self._client.try_get(CSINode, node_name)
         if csinode is not None:
             sn.volume_limits = dict(csinode.driver_limits)
-        for pod in self._client.list(Pod):
-            if pod.spec.node_name == node_name and pod.status.phase not in (
+        # indexed read: only this node's pods, not every pod in the store
+        # (the informer-rebuild wall at 100k-node scale was store-scan
+        # dominated — kube/store.py field index over spec.nodeName)
+        for pod in self._client.list(
+            Pod, field_selector={"spec.nodeName": node_name}
+        ):
+            if pod.status.phase not in (
                 "Succeeded",
                 "Failed",
             ):

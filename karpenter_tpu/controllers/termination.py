@@ -157,8 +157,11 @@ class TerminationController:
         non-critical daemon, critical daemon. Returns pods still present."""
         pods = [
             p
-            for p in self.client.list(Pod)
-            if p.spec.node_name == node.name and pod_utils.is_active(p)
+            # indexed read (kube/store.py): cost ∝ this node's pods
+            for p in self.client.list(
+                Pod, field_selector={"spec.nodeName": node.name}
+            )
+            if pod_utils.is_active(p)
         ]
         groups = [[], [], [], []]
         for p in pods:
@@ -175,9 +178,10 @@ class TerminationController:
                 break
         return [
             p
-            for p in self.client.list(Pod)
-            if p.spec.node_name == node.name and pod_utils.is_active(p)
-            and pod_utils.is_reschedulable(p)
+            for p in self.client.list(
+                Pod, field_selector={"spec.nodeName": node.name}
+            )
+            if pod_utils.is_active(p) and pod_utils.is_reschedulable(p)
         ]
 
     def _owned_by_daemonset(self, pod: Pod) -> bool:

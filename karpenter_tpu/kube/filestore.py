@@ -66,6 +66,7 @@ class FileClient(Client):
                 key = self._key(obj)
                 self._objects[key] = obj
                 self._by_uid[obj.metadata.uid] = key
+                self._index_insert(key, obj)
                 self._rv = max(self._rv, obj.metadata.resource_version or 0)
 
     def _sync(self, key) -> None:
@@ -151,8 +152,15 @@ class FileClient(Client):
     def get_by_uid(self, uid: str):
         return self._copy(super().get_by_uid(uid))
 
-    def list(self, kind, namespace=None, predicate=None):
-        out = [self._copy(o) for o in super().list(kind, namespace)]
+    def list(self, kind, namespace=None, predicate=None,
+             label_selector=None, field_selector=None):
+        out = [
+            self._copy(o)
+            for o in super().list(
+                kind, namespace,
+                label_selector=label_selector, field_selector=field_selector,
+            )
+        ]
         if predicate is not None:
             out = [o for o in out if predicate(o)]
         return out

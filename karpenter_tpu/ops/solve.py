@@ -134,6 +134,7 @@ def _solve_with(
     tile_feasibility: bool,
     wf_iters: int,
     sparse_groups: bool = False,
+    table_sharding=None,
     **packer_statics,
 ):
     # named scopes ride into the lowered HLO metadata so XProf/TensorBoard
@@ -152,6 +153,19 @@ def _solve_with(
             ct_kid=ct_kid,
             tile_feasibility=tile_feasibility,
             sparse_groups=sparse_groups,
+        )
+    if table_sharding is not None:
+        # the scan boundary of the r06 mesh layout (parallel/mesh.py): the
+        # feasibility tables computed sharded above replicate HERE, once
+        # per solve, so the sequential packing scan below never pays a
+        # per-step collective. Without the constraint GSPMD keeps the
+        # tables sharded (e.g. reduce-scattered over G out of the segment
+        # sums) and the while body all-gathers them EVERY step — the
+        # measured 12x r05 regression shape
+        # (tests/test_parallel.py::test_scan_body_has_no_collectives).
+        compat_pg, type_ok, n_fit, cap_ng = (
+            jax.lax.with_sharding_constraint(x, table_sharding)
+            for x in (compat_pg, type_ok, n_fit, cap_ng)
         )
     with jax.named_scope("ktpu.pack"):
         state, exist_fills, claim_fills, unplaced = packer(
@@ -196,6 +210,7 @@ def solve_core(
     tile_feasibility: bool = False,
     wf_iters: int = 32,
     sparse_groups: bool = False,
+    table_sharding=None,
 ):
     return _solve_with(
         pack, *args,
@@ -203,6 +218,7 @@ def solve_core(
         has_domains=has_domains, has_contrib=has_contrib,
         tile_feasibility=tile_feasibility, wf_iters=wf_iters,
         sparse_groups=sparse_groups,
+        table_sharding=table_sharding,
         nmax=nmax,
     )
 
@@ -218,6 +234,7 @@ def solve_core_classed(
     tile_feasibility: bool = False,
     wf_iters: int = 32,
     sparse_groups: bool = False,
+    table_sharding=None,
 ):
     """solve_core over the class-batched scan (ops/packing.py:pack_classed)
     — one scan step per feasibility class, members placed by an inner loop.
@@ -230,6 +247,7 @@ def solve_core_classed(
         has_domains=has_domains, has_contrib=has_contrib,
         tile_feasibility=tile_feasibility, wf_iters=wf_iters,
         sparse_groups=sparse_groups,
+        table_sharding=table_sharding,
         nmax=nmax, lmax=lmax,
     )
 
@@ -386,6 +404,117 @@ def _apply_rows_core(arr, idx, rows):
 _apply_rows_donated = jax.jit(_apply_rows_core, donate_argnums=(0,))
 _apply_rows_plain = jax.jit(_apply_rows_core)
 
+# shard_map twins of the row apply, keyed by (mesh, axis name): each shard
+# receives ONLY its own (local row, value, live mask) triples — see
+# _sharded_axis0 / delta_apply_rows below
+_APPLY_ROWS_SHARDED = {}
+
+
+def _sharded_axis0(arr):
+    """(mesh, axis_name, n_shards) when ``arr`` is a NamedSharding buffer
+    partitioned on its leading axis, else None. Replicated mesh buffers
+    (the r06 layout's group/node arrays) return None: every device holds
+    the full rows and the plain update is already shard-local."""
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None or not len(spec) or spec[0] is None:
+        return None
+    ax = spec[0]
+    if isinstance(ax, tuple):
+        if len(ax) != 1:
+            return None
+        ax = ax[0]
+    mesh = sharding.mesh
+    n = int(mesh.shape[ax])
+    if n <= 1:
+        return None
+    return mesh, ax, n
+
+
+def _decompose_rows_by_shard(idx, rows, block: int, n_shards: int):
+    """Global row index -> (shard, local row): per-shard local indices,
+    values, and live masks, padded to a shared pow2 bucket so nearby
+    delta sizes share one compiled program. A non-empty shard pads with
+    REPEATS of its own first (index, row) pair — idempotent duplicates,
+    exactly like the plain path's bucket padding — because padding with
+    masked writes of the CURRENT row-0 value would race a real update to
+    local row 0 under duplicate-index scatter semantics (the old value
+    could win and silently revert the delta). Only fully-empty shards
+    carry live=False slots (their row-0 rewrite of the current value is
+    conflict-free by construction)."""
+    import numpy as _np
+
+    per = [
+        _np.flatnonzero((idx >= j * block) & (idx < (j + 1) * block))
+        for j in range(n_shards)
+    ]
+    m = max((len(p) for p in per), default=0)
+    bucket = 1
+    while bucket < m:
+        bucket *= 2
+    lidx = _np.zeros((n_shards, bucket), _np.int32)
+    live = _np.zeros((n_shards, bucket), bool)
+    lrows = _np.zeros((n_shards, bucket) + rows.shape[1:], rows.dtype)
+    for j, p in enumerate(per):
+        k = len(p)
+        if not k:
+            continue
+        lidx[j, :k] = idx[p] - j * block
+        lrows[j, :k] = rows[p]
+        lidx[j, k:] = lidx[j, 0]
+        lrows[j, k:] = lrows[j, 0]
+        live[j, :] = True
+    return lidx, lrows, live
+
+
+def _apply_rows_shard_fn(mesh, ax, donate: bool):
+    """The jitted shard_map row-apply for (mesh, axis), cached; the
+    ``donate`` twin mirrors _apply_rows_donated/_apply_rows_plain so
+    KTPU_DONATE_DELTA keeps its HBM contract (no double residency of the
+    largest encodings) on mesh-resident buffers too."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    key = (mesh, ax, donate)
+    fn = _APPLY_ROWS_SHARDED.get(key)
+    if fn is None:
+
+        def body(a, li, lr, lv):
+            li0, lr0, lv0 = li[0], lr[0], lv[0]
+            cur = a[li0]
+            sel = lv0.reshape((-1,) + (1,) * (lr0.ndim - 1))
+            return a.at[li0].set(jnp.where(sel, lr0, cur))
+
+        mapped = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(ax), P(ax), P(ax), P(ax)),
+            out_specs=P(ax),
+            check_rep=False,
+        )
+        fn = _APPLY_ROWS_SHARDED[key] = (
+            jax.jit(mapped, donate_argnums=(0,)) if donate else jax.jit(mapped)
+        )
+    return fn
+
+
+def _apply_rows_shard_local(arr, idx, rows, mesh, ax, n_shards):
+    """Row update on an axis-0-sharded buffer with zero collectives: the
+    global row index decomposes host-side into (shard, local row), each
+    shard receives only its own update triples (padded to a shared pow2
+    bucket with idempotent repeats), and a shard_map body applies them
+    against the local block. The compiled program has no cross-device
+    ops — pinned by tests/test_parallel.py::test_delta_apply_shard_local.
+    KTPU_DONATE_DELTA=1 donates the input buffer exactly like the plain
+    path (same caveat: no queue token may still hold it)."""
+    import os
+
+    lidx, lrows, live = _decompose_rows_by_shard(
+        idx, rows, arr.shape[0] // n_shards, n_shards
+    )
+    donate = os.environ.get("KTPU_DONATE_DELTA") == "1"
+    return _apply_rows_shard_fn(mesh, ax, donate)(arr, lidx, lrows, live)
+
 
 def delta_apply_rows(arr, idx, rows):
     """In-place row update on a device-resident buffer: arr[idx] = rows.
@@ -396,13 +525,24 @@ def delta_apply_rows(arr, idx, rows):
     instead of forking the jit cache per row count. Under
     KTPU_DONATE_DELTA=1 ``arr`` must not be used after the call — the
     residency store replaces its reference with the return value, and no
-    queue token may still hold the old buffer (see the module note)."""
+    queue token may still hold the old buffer (see the module note).
+
+    Mesh-resident buffers stay shard-local either way: a replicated
+    buffer applies the full row set on every device (no cross-device
+    ops), and a buffer sharded on its leading axis routes through the
+    (shard, local row) decomposition so each shard patches only its own
+    block."""
     import os
     import numpy as _np
 
     n = len(idx)
     if not n:
         return arr
+    sharded = _sharded_axis0(arr)
+    if sharded is not None:
+        return _apply_rows_shard_local(
+            arr, _np.asarray(idx), _np.asarray(rows), *sharded
+        )
     bucket = 1
     while bucket < n:
         bucket *= 2
@@ -475,3 +615,29 @@ def dispatch_scenarios_packed(*args, **kw):
     ):
         out = solve_all_scenarios_packed(*args, **kw)
     return faults.mutate(faults.SOLVER_OUTPUT, out, kernel="scenarios")
+
+
+def dispatch_mesh_packed(fn, args, mesh):
+    """The GSPMD-sharded solve (parallel/mesh.py:sharded_solve_packed_fn)
+    behind the same fault/trace seams as its single-device twin — chaos
+    plans and XProf captures see one dispatch surface either way."""
+    faults.hit(faults.SOLVER_DISPATCH, kernel="mesh")
+    with obs.span("kernel.dispatch", kernel="mesh"), _device_annotation(
+        "mesh"
+    ):
+        with mesh:
+            out = fn(*args)
+    return faults.mutate(faults.SOLVER_OUTPUT, out, kernel="mesh")
+
+
+def dispatch_scenarios_mesh_packed(fn, args, mesh):
+    """The scenario-sharded dispatch (sharded_scenarios_fn): the whole
+    probe set of a consolidation search fans out over the mesh's leading
+    'scenario' axis in one submit."""
+    faults.hit(faults.SOLVER_SCENARIOS, kernel="scenarios-mesh")
+    with obs.span(
+        "kernel.dispatch", kernel="scenarios-mesh"
+    ), _device_annotation("scenarios-mesh"):
+        with mesh:
+            out = fn(*args)
+    return faults.mutate(faults.SOLVER_OUTPUT, out, kernel="scenarios-mesh")

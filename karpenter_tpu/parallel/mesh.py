@@ -1,32 +1,101 @@
-"""Multi-chip sharding for the batch solver.
+"""Multi-host sharding for the batch solver: a region's pending pods in
+one dispatch.
 
-The scaling axes of this domain map onto a 2-D device mesh:
+The scaling axes of this domain map onto a 3-D device mesh:
 
-- ``data`` — pod groups (G). The feasibility tables are embarrassingly
-  parallel over groups; this is the data-parallel axis.
+- ``scenario`` — consolidation's what-if axis (S). The PR-2 scenario batch
+  is embarrassingly parallel (each scenario is an independent solve over
+  one shared encoding), so it is the LEADING mesh dimension: a
+  consolidation search's whole probe set fans out across hosts and still
+  costs <= 2 dispatches.
+- ``data`` — the segment live-pair axis (L). The group axis itself CANNOT
+  shard: the packing scan is sequential over groups, and the measured
+  r05 layout (G over 'data') paid collectives on every scan step — 8x1
+  ran 12x slower than single-device (hack/mesh_scaling.py, PARITY.md
+  "multi-chip scaling measurements"). The r06 re-factorization moves the
+  group-parallel WORK onto the PR-13 segment index instead: the live
+  (group, key) pairs (gk_*) shard over 'data', the segment contractions
+  run shard-local, and one segment_sum all-reduce per feasibility stage
+  folds them back into replicated [G, ...] tables — family-parallel
+  batching of exactly the fragmented spread-singleton shapes the index
+  was built for. Group- and node-major arrays stay REPLICATED so the
+  scan's per-step state never crosses the mesh (pinned structurally by
+  tests/test_parallel.py::test_scan_body_has_no_collectives).
 - ``model`` — instance types (T). The (K x V1) mask reductions and the
-  offering contractions partition over types; this is the tensor-parallel
-  axis. The reference has no distributed backend at all (SURVEY.md §5) —
-  its analog of "scale" is pruning; here the dense tables shard across
-  chips and XLA inserts the all-gathers where the packing scan consumes
-  cross-type reductions over ICI.
+  offering contractions partition over types; per-step [*, T] scan state
+  updates are elementwise over T, so type sharding stays scan-local
+  (within 1.6x at 8 chips in the r05 measurement).
 
-The packing scan itself is sequential over groups (the simulation's
-inherent dependence, SURVEY.md §7.4.1); its per-step state is small, so it
-runs effectively replicated while the heavy feasibility math stays sharded.
-GSPMD handles the resharding at the boundary inside one jitted program.
+GSPMD inserts the ICI collectives at the stage boundaries inside one
+jitted program; the warm path (solver/residency.py) stages per-shard
+device buffers against these same specs, so REUSE/row-delta outcomes
+survive a mesh exactly as they do on one device.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+AXIS_SCENARIO = "scenario"
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+MESH_AXES = (AXIS_SCENARIO, AXIS_DATA, AXIS_MODEL)
 
-def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None):
-    """Build a ('data', 'model') mesh over the first n devices."""
+# Per-argument partition specs for EncodedSnapshot.solve_args /
+# SOLVE_ARG_NAMES, as tuples of mesh-axis names (None = replicated dim;
+# a missing tail is replicated). THE fixed r06 layout — the residency
+# store, the padding, the scenario axis, and the SHP6xx shard-divisibility
+# check all read this table.
+#
+#   replicated  g_* / p_* / n_* — scan-carried or scan-read state
+#   'model'     t_* / o_* / a_tzc / a_res[T@1] / p_titype_ok[T@1] / t_mvoh
+#   'data'      gk_g / gk_k / gk_w — the compacted live-pair axis
+ARG_SPECS: Dict[str, Tuple[Optional[str], ...]] = {
+    "g_count": (), "g_req": (), "g_def": (), "g_neg": (), "g_mask": (),
+    "g_hcap": (), "g_haff": (),
+    "g_dmode": (), "g_dkey": (), "g_dskew": (), "g_dmin0": (),
+    "g_dprior": (), "g_dreg": (), "g_drank": (),
+    "g_hstg": (), "g_hscap": (), "g_dtg": (),
+    "g_hself": (), "g_hcontrib": (), "g_dcontrib": (),
+    "p_def": (), "p_neg": (), "p_mask": (), "p_daemon": (),
+    "p_limit": (), "p_has_limit": (), "p_tol": (),
+    "p_titype_ok": (None, AXIS_MODEL),
+    "t_def": (AXIS_MODEL,), "t_mask": (AXIS_MODEL,),
+    "t_alloc": (AXIS_MODEL,), "t_cap": (AXIS_MODEL,),
+    "o_avail": (AXIS_MODEL,), "o_zone": (AXIS_MODEL,),
+    "o_ct": (AXIS_MODEL,),
+    "a_tzc": (AXIS_MODEL,), "res_cap0": (), "a_res": (None, AXIS_MODEL),
+    "n_def": (), "n_mask": (), "n_avail": (), "n_base": (), "n_tol": (),
+    "n_hcnt": (),
+    "n_dzone": (), "n_dct": (),
+    "nh_cnt0": (), "dd0": (), "dtg_key": (),
+    "well_known": (),
+    "p_mvmin": (), "t_mvoh": (AXIS_MODEL,),
+    "gk_g": (AXIS_DATA,), "gk_k": (AXIS_DATA,), "gk_w": (AXIS_DATA,),
+    "goff_idx": (),
+}
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    data: Optional[int] = None,
+    scenario: Optional[int] = None,
+):
+    """Build a ('scenario', 'data', 'model') mesh over the first n devices.
+
+    Defaults are measured, not assumed (hack/mesh_scaling.py, the r06
+    re-measurement): the plain solve puts every device on 'data' — the
+    segment live-pair axis is the only single-solve factorization whose
+    compiled scan body carries ZERO collectives (the sharded feasibility
+    stage folds into replicated tables once, at the scan boundary).
+    'model' (type sharding) is opt-in HBM headroom for catalogs too large
+    for one chip — its T-shaped scan state pays small per-step
+    collectives (within 1.6x at 8 chips, r05). 'scenario' is taken by the
+    scenario dispatch path itself via :func:`scenario_mesh`.
+    """
     import jax
 
     devices = jax.devices()
@@ -36,87 +105,116 @@ def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None):
             f"requested {n} devices but only {len(devices)} available"
         )
     devices = np.asarray(devices[:n])
+    scenario = scenario or 1
     if data is None:
-        # measured, not assumed (hack/mesh_scaling.py, 50k x 800 over the
-        # virtual mesh): the packing scan is sequential over groups, so
-        # sharding the G axis forces collectives on every scan step —
-        # 8x1 ran 12x slower than single-device while 1x8 stayed within
-        # 1.6x. Pure model (type) sharding is the only factorization that
-        # keeps the sequential scan local; the data axis exists for
-        # embarrassingly-parallel multi-solve workloads, opt-in via
-        # ``data``.
-        data = 1
-    model = n // data
-    return jax.sharding.Mesh(devices.reshape(data, model), ("data", "model"))
+        data = n // scenario
+    if n % (scenario * data):
+        raise ValueError(
+            f"{n} devices do not factor as scenario={scenario} x data={data}"
+            " x model"
+        )
+    model = n // (scenario * data)
+    return jax.sharding.Mesh(
+        devices.reshape(scenario, data, model), MESH_AXES
+    )
+
+
+# derived scenario-major meshes, keyed by (base mesh, scenario dim): the
+# SAME devices re-factorized so consolidation's embarrassingly-parallel
+# axis gets them (a Mesh is hashable; the jit caches key on it)
+_SCENARIO_MESHES: Dict[tuple, object] = {}
+
+
+def scenario_mesh(mesh, s: int):
+    """Re-factorize ``mesh``'s devices scenario-major for a batch of ``s``
+    scenarios: the scenario axis takes the largest device count that
+    divides ``s`` (S is pow2-bucketed with floor 8, so a pow2 device
+    count lands fully on the scenario axis); any remainder stays on
+    'data' (the collective-free segment axis). The base mesh's 'model'
+    dimension is PRESERVED, never folded into 'scenario': model sharding
+    exists as HBM headroom for catalogs too large for one chip, and
+    replicating the type tables across a scenario-major re-factorization
+    would OOM exactly the configs that opted into it."""
+    import jax
+
+    model = int(mesh.devices.shape[MESH_AXES.index(AXIS_MODEL)])
+    navail = int(np.prod(mesh.devices.shape)) // model
+    sdim = 1
+    while (
+        sdim * 2 <= navail
+        and s % (sdim * 2) == 0
+        and navail % (sdim * 2) == 0
+    ):
+        sdim *= 2
+    key = (mesh, sdim)
+    out = _SCENARIO_MESHES.get(key)
+    if out is None:
+        out = _SCENARIO_MESHES[key] = jax.sharding.Mesh(
+            mesh.devices.reshape(sdim, navail // sdim, model), MESH_AXES
+        )
+    return out
+
+
+def dense_mesh(mesh):
+    """Re-factorize for the DENSE (non-sparse-segment) kernel: 'data'
+    shards only the compacted live-pair index (gk_*), which the dense and
+    tiled feasibility paths never read — left as-is, a data-major mesh
+    would run the identical replicated program on every device (zero
+    speedup plus GSPMD overhead). Fold 'data' into 'model' so the [T, *]
+    type/offering tables shard instead (the r05-measured dense layout,
+    within 1.6x at 8 chips). The 'scenario' dimension is preserved."""
+    import jax
+
+    sdim, ddim, mdim = (int(x) for x in mesh.devices.shape)
+    if ddim == 1:
+        return mesh
+    key = (mesh, "dense")
+    out = _SCENARIO_MESHES.get(key)
+    if out is None:
+        out = _SCENARIO_MESHES[key] = jax.sharding.Mesh(
+            mesh.devices.reshape(sdim, 1, ddim * mdim), MESH_AXES
+        )
+    return out
+
+
+def _named(mesh, spec: Tuple[Optional[str], ...]):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(*spec))
+
+
+def arg_shardings(mesh) -> Dict[str, object]:
+    """NamedSharding per SOLVE_ARG_NAMES entry (the residency store's
+    staging specs — what `snapshot_shardings` serves positionally)."""
+    return {name: _named(mesh, spec) for name, spec in ARG_SPECS.items()}
 
 
 def snapshot_shardings(mesh) -> Tuple:
-    """in_shardings for solve_core's argument list (ops/solve.py), sharding
-    group-major arrays over 'data' and type-major arrays over 'model'."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    """in_shardings for solve_core's argument list, positionally aligned
+    with EncodedSnapshot.solve_args / SOLVE_ARG_NAMES via ARG_SPECS."""
+    from ..solver.encode import SOLVE_ARG_NAMES
 
-    S = lambda *spec: NamedSharding(mesh, P(*spec))
-    rep = S()
-    g = S("data")
-    t = S("model")
-    return (
-        g,  # g_count [G]
-        g,  # g_req [G, R]
-        g,  # g_def [G, K]
-        g,  # g_neg [G, K]
-        g,  # g_mask [G, K, V1]
-        g,  # g_hcap [G]
-        g,  # g_haff [G]
-        g,  # g_dmode [G]
-        g,  # g_dkey [G]
-        g,  # g_dskew [G]
-        g,  # g_dmin0 [G]
-        g,  # g_dprior [G, V1]
-        g,  # g_dreg [G, V1]
-        g,  # g_drank [G, V1]
-        g,  # g_hstg [G]
-        g,  # g_hscap [G]
-        g,  # g_dtg [G]
-        g,  # g_hself [G]
-        g,  # g_hcontrib [G, JH]
-        g,  # g_dcontrib [G, JD]
-        rep,  # p_def
-        rep,  # p_neg
-        rep,  # p_mask
-        rep,  # p_daemon
-        rep,  # p_limit
-        rep,  # p_has_limit
-        S(None, "data"),  # p_tol [P, G]
-        S(None, "model"),  # p_titype_ok [P, T]
-        t,  # t_def [T, K]
-        t,  # t_mask [T, K, V1]
-        t,  # t_alloc [T, R]
-        t,  # t_cap [T, R]
-        t,  # o_avail [T, O]
-        t,  # o_zone [T, O]
-        t,  # o_ct [T, O]
-        t,  # a_tzc [T, V1, V1]
-        rep,  # res_cap0 [NRES]
-        S(None, "model"),  # a_res [NRES, T, V1, V1]
-        rep,  # n_def [N, K]
-        rep,  # n_mask
-        rep,  # n_avail
-        rep,  # n_base
-        S(None, "data"),  # n_tol [N, G]
-        S(None, "data"),  # n_hcnt [N, G]
-        rep,  # n_dzone [N]
-        rep,  # n_dct [N]
-        rep,  # nh_cnt0 [N, JH]
-        rep,  # dd0 [JD, V1]
-        rep,  # dtg_key [JD]
-        rep,  # well_known [K]
-        rep,  # p_mvmin [P, MV]
-        S("model"),  # t_mvoh [T, MV, W]
-        rep,  # gk_g [L]
-        rep,  # gk_k [L]
-        rep,  # gk_w [L]
-        rep,  # goff_idx [LZ]
-    )
+    return tuple(_named(mesh, ARG_SPECS[n]) for n in SOLVE_ARG_NAMES)
+
+
+def scenario_shardings(mesh, batch_topo: bool = False) -> Tuple:
+    """in_shardings for the scenario-batched dispatch: the per-scenario
+    stacks (g_count, n_tol — plus the four topology prior arrays under
+    ``batch_topo``) gain a leading 'scenario' axis; every shared arg
+    keeps its snapshot spec. Replicated base specs make the stacked spec
+    exactly ('scenario',): each scenario shard owns its scenarios' rows
+    and the solve inside a shard is the single-device program."""
+    from ..ops.solve import SCENARIO_BATCHED_ARGS, SCENARIO_TOPO_BATCHED_ARGS
+    from ..solver.encode import SOLVE_ARG_NAMES
+
+    stacked = SCENARIO_TOPO_BATCHED_ARGS if batch_topo else SCENARIO_BATCHED_ARGS
+    out = []
+    for name in SOLVE_ARG_NAMES:
+        spec = ARG_SPECS[name]
+        if name in stacked:
+            spec = (AXIS_SCENARIO,) + spec
+        out.append(_named(mesh, spec))
+    return tuple(out)
 
 
 # jitted sharded programs keyed by (mesh, statics): a jax.jit wrapper owns
@@ -126,19 +224,26 @@ def snapshot_shardings(mesh) -> Tuple:
 _SHARDED_FNS = {}
 
 
+def _replicated_out(mesh):
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
 def sharded_solve_fn(
     mesh, nmax: int, zone_kid: int, ct_kid: int, has_domains: bool = True,
     has_contrib: bool = False, tile_feasibility: bool = False,
     wf_iters: int = 32, sparse_groups: bool = False,
 ):
-    """The full solve step jitted over the mesh. Group/type-sharded inputs,
-    replicated outputs; XLA/GSPMD inserts the ICI collectives."""
+    """The full solve step jitted over the mesh (unpacked outputs — the
+    measurement/test surface). Sharded inputs per ARG_SPECS, replicated
+    outputs; XLA/GSPMD inserts the ICI collectives."""
     import jax
 
     from ..ops.solve import solve_core
 
     key = (
-        mesh, nmax, zone_kid, ct_kid, has_domains, has_contrib,
+        "solve", mesh, nmax, zone_kid, ct_kid, has_domains, has_contrib,
         tile_feasibility, wf_iters, sparse_groups,
     )
     fn = _SHARDED_FNS.get(key)
@@ -154,93 +259,215 @@ def sharded_solve_fn(
                 tile_feasibility=tile_feasibility,
                 wf_iters=wf_iters,
                 sparse_groups=sparse_groups,
+                # replicate the feasibility tables at the scan boundary:
+                # GSPMD otherwise carries them sharded into the while loop
+                # and the scan pays an all-gather per step (the measured
+                # r05 regression; see ops/solve.py:_solve_with)
+                table_sharding=_replicated_out(mesh),
             ),
             in_shardings=snapshot_shardings(mesh),
-            out_shardings=jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec()
-            ),
+            out_shardings=_replicated_out(mesh),
         )
     return fn
 
 
+def sharded_solve_packed_fn(mesh, fills_dtype, **statics):
+    """The wire-packed solve over the mesh — the driver's production
+    dispatch: outputs match the single-device queued path bit-for-bit
+    (uint8-packed type masks, narrowed fills), so decode, the relax
+    merge contract, and the single blessed drain are shared."""
+    import jax
+
+    from ..ops.solve import solve_core_packed
+
+    key = ("packed", mesh, fills_dtype) + tuple(sorted(statics.items()))
+    fn = _SHARDED_FNS.get(key)
+    if fn is None:
+        fn = _SHARDED_FNS[key] = jax.jit(
+            partial(
+                solve_core_packed, fills_dtype=fills_dtype,
+                table_sharding=_replicated_out(mesh), **statics,
+            ),
+            in_shardings=snapshot_shardings(mesh),
+            out_shardings=_replicated_out(mesh),
+        )
+    return fn
+
+
+def sharded_scenarios_fn(mesh, fills_dtype, batch_topo: bool, **statics):
+    """The scenario-batched dispatch over the mesh: the vmapped solve with
+    the stacked args sharded on the leading 'scenario' axis. One program,
+    S scenarios, the whole region's what-if set in one dispatch."""
+    import jax
+
+    from ..ops.solve import solve_scenarios_core_packed
+
+    key = (
+        ("scenarios", mesh, fills_dtype, batch_topo)
+        + tuple(sorted(statics.items()))
+    )
+    fn = _SHARDED_FNS.get(key)
+    if fn is None:
+        fn = _SHARDED_FNS[key] = jax.jit(
+            partial(
+                solve_scenarios_core_packed,
+                fills_dtype=fills_dtype,
+                batch_topo=batch_topo,
+                # the scan-boundary replication constraint matters here
+                # too: whenever the scenario re-factorization retains
+                # data>1 (devices > scenario bucket), the sharded
+                # feasibility tables must fold BEFORE the packing scan
+                # or every step pays the r05 all-gather
+                table_sharding=_replicated_out(mesh),
+                **statics,
+            ),
+            in_shardings=scenario_shardings(mesh, batch_topo),
+            out_shardings=_replicated_out(mesh),
+        )
+    return fn
+
+
+_COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "all-to-all", "collective-permute",
+    "reduce-scatter", "collective-broadcast",
+    # async forms (TPU/GPU lowerings after collective scheduling): count
+    # the -start ops — each moves the data once; the paired -done ops are
+    # deliberately absent so an async pair isn't counted twice
+    "all-reduce-start", "all-gather-start", "all-to-all-start",
+    "collective-permute-start", "reduce-scatter-start",
+)
+
+
+def scan_collective_report(compiled_text: str) -> Dict[str, object]:
+    """Structural audit of a compiled sharded program: which collective
+    ops sit INSIDE while-loop bodies (the packing scan lowers to while;
+    a collective there is paid once PER SCAN STEP — the r05 regression
+    shape) versus outside them (stage-boundary collectives, paid once per
+    solve). Parses the post-partitioning HLO text from
+    ``fn.lower(*args).compile().as_text()``; dispatch STRUCTURE, not
+    wall-clock, so CPU CI can pin the layout without timing flake
+    (tests/test_parallel.py::test_scan_body_has_no_collectives)."""
+    comp_ops: Dict[str, list] = {}
+    current = None
+    for line in compiled_text.splitlines():
+        if line and not line[0].isspace() and line.rstrip().endswith("{"):
+            head = line.split("(", 1)[0].strip()
+            name = head.split()[-1].lstrip("%")
+            current = name
+            comp_ops[current] = []
+        elif current is not None and line.strip() and line.strip() != "}":
+            comp_ops[current].append(line)
+
+    import re
+
+    ref_re = re.compile(
+        r"(?:body|condition|to_apply|calls)=%([\w./-]+)"
+        r"|branch_computations=\{([^}]*)\}"
+    )
+
+    def refs_of(line: str) -> list:
+        out = []
+        for m in ref_re.finditer(line):
+            if m.group(1):
+                out.append(m.group(1))
+            elif m.group(2):
+                out.extend(
+                    t.strip().lstrip("%") for t in m.group(2).split(",")
+                )
+        return out
+
+    scan_roots = set()
+    total = 0
+    for name, lines in comp_ops.items():
+        for line in lines:
+            s = line.strip()
+            op = s.split("=", 1)[-1].strip() if "=" in s else s
+            if any(op.startswith(f"{c}(") or f" {c}(" in f" {op}"
+                   for c in _COLLECTIVE_OPS):
+                total += 1
+            if " while(" in s or s.startswith("while("):
+                scan_roots.update(refs_of(s))
+
+    # transitive closure over computations reachable from scan bodies
+    seen = set()
+    frontier = list(scan_roots)
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in comp_ops:
+            continue
+        seen.add(name)
+        for line in comp_ops[name]:
+            frontier.extend(refs_of(line))
+
+    offenders = []
+    in_scan = 0
+    in_scan_scalar = 0
+    for name in seen:
+        for line in comp_ops.get(name, ()):
+            s = line.strip()
+            op = s.split("=", 1)[-1].strip() if "=" in s else s
+            if any(op.startswith(f"{c}(") or f" {c}(" in f" {op}"
+                   for c in _COLLECTIVE_OPS):
+                in_scan += 1
+                # a SCALAR (pred[]/s32[]) collective is loop trip-count
+                # sync — the scenario axis's "are all shards done" vote,
+                # O(1) bytes — distinct from per-step DATA movement (the
+                # r05 regression gathered whole table rows every step)
+                shape = op.split(" ", 1)[0]
+                if shape.endswith("[]"):
+                    in_scan_scalar += 1
+                else:
+                    offenders.append((name, s[:160]))
+    return {
+        "computations": len(comp_ops),
+        "scan_computations": len(seen),
+        "collectives_total": total,
+        "collectives_in_scan": in_scan,
+        "collectives_in_scan_scalar": in_scan_scalar,
+        "collectives_in_scan_data": in_scan - in_scan_scalar,
+        "offenders": offenders,
+    }
+
+
+def pad_axis(arr, axis: int, mult: int, fill=0):
+    """Pad ``arr``'s ``axis`` up to a multiple of ``mult`` (shard-divisible
+    after the encoder's pow2 bucketing; a pow2 axis >= the shard count is
+    already divisible and returns unchanged)."""
+    size = arr.shape[axis]
+    target = ((size + mult - 1) // mult) * mult
+    if target == size:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - size)
+    return np.pad(arr, widths, constant_values=fill)
+
+
 def pad_args_for_mesh(args, mesh):
     """Pad solve_core's argument tuple (EncodedSnapshot.solve_args order) so
-    the sharded axes divide the mesh: the G axis (groups and the [*, G]
-    tables) to a multiple of 'data', the T axis (types, offerings,
-    availability) to a multiple of 'model'. Padded groups have count 0 (the
-    kernel's skip-step branch retires them); padded types stay infeasible
-    (p_titype_ok False, no offerings), so results are unchanged."""
-    data = mesh.devices.shape[0]
-    model = mesh.devices.shape[1]
-    (
-        g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
-        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
-        g_hstg, g_hscap, g_dtg,
-        g_hself, g_hcontrib, g_dcontrib,
-        p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
-        p_titype_ok,
-        t_def, t_mask, t_alloc, t_cap,
-        o_avail, o_zone, o_ct, a_tzc, res_cap0, a_res,
-        n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
-        nh_cnt0, dd0, dtg_key,
-        well_known,
-        p_mvmin, t_mvoh,
-        gk_g, gk_k, gk_w, goff_idx,
-    ) = args
+    every sharded axis divides its mesh dimension: the T axis (types,
+    offerings, availability) to a multiple of 'model', the segment
+    live-pair axis L to a multiple of 'data'. Group- and node-major arrays
+    are replicated in the r06 layout and need no padding. Padded types
+    stay infeasible (p_titype_ok False, no offerings); padded live pairs
+    carry weight 0 (a zero segment_sum contribution) and repeat group 0 in
+    gk_g, so results are unchanged."""
+    from ..solver.encode import SOLVE_ARG_NAMES
 
-    def pad_axis(arr, axis, mult, fill=0):
-        size = arr.shape[axis]
-        target = ((size + mult - 1) // mult) * mult
-        if target == size:
-            return arr
-        widths = [(0, 0)] * arr.ndim
-        widths[axis] = (0, target - size)
-        return np.pad(arr, widths, constant_values=fill)
+    model = mesh.devices.shape[MESH_AXES.index(AXIS_MODEL)]
+    data = mesh.devices.shape[MESH_AXES.index(AXIS_DATA)]
+    byname = dict(zip(SOLVE_ARG_NAMES, args))
 
-    g_count = pad_axis(g_count, 0, data)  # padded groups have count 0
-    g_req = pad_axis(g_req, 0, data)
-    g_def = pad_axis(g_def, 0, data)
-    g_neg = pad_axis(g_neg, 0, data)
-    g_mask = pad_axis(g_mask, 0, data, fill=1)
-    g_hcap = pad_axis(g_hcap, 0, data)  # count-0 pads never place anyway
-    g_haff = pad_axis(g_haff, 0, data)
-    for_g = lambda a: pad_axis(a, 0, data)
-    g_dmode, g_dkey, g_dskew, g_dmin0 = map(
-        for_g, (g_dmode, g_dkey, g_dskew, g_dmin0)
-    )
-    g_dprior, g_dreg, g_drank = map(for_g, (g_dprior, g_dreg, g_drank))
-    # slot ids pad with -1 (0 is a real slot); caps pad with the no-cap value
-    g_hstg = pad_axis(g_hstg, 0, data, fill=-1)
-    g_dtg = pad_axis(g_dtg, 0, data, fill=-1)
-    g_hscap = pad_axis(g_hscap, 0, data, fill=2**30)
-    g_hself = pad_axis(g_hself, 0, data, fill=1)
-    g_hcontrib = pad_axis(g_hcontrib, 0, data)
-    g_dcontrib = pad_axis(g_dcontrib, 0, data)
-    p_tol = pad_axis(p_tol, 1, data)
-    n_tol = pad_axis(n_tol, 1, data)
-    n_hcnt = pad_axis(n_hcnt, 1, data)
+    for name in ("t_def", "t_mask", "t_alloc", "t_cap",
+                 "o_avail", "o_zone", "o_ct", "a_tzc", "t_mvoh"):
+        byname[name] = pad_axis(byname[name], 0, model)
+    byname["a_res"] = pad_axis(byname["a_res"], 1, model)
+    # padded types stay infeasible for every template
+    byname["p_titype_ok"] = pad_axis(byname["p_titype_ok"], 1, model)
+    # the segment index names REAL group rows; L-axis padding appends
+    # weight-0 pairs on group 0 — segment_sum ignores them exactly
+    byname["gk_g"] = pad_axis(byname["gk_g"], 0, data)
+    byname["gk_k"] = pad_axis(byname["gk_k"], 0, data)
+    byname["gk_w"] = pad_axis(byname["gk_w"], 0, data)
+    return tuple(byname[name] for name in SOLVE_ARG_NAMES)
 
-    for_t = lambda a: pad_axis(a, 0, model)
-    t_def, t_mask, t_alloc, t_cap = map(for_t, (t_def, t_mask, t_alloc, t_cap))
-    o_avail, o_zone, o_ct, a_tzc = map(for_t, (o_avail, o_zone, o_ct, a_tzc))
-    a_res = pad_axis(a_res, 1, model)  # padded types have no reservations
-    p_titype_ok = pad_axis(p_titype_ok, 1, model)  # padded types stay infeasible
-    t_mvoh = pad_axis(t_mvoh, 0, model)  # padded types offer no mv values
 
-    return (
-        g_count, g_req, g_def, g_neg, g_mask, g_hcap, g_haff,
-        g_dmode, g_dkey, g_dskew, g_dmin0, g_dprior, g_dreg, g_drank,
-        g_hstg, g_hscap, g_dtg,
-        g_hself, g_hcontrib, g_dcontrib,
-        p_def, p_neg, p_mask, p_daemon, p_limit, p_has_limit, p_tol,
-        p_titype_ok,
-        t_def, t_mask, t_alloc, t_cap,
-        o_avail, o_zone, o_ct, a_tzc, res_cap0, a_res,
-        n_def, n_mask, n_avail, n_base, n_tol, n_hcnt, n_dzone, n_dct,
-        nh_cnt0, dd0, dtg_key,
-        well_known,
-        p_mvmin, t_mvoh,
-        # the segment index names REAL group rows; G-axis padding appends
-        # neutral rows with no live pairs, so the index is already valid
-        gk_g, gk_k, gk_w, goff_idx,
-    )

@@ -442,11 +442,13 @@ class ClusterTwin:
         and re-provision."""
         spot_nodes = sorted(
             n.name
-            for n in self.client.list(Node)
-            if n.metadata.labels.get(labels_mod.CAPACITY_TYPE_LABEL_KEY)
-            == "spot"
-            and n.provider_id
-            and n.metadata.deletion_timestamp is None
+            # indexed read (kube/store.py label index): only the spot
+            # nodes, not the whole 100k-node roster
+            for n in self.client.list(
+                Node,
+                label_selector={labels_mod.CAPACITY_TYPE_LABEL_KEY: "spot"},
+            )
+            if n.provider_id and n.metadata.deletion_timestamp is None
         )
         if not spot_nodes:
             return
@@ -500,8 +502,10 @@ class ClusterTwin:
             return
         pods = [
             p
-            for p in self.client.list(Pod)
-            if p.spec.node_name == name and pod_utils.is_active(p)
+            for p in self.client.list(
+                Pod, field_selector={"spec.nodeName": name}
+            )
+            if pod_utils.is_active(p)
         ]
         used = res.merge(*(p.spec.requests for p in pods)) if pods else {}
         new_alloc = dict(node.status.allocatable)

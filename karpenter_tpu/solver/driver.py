@@ -259,10 +259,13 @@ class SolverConfig:
     # "tpu": jitted JAX kernel (ops/solve.py). "native": the C++ host core
     # (native/solve_core.cc) — same contract, no accelerator needed.
     backend: str = "tpu"
-    # multi-chip: a jax.sharding.Mesh (parallel.mesh.make_mesh) to shard the
-    # solve over — groups data-parallel, instance types tensor-parallel —
-    # or "auto" to build one over all local devices when more than one is
-    # present. None = single device. Only meaningful with backend="tpu".
+    # multi-chip: a jax.sharding.Mesh (parallel.mesh.make_mesh) to shard
+    # the solve over — ('scenario', 'data', 'model'): consolidation
+    # scenarios lead, the segment live-pair axis data-shards, instance
+    # types tensor-shard; group/node state stays replicated so the
+    # sequential packing scan never pays per-step collectives — or "auto"
+    # to build one over all local devices when more than one is present.
+    # None = single device. Only meaningful with backend="tpu".
     mesh: Optional[object] = None
     # class-batched kernel (ops/packing.py:pack_classed): one scan step per
     # feasibility class instead of per group — the structural fix for
@@ -737,8 +740,6 @@ class TpuSolver:
             # batched rung is open: callers fall back to per-probe solves
             # (themselves ladder-gated) — rung 2 of the degradation ladder
             return None
-        if self._resolve_mesh() is not None:
-            return None
         if (
             self.oracle.reserved_capacity_enabled
             and self.oracle.reserved_offering_mode
@@ -814,6 +815,23 @@ class TpuSolver:
         snap_run = snap.padded(G, N)
         args = list(snap_run.solve_args(a_tzc, res_cap0, a_res))
 
+        # scenario-major mesh: consolidation's S scenarios are
+        # embarrassingly parallel, so the configured mesh's devices
+        # re-factorize onto the leading 'scenario' axis
+        # (parallel/mesh.py:scenario_mesh) and the whole probe set still
+        # costs <= 2 dispatches. Shared args pad/shard per ARG_SPECS; the
+        # per-scenario stacks shard on 'scenario' (S is pow2-bucketed, so
+        # the axis divides).
+        mesh = self._resolve_mesh()
+        smesh = None
+        if mesh is not None:
+            from ..parallel.mesh import pad_args_for_mesh, scenario_mesh
+
+            smesh = scenario_mesh(mesh, enc._next_pow2(
+                len(scenarios), floor=self._SCENARIO_FLOOR
+            ))
+            args = list(pad_args_for_mesh(tuple(args), smesh))
+
         # per-scenario arrays over the shared encoding
         uid_to_group: Dict[str, int] = {}
         for gi, g in enumerate(snap.groups):
@@ -865,13 +883,19 @@ class TpuSolver:
         if batch_topo:
             skip |= {"g_dprior", "n_hcnt", "nh_cnt0", "dd0"}
         store = self._shared_cache.lease_device_store(scenario=True)
+        scen_shardings = None
+        if smesh is not None:
+            from ..parallel.mesh import arg_shardings
+
+            scen_shardings = arg_shardings(smesh)
         with obs.span(
             "solve.transfer",
             reused=bool(delta.reused),
             delta_rows=int(delta.delta_rows),
         ):
             args = store.stage(
-                enc.SOLVE_ARG_NAMES, args, delta, skip=frozenset(skip)
+                enc.SOLVE_ARG_NAMES, args, delta, skip=frozenset(skip),
+                shardings=scen_shardings, mesh_key=smesh,
             )
             if obs.active() is not None:
                 jax.block_until_ready(
@@ -891,6 +915,7 @@ class TpuSolver:
 
         token = {
             "batch_topo": batch_topo,
+            "mesh": smesh,
             "scenarios": list(scenarios),
             "snap": snap,
             "snap_run": snap_run,
@@ -926,10 +951,26 @@ class TpuSolver:
         return token
 
     def _submit_scenario_dispatch(self, token):
-        from ..ops.solve import dispatch_scenarios_packed
+        from ..ops.solve import (
+            dispatch_scenarios_mesh_packed,
+            dispatch_scenarios_packed,
+        )
 
         args = token["args"]
         nmax = token["nmax"]
+        smesh = token.get("mesh")
+        if smesh is not None:
+            from ..parallel.mesh import sharded_scenarios_fn
+
+            fn = sharded_scenarios_fn(
+                smesh, token["fills_dtype"],
+                token.get("batch_topo", False),
+                nmax=nmax, **token["statics"],
+            )
+            return self._queue.submit(
+                "scenarios-mesh",
+                lambda: dispatch_scenarios_mesh_packed(fn, args, smesh),
+            )
         return self._queue.submit(
             "scenarios",
             lambda: dispatch_scenarios_packed(
@@ -1371,14 +1412,33 @@ class TpuSolver:
         # provisioning rounds) reuse one compiled program instead of paying
         # XLA compilation per solve. The native backend has no compilation
         # to amortize, so it runs the exact shapes.
+        mesh = (
+            self._resolve_mesh() if self.config.backend == "tpu" else None
+        )
+        if mesh is not None and not statics.get("sparse_groups"):
+            # the dense/tiled kernel never reads the 'data'-sharded
+            # segment index: re-factorize so the devices shard the type
+            # tables instead of replicating the whole program
+            from ..parallel.mesh import dense_mesh
+
+            mesh = dense_mesh(mesh)
         if self.config.backend == "tpu":
             snap_run = snap.padded(G, N)
             args = snap_run.solve_args(a_tzc, res_cap0, a_res)
+            if mesh is not None:
+                # shard-divisible axes BEFORE staging: the resident
+                # buffers must hold the mesh-padded shapes the sharded
+                # program was compiled for (T to 'model', the segment
+                # live-pair axis to 'data'; group/node arrays are
+                # replicated in the r06 layout and stay untouched)
+                from ..parallel.mesh import pad_args_for_mesh
+
+                args = pad_args_for_mesh(args, mesh)
         else:
             snap_run = snap
             args = snap.solve_args(a_tzc, res_cap0, a_res)
 
-        if self.config.backend == "tpu" and self._resolve_mesh() is None:
+        if self.config.backend == "tpu":
             # device residency: the encoded cluster tensors stay resident
             # on device between solves (buffers keyed by the encode delta's
             # class versions, solver/residency.py), so this stage transfers
@@ -1386,15 +1446,26 @@ class TpuSolver:
             # fast path. jit accepts committed device buffers identically
             # to host arrays, so decisions don't change
             # (tests/test_delta_encode.py pins byte-identical results).
+            # Under a mesh the same store stages each buffer against its
+            # ARG_SPECS NamedSharding — REUSE/row-delta outcomes survive
+            # partitioning (tests/test_parallel.py pins parity).
             import jax
 
+            shardings = None
+            if mesh is not None:
+                from ..parallel.mesh import arg_shardings
+
+                shardings = arg_shardings(mesh)
             store = self._shared_cache.lease_device_store()
             with obs.span(
                 "solve.transfer",
                 reused=bool(delta.reused),
                 delta_rows=int(delta.delta_rows),
             ):
-                args = store.stage(enc.SOLVE_ARG_NAMES, list(args), delta)
+                args = store.stage(
+                    enc.SOLVE_ARG_NAMES, list(args), delta,
+                    shardings=shardings, mesh_key=mesh,
+                )
                 if obs.active() is not None:
                     # traced runs block so transfer time stays attributable
                     # apart from kernel time; untraced runs let the async
@@ -1410,28 +1481,38 @@ class TpuSolver:
             def call(nmax):
                 return native.solve_core_native(*args, nmax=nmax, **statics)
 
-        elif self.config.backend == "tpu" and self._resolve_mesh() is not None:
+        elif self.config.backend == "tpu" and mesh is not None:
             # multi-chip: shard the whole solve over the configured mesh
-            # (SURVEY §5 — pjit/shard_map across TPU cores behind the
-            # Solver seam); inputs pad to divide the mesh axes, outputs
-            # come back replicated and decode identically
-            import jax
+            # (SURVEY §5 — pjit across cores behind the Solver seam).
+            # Inputs were mesh-padded and staged sharded above; the
+            # wire-packed outputs come back replicated, ride the two-slot
+            # queue, and cross at the single blessed drain exactly like
+            # the single-device path — the former per-mesh-solve readback
+            # site is gone (PARITY.md device-residency contract).
+            # The relaxation pre-solver stays off under a mesh (it is a
+            # host-side bulk placement around the plain jit path; its
+            # separability planning is mesh-agnostic follow-up work).
+            import jax.numpy as jnp
 
-            from ..parallel.mesh import pad_args_for_mesh, sharded_solve_fn
+            from ..ops.solve import dispatch_mesh_packed
+            from ..parallel.mesh import sharded_solve_packed_fn
 
-            mesh = self._resolve_mesh()
-            margs = pad_args_for_mesh(args, mesh)
+            fills_dtype = (
+                jnp.int16 if self._fill_bound(snap, fit) < 2**15 else jnp.int32
+            )
 
             def call(nmax):
-                fn = sharded_solve_fn(mesh, nmax=nmax, **statics)
-                with mesh:
-                    out = fn(*margs)
-                (c_pool, c_tmask, n_open, overflow,
+                fn = sharded_solve_packed_fn(
+                    mesh, fills_dtype, nmax=nmax, **statics
+                )
+                slot = self._queue.submit(
+                    "mesh", lambda: dispatch_mesh_packed(fn, args, mesh)
+                )
+                (c_pool, packed, n_open, overflow,
                  exist_fills, claim_fills, unplaced, c_dzone, c_dct,
-                 # analysis: sanctioned[DTX906] blessed decode boundary: one readback per sharded solve (PARITY.md)
-                 c_resv) = [np.asarray(x) for x in jax.device_get(out)]
+                 c_resv) = self._drain_host(self._queue.drain(slot))
                 return (
-                    c_pool.astype(np.int32), c_tmask, n_open, overflow,
+                    c_pool.astype(np.int32), packed, n_open, overflow,
                     exist_fills.astype(np.int32),
                     claim_fills.astype(np.int32), unplaced,
                     c_dzone.astype(np.int32), c_dct.astype(np.int32),
@@ -1883,9 +1964,9 @@ class TpuSolver:
 
     def _resolve_mesh(self):
         """The mesh to shard the solve over, or None for single-device.
-        "auto" builds a ('data', 'model') mesh over all local devices once
-        more than one is present (single-device auto stays on the plain
-        jit path — no GSPMD overhead for nothing)."""
+        "auto" builds a ('scenario', 'data', 'model') mesh over all local
+        devices once more than one is present (single-device auto stays on
+        the plain jit path — no GSPMD overhead for nothing)."""
         m = self.config.mesh
         if m is None:
             return None

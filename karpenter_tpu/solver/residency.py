@@ -93,6 +93,11 @@ class DeviceResidentArgs:
         # valid — donation (KTPU_DONATE_DELTA=1) is only safe when no
         # token can outlive a stage.
         self._lock = threading.Lock()
+        # mesh signature of the resident buffers: staging against a
+        # different mesh (or switching mesh <-> single-device) sheds every
+        # buffer — a buffer committed to one device set cannot serve a
+        # program compiled over another
+        self._mesh_key: object = None
         self.last_incremental = False
         self.last_delta_rows = 0
         self.last_full_puts = 0
@@ -130,6 +135,8 @@ class DeviceResidentArgs:
         host_args: Sequence,
         delta,
         skip: frozenset = frozenset(),
+        shardings: Optional[Dict[str, object]] = None,
+        mesh_key: object = None,
     ) -> List:
         """Device-resident argument list aligned with ``names``.
 
@@ -138,18 +145,31 @@ class DeviceResidentArgs:
         caller). Emits one ``solve.delta_apply`` span covering the
         row-level updates (delta_rows/reused attrs ride it for the trace
         smoke and the churn bench).
+
+        ``shardings``/``mesh_key`` make the warm path mesh-resident: full
+        puts commit each buffer against its NamedSharding
+        (parallel/mesh.py:arg_shardings — the mesh-padded host args the
+        driver passes already divide the sharded axes), reuse and row
+        deltas then behave exactly as on one device (delta_apply_rows is
+        sharding-aware). A changed ``mesh_key`` sheds every buffer first.
         """
         import jax
 
         from ..ops.solve import delta_apply_rows
 
         with self._lock:
+            if mesh_key != self._mesh_key:
+                self._dev_buffers.clear()
+                self._meta.clear()
+                self._mesh_key = mesh_key
             return self._stage_locked(
-                names, host_args, delta, skip, jax, delta_apply_rows
+                names, host_args, delta, skip, jax, delta_apply_rows,
+                shardings or {},
             )
 
     def _stage_locked(
-        self, names, host_args, delta, skip, jax, delta_apply_rows
+        self, names, host_args, delta, skip, jax, delta_apply_rows,
+        shardings,
     ) -> List:
         out: List = []
         applies: List[Tuple[str, int, np.ndarray, np.ndarray]] = []
@@ -189,7 +209,12 @@ class DeviceResidentArgs:
                 applies.append((name, version, host, rows))
                 out.append(None)  # patched below, order preserved
                 continue
-            buf = jax.device_put(host)
+            sharding = shardings.get(name)
+            buf = (
+                jax.device_put(host, sharding)
+                if sharding is not None
+                else jax.device_put(host)
+            )
             self._dev_buffers[name] = buf
             self._meta[name] = sig
             out.append(buf)

@@ -74,7 +74,15 @@ class Vocab:
         every key)."""
         for r in reqs:
             self.key_id(r.key)
-            for v in r.values:
+            # CONTENT-ordered interning: Requirement.values is a set, and
+            # bare set iteration assigns value ids in PYTHONHASHSEED order
+            # — two processes would intern the same zones/hostnames at
+            # different ids, and every argmin/argmax tie-break over value
+            # ids (domain picks, hostname slots) would diverge, moving
+            # packing cost ~0.2% across processes (PARITY.md round 13).
+            # Sorting pins the id order to the values themselves
+            # (tests/test_solver_parity.py two-process determinism pin).
+            for v in sorted(r.values):
                 self.value_id(r.key, v)
 
     def observe_keys(self, reqs: Requirements) -> None:

@@ -64,3 +64,20 @@ def segment_result_misjoined(l, m, g):
     ids = jnp.zeros((l,), jnp.int32)
     seg = jax.ops.segment_sum(data, ids, num_segments=g)  # [g, m]
     return seg + jnp.zeros((l, m), jnp.float32)  # SHP601: g joined with l
+
+
+def sharded_unpadded_axis(mesh, m):
+    # 48 rows never went through the pow2 shard padding; broadcast_to so
+    # the constructor-literal rule (SHP603) stays out of this function
+    row = jnp.zeros((m,), jnp.float32)
+    x = jnp.broadcast_to(row[None, :], (48, m))
+    s = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
+    return jax.device_put(x, s)  # SHP604: 'data' shards a 48-dim
+
+
+def sharded_unpadded_via_names(mesh, m):
+    spec = jax.sharding.PartitionSpec(None, "model")
+    sh = jax.sharding.NamedSharding(mesh, spec)
+    x = jnp.broadcast_to(jnp.zeros((m,), jnp.float32)[:, None], (m, 24))
+    # SHP604: the name-resolved spec partitions the literal 24 column axis
+    return jax.lax.with_sharding_constraint(x, sh)

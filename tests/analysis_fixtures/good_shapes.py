@@ -67,3 +67,34 @@ def bucketed_broadcast(g, m):
     row = jnp.zeros((m,), jnp.float32)
     wide = jnp.broadcast_to(row[None, :], (g, m))
     return wide + jnp.zeros((g, m), jnp.float32)
+
+
+def sharded_padded_axis(mesh, m):
+    """The r06 staging shape: a pow2 leading dim under a mesh-axis entry
+    divides any pow2 mesh axis — silent."""
+    row = jnp.zeros((m,), jnp.float32)
+    x = jnp.broadcast_to(row[None, :], (64, m))
+    spec = jax.sharding.PartitionSpec("data", None)
+    sh = jax.sharding.NamedSharding(mesh, spec)
+    y = jax.lax.with_sharding_constraint(x, sh)
+    return y + jnp.zeros((64, m), jnp.float32)
+
+
+def replicated_any_size(mesh, m):
+    """A replicated spec places the whole buffer on every device: no
+    divisibility constraint, any dim is fine."""
+    row = jnp.zeros((m,), jnp.float32)
+    x = jnp.broadcast_to(row[None, :], (48, m))
+    return jax.device_put(x, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()
+    ))
+
+
+def sharded_named_dim(mesh, n, m):
+    """A named (non-literal) dim under a mesh axis: unknowable statically,
+    the pass must not guess."""
+    row = jnp.zeros((m,), jnp.float32)
+    x = jnp.broadcast_to(row[None, :], (n, m))
+    return jax.device_put(x, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("data")
+    ))

@@ -415,7 +415,9 @@ class TestParityPass:
 class TestShapesPass:
     def test_bad_fixture_flags_every_rule(self):
         findings, _ = shapes.check_paths([fixture("bad_shapes.py")])
-        assert rules_of(findings) == {"SHP601", "SHP602", "SHP603"}
+        assert rules_of(findings) == {
+            "SHP601", "SHP602", "SHP603", "SHP604",
+        }
         messages = "\n".join(f.message for f in findings)
         # the six seeded SHP601 shapes: operator join, where join,
         # einsum, transposed matmul contraction, misaligned segment ids,
@@ -430,6 +432,10 @@ class TestShapesPass:
         # the non-bucketed constructor dim and the reshape literal
         assert len([f for f in findings if f.rule == "SHP603"]) == 2
         assert "1000" in messages
+        # the two seeded SHP604 shapes: an inline NamedSharding at a
+        # device_put and a name-resolved spec at with_sharding_constraint
+        assert len([f for f in findings if f.rule == "SHP604"]) == 2
+        assert "pow2 shard padding" in messages or "power of two" in messages
 
     def test_clean_fixture_silent(self):
         findings, _ = shapes.check_paths([fixture("good_shapes.py")])
@@ -440,6 +446,7 @@ class TestShapesPass:
             [
                 os.path.join(REPO, "karpenter_tpu", "ops"),
                 os.path.join(REPO, "karpenter_tpu", "solver"),
+                os.path.join(REPO, "karpenter_tpu", "parallel"),
             ]
         )
         assert filter_suppressed(findings, sources) == []
@@ -455,6 +462,25 @@ class TestShapesPass:
             "    return a + b\n"
         )
         p = tmp_path / "unknown.py"
+        p.write_text(src)
+        findings, _ = shapes.check_paths([str(p)])
+        assert findings == []
+
+    def test_spec_rebind_through_tuple_poisons(self, tmp_path):
+        # a tuple-unpacking reassignment of a name that held a
+        # PartitionSpec must clear the tracked spec — checking a sharding
+        # the name no longer holds would false-positive SHP604
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def f(mesh, m, build):\n"
+            "    spec = jax.sharding.PartitionSpec('data')\n"
+            "    spec, other = build()\n"
+            "    row = jnp.zeros((m,), jnp.float32)\n"
+            "    x = jnp.broadcast_to(row[None, :], (48, m))\n"
+            "    return jax.device_put(x, spec)\n"
+        )
+        p = tmp_path / "rebind.py"
         p.write_text(src)
         findings, _ = shapes.check_paths([str(p)])
         assert findings == []
@@ -770,19 +796,20 @@ class TestDevicePass:
         assert kept == [], [f.render() for f in kept]
         assert len(sanctioned) == 1
 
-    def test_real_solve_path_clean_with_two_blessed_readbacks(self):
+    def test_real_solve_path_clean_with_single_blessed_readback(self):
         """The device-residency contract (PARITY.md): the ONLY
-        device->host crossings in the solve path are driver.py's two
-        sanctioned readbacks — the dispatch queue's single drain point
-        (plain, classed, AND scenario kernels all cross there) plus the
-        sharded-mesh path. The delta-encode PR collapsed the former
-        three per-path readbacks into the drain, exactly the end state
-        the round-7 contract table predicted; any further change goes
-        through the documented contract-table workflow."""
+        device->host crossing in the solve path is driver.py's single
+        sanctioned readback — the dispatch queue's drain point (plain,
+        classed, scenario, AND sharded-mesh kernels all cross there).
+        The delta-encode PR collapsed the former three per-path readbacks
+        into the drain; the fleet-sharding PR routed the mesh path's own
+        readback through the same queue, retiring its sanctioned site.
+        Any further change goes through the documented contract-table
+        workflow."""
         findings, sources = device.check_paths(self.REAL_TARGETS)
         kept, suppressed, sanctioned = partition_findings(findings, sources)
         assert kept == [], [f.render() for f in kept]
-        assert len(sanctioned) == 2
+        assert len(sanctioned) == 1
         assert all(f.rule == "DTX906" for f in sanctioned)
         assert all(f.path.endswith("driver.py") for f in sanctioned)
 
@@ -1245,11 +1272,11 @@ class TestCli:
         assert doc["version"] == "2.1.0"
         results = doc["runs"][0]["results"]
         assert {r["ruleId"] for r in results} == {
-            "SHP601", "SHP602", "SHP603"
+            "SHP601", "SHP602", "SHP603", "SHP604"
         }
         assert all(r["level"] == "error" for r in results)
         rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
-        assert rule_ids == {"SHP601", "SHP602", "SHP603"}
+        assert rule_ids == {"SHP601", "SHP602", "SHP603", "SHP604"}
         loc = results[0]["locations"][0]["physicalLocation"]
         assert loc["artifactLocation"]["uri"].endswith("bad_shapes.py")
         assert loc["region"]["startLine"] >= 1

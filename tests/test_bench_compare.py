@@ -237,6 +237,70 @@ class TestCompareGrids:
         assert row["fallback_solves"] == 0
         assert row["repeat_reused"] is True
 
+    def test_mesh_rows_enforced(self, tmp_path):
+        # ISSUE 14's weak-scaling mesh rows: keyed by device count too —
+        # the 8-chip row regressing must trip the gate even when a
+        # same-shape single-chip row is healthy
+        def mesh_entry(devices, pods, best_ms):
+            return {
+                "config": "mesh-weak", "pods": pods, "types": 2000,
+                "devices": devices, "mesh": f"1x{devices}x1",
+                "best_ms": best_ms,
+                "pods_per_sec": pods / best_ms * 1000,
+                "pods_per_chip_per_sec": pods / best_ms * 1000 / devices,
+                "fallback_solves": 0, "repeat_reused": True,
+            }
+
+        old = _write(tmp_path, "old.json", _grid("cpu", [
+            mesh_entry(1, 62500, 4000.0),
+            mesh_entry(8, 500000, 5000.0),
+        ]))
+        new_ok = _write(tmp_path, "new_ok.json", _grid("cpu", [
+            mesh_entry(1, 62500, 4100.0),
+            mesh_entry(8, 500000, 5200.0),
+        ]))
+        assert compare_grids(old, new_ok) == 0
+        new_bad = _write(tmp_path, "new_bad.json", _grid("cpu", [
+            mesh_entry(1, 62500, 4000.0),
+            mesh_entry(8, 500000, 9000.0),
+        ]))
+        assert compare_grids(old, new_bad) == 1
+
+    def test_mesh_rows_keyed_by_devices(self, tmp_path):
+        # two rows identical but for the device count must compare
+        # independently (the _entry_key devices dimension)
+        rows_old = [
+            {"config": "mesh-weak", "pods": 1000, "types": 10,
+             "devices": 1, "best_ms": 400.0, "pods_per_sec": 2500.0},
+            {"config": "mesh-weak", "pods": 1000, "types": 10,
+             "devices": 8, "best_ms": 800.0, "pods_per_sec": 1250.0},
+        ]
+        rows_bad = [dict(rows_old[0]), dict(rows_old[1], best_ms=2000.0)]
+        old = _write(tmp_path, "old.json", _grid("cpu", rows_old))
+        bad = _write(tmp_path, "bad.json", _grid("cpu", rows_bad))
+        assert compare_grids(old, bad) == 1
+
+    def test_mesh_row_live(self):
+        """The weak-scaling row, live at a small shape on the virtual
+        mesh: decisions parity-pinned against single-device, zero
+        fallbacks, warm REUSE mesh-resident."""
+        import jax
+
+        import bench
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        rows = bench.run_mesh(
+            n_pods=800, n_types=20, device_counts=(1, 8)
+        )
+        assert [r["devices"] for r in rows] == [1, 8]
+        top = rows[-1]
+        assert top["parity"] is True
+        assert top["mesh"] == "1x8x1"
+        assert all(r["fallback_solves"] == 0 for r in rows)
+        assert all(r["repeat_reused"] for r in rows)
+        assert all(r["pods_per_chip_per_sec"] > 0 for r in rows)
+
     def test_cli_entrypoint(self, tmp_path):
         old = _write(tmp_path, "old.json", _grid("tpu", [
             _entry("mixed", 5000, 400, 100.0),

@@ -784,8 +784,15 @@ class TestClusterStateGauges:
             metadata=ObjectMeta(name="ghost"), spec=NodeClaimSpec()
         )
         ghost.status.provider_id = "ghost://1"
-        # bypass the watch so state stays behind the store
-        client._objects[("NodeClaim", "default", "ghost")] = ghost
+        # bypass the watch so state stays behind the store (a real create
+        # with watchers silenced — poking client._objects directly would
+        # also bypass the store's own kind/label indexes, which list()
+        # reads)
+        saved, client._watchers = client._watchers, []
+        try:
+            client.create(ghost)
+        finally:
+            client._watchers = saved
         assert not cluster.synced()
         assert CLUSTER_STATE_SYNCED.value() == 0.0
         clock.step(7)

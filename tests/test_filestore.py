@@ -86,3 +86,266 @@ class TestCopySemantics:
         assert seen and seen[-1].object is not pod
         seen[-1].object.metadata.name = "corrupted"
         assert c.try_get("Pod", "w-1") is not None
+
+
+class TestIndexedReads:
+    """kube/store.py inverted label/field indexes (ISSUE 14 satellite):
+    selector reads must return exactly what a full scan filters to, in the
+    same (insertion) order, across every CRUD shape — on both backends.
+    The 100k-node twin's informer rebuilds read these indexes; a stale
+    entry here is a silently-wrong roster, so the pin is an oracle diff
+    under seeded churn, not a handful of point cases."""
+
+    def _backend(self, which, tmp_path):
+        from karpenter_tpu.kube import Client, FileClient, TestClock
+
+        if which == "memory":
+            return Client(TestClock())
+        return FileClient(TestClock(), root=str(tmp_path / "idx"))
+
+    @staticmethod
+    def _ids(objs):
+        return [(o.metadata.name, o.metadata.resource_version) for o in objs]
+
+    def _oracle(self, client, kind, label_selector=None, field_selector=None):
+        """The full-scan definition of a selector read."""
+        from karpenter_tpu.kube.store import _FIELD_EXTRACTORS
+
+        kind_name = kind if isinstance(kind, str) else kind.__name__
+        out = []
+        for o in client.list(kind):
+            labels = o.metadata.labels or {}
+            if any(
+                labels.get(k) != v
+                for k, v in (label_selector or {}).items()
+            ):
+                continue
+            if any(
+                (_FIELD_EXTRACTORS[kind_name][f](o) or None) != v
+                for f, v in (field_selector or {}).items()
+            ):
+                continue
+            out.append(o)
+        return out
+
+    def _assert_matches_oracle(self, client):
+        from karpenter_tpu.api.objects import Pod
+
+        probes = [
+            ({"app": "a"}, None),
+            ({"app": "b"}, None),
+            ({"app": "a", "tier": "web"}, None),
+            (None, {"spec.nodeName": "n-0"}),
+            (None, {"spec.nodeName": "n-1"}),
+            ({"app": "b"}, {"spec.nodeName": "n-0"}),
+        ]
+        for label_sel, field_sel in probes:
+            got = client.list(
+                Pod, label_selector=label_sel, field_selector=field_sel
+            )
+            want = self._oracle(
+                client, Pod, label_selector=label_sel, field_selector=field_sel
+            )
+            assert self._ids(got) == self._ids(want), (label_sel, field_sel)
+
+    @pytest.mark.parametrize("which", ["memory", "file"])
+    def test_seeded_churn_matches_full_scan(self, which, tmp_path):
+        """Creates, label flips (copy-mutate AND stored-reference-mutate,
+        the twin's shape), binds/unbinds, plain deletes, and two-phase
+        finalizer deletes: after every step the indexed read equals the
+        full-scan oracle."""
+        import random
+
+        from karpenter_tpu.api.objects import Pod
+
+        rng = random.Random(4242)
+        client = self._backend(which, tmp_path)
+        live = []
+        for step in range(120):
+            op = rng.randrange(6)
+            if op in (0, 1) or not live:  # create
+                name = f"p-{step}"
+                pod = make_pod(
+                    name=name,
+                    labels={
+                        "app": rng.choice("ab"),
+                        "tier": rng.choice(("web", "db")),
+                    },
+                    node_name=rng.choice(("", "n-0", "n-1")),
+                )
+                if rng.randrange(3) == 0:
+                    pod.metadata.finalizers.append("ktpu/test")
+                client.create(pod)
+                live.append(name)
+            elif op == 2:  # label flip via the stored handle (twin shape)
+                pod = client.try_get(Pod, rng.choice(live))
+                if pod is not None:
+                    pod.metadata.labels["app"] = rng.choice("ab")
+                    client.update(pod)
+            elif op == 3:  # bind/unbind
+                pod = client.try_get(Pod, rng.choice(live))
+                if pod is not None:
+                    pod.spec.node_name = rng.choice(("", "n-0", "n-1"))
+                    client.update(pod)
+            elif op == 4:  # delete (two-phase when finalized)
+                name = live[rng.randrange(len(live))]
+                pod = client.try_get(Pod, name)
+                if pod is not None:
+                    client.delete(pod)
+                    if pod.metadata.finalizers and rng.randrange(2):
+                        client.remove_finalizer(pod, "ktpu/test")
+                if client.try_get(Pod, name) is None:
+                    live.remove(name)
+            else:  # label ADD (new key) then flip back off via update
+                pod = client.try_get(Pod, rng.choice(live))
+                if pod is not None:
+                    if "extra" in (pod.metadata.labels or {}):
+                        del pod.metadata.labels["extra"]
+                    else:
+                        pod.metadata.labels["extra"] = "x"
+                    client.update(pod)
+            if step % 10 == 9:
+                self._assert_matches_oracle(client)
+        self._assert_matches_oracle(client)
+
+    def test_unknown_field_selector_raises(self, tmp_path):
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.kube import Client, TestClock
+
+        c = Client(TestClock())
+        with pytest.raises(ValueError, match="not indexed"):
+            c.list(Pod, field_selector={"status.phase": "Running"})
+
+    def test_export_import_rebuilds_index(self):
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.kube import Client, TestClock
+
+        c1 = Client(TestClock())
+        for i in range(8):
+            c1.create(
+                make_pod(
+                    name=f"p{i}",
+                    labels={"app": "a" if i % 2 else "b"},
+                    node_name="n-0" if i < 4 else "",
+                )
+            )
+        c2 = Client(TestClock())
+        c2.import_objects(c1.export_objects())
+        self._assert_matches_oracle(c2)
+        got = c2.list(
+            Pod, label_selector={"app": "a"},
+            field_selector={"spec.nodeName": "n-0"},
+        )
+        assert [o.metadata.name for o in got] == ["p1", "p3"]
+
+    def test_filestore_restart_rebuilds_index(self, tmp_path):
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.kube import FileClient, TestClock
+
+        c1 = self._backend("file", tmp_path)
+        for i in range(6):
+            c1.create(
+                make_pod(
+                    name=f"p{i}",
+                    labels={"app": "a" if i % 2 else "b"},
+                    node_name="n-1",
+                )
+            )
+        c2 = FileClient(TestClock(), root=str(tmp_path / "idx"))
+        self._assert_matches_oracle(c2)
+        assert len(c2.list(Pod, field_selector={"spec.nodeName": "n-1"})) == 6
+
+    def test_selector_read_cost_is_match_proportional(self):
+        """The point of the index: a narrow selector over a big store must
+        not touch every object. Pinned structurally — the object map is
+        swapped for a counting dict, and the selector read may perform
+        only match-many key lookups and ZERO full iterations (a
+        regression to scan-plus-filter trips either counter), not on
+        wall-clock."""
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.kube import Client, TestClock
+
+        class CountingDict(dict):
+            def __init__(self, *a):
+                super().__init__(*a)
+                self.gets = 0
+                self.scans = 0
+
+            def __getitem__(self, k):
+                self.gets += 1
+                return super().__getitem__(k)
+
+            def items(self):
+                self.scans += 1
+                return super().items()
+
+            def values(self):
+                self.scans += 1
+                return super().values()
+
+        c = Client(TestClock())
+        for i in range(500):
+            c.create(
+                make_pod(
+                    name=f"p{i}",
+                    labels={"app": "hot" if i % 100 == 0 else "cold"},
+                )
+            )
+        c._objects = CountingDict(c._objects)
+        got = c.list(Pod, label_selector={"app": "hot"})
+        assert len(got) == 5
+        assert c._objects.gets == 5, "selector read touched non-matches"
+        assert c._objects.scans == 0, "selector read fell back to a scan"
+        term = ("l", "Pod", "app", "hot")
+        assert len(c._label_idx[term]) == 5
+
+    def test_injected_update_conflict_keeps_index_consistent(self):
+        """The chaos seam fires BEFORE the index maintenance runs: a
+        caller that mutated the stored reference in place (the binder's
+        bind-then-update shape) and then hits an injected conflict must
+        not leave the inverted index describing the pre-mutation object
+        while a full scan sees the mutation — update()/delete() re-derive
+        the stored object's terms before re-raising."""
+        from karpenter_tpu import faults
+        from karpenter_tpu.api.objects import Pod
+        from karpenter_tpu.kube import Client, TestClock
+
+        client = Client(TestClock())
+        pod = make_pod(name="bindme", labels={"app": "a"})
+        client.create(pod)
+        inj = faults.install(
+            faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        "store.update",
+                        error=lambda: __import__(
+                            "karpenter_tpu.kube.store", fromlist=["x"]
+                        ).ConflictError("injected"),
+                        times=1,
+                    ),
+                    faults.FaultRule("store.delete", times=1),
+                ]
+            )
+        )
+        try:
+            stored = client.get(Pod, "bindme")
+            stored.spec.node_name = "n-9"  # in-place, pre-update (binder shape)
+            with pytest.raises(Exception):
+                client.update(stored)
+            # index == full scan, even though the update failed
+            self._assert_matches_oracle(client)
+            got = client.list(
+                Pod, field_selector={"spec.nodeName": "n-9"}
+            )
+            assert [o.metadata.name for o in got] == ["bindme"]
+            # same healing on the delete seam
+            stored.metadata.labels["app"] = "b"
+            with pytest.raises(Exception):
+                client.delete(stored)
+            self._assert_matches_oracle(client)
+            assert [
+                o.metadata.name
+                for o in client.list(Pod, label_selector={"app": "b"})
+            ] == ["bindme"]
+        finally:
+            faults.uninstall()

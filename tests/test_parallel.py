@@ -1,11 +1,26 @@
-"""Multi-chip sharding tests over the virtual 8-device CPU mesh."""
+"""Multi-chip sharding tests over the virtual 8-device CPU mesh.
+
+The r06 layout (parallel/mesh.py): scenario-major consolidation, the
+segment live-pair axis on 'data', types on 'model', group/node state
+replicated so the sequential packing scan never pays per-step collectives
+— pinned structurally on the compiled HLO, not on wall-clock."""
 
 import jax
 import numpy as np
 import pytest
 
-from karpenter_tpu.parallel.mesh import make_mesh, pad_args_for_mesh, sharded_solve_fn
 from karpenter_tpu.ops.solve import solve_all
+from karpenter_tpu.parallel.mesh import (
+    ARG_SPECS,
+    make_mesh,
+    pad_args_for_mesh,
+    scan_collective_report,
+    scenario_mesh,
+    sharded_scenarios_fn,
+    sharded_solve_fn,
+    sharded_solve_packed_fn,
+)
+from karpenter_tpu.solver.encode import SOLVE_ARG_NAMES
 
 
 def _example(n_pods=64, n_types=16, shapes=8):
@@ -21,10 +36,41 @@ def mesh():
     return make_mesh(8)
 
 
+def _claim_key(results):
+    return sorted(
+        (
+            tuple(sorted(p.metadata.name for p in c.pods)),
+            tuple(sorted(t.name for t in c.instance_type_options)),
+        )
+        for c in results.new_node_claims
+    )
+
+
 class TestMesh:
     def test_mesh_shape(self, mesh):
-        assert mesh.axis_names == ("data", "model")
+        assert mesh.axis_names == ("scenario", "data", "model")
         assert int(np.prod(mesh.devices.shape)) == 8
+        # the measured default: every device on the segment ('data') axis,
+        # the only single-solve factorization with a collective-free scan
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "scenario": 1, "data": 8, "model": 1,
+        }
+
+    def test_arg_specs_cover_solve_args(self):
+        assert set(ARG_SPECS) == set(SOLVE_ARG_NAMES)
+        # the fixed layout: group- and node-major state replicated (the
+        # scan reads/carries it), the segment index on 'data', types on
+        # 'model' — a g_*/n_* entry growing a mesh axis is the r05
+        # regression coming back
+        for name, spec in ARG_SPECS.items():
+            if name.startswith(("g_", "n_")) or name in (
+                "nh_cnt0", "dd0", "dtg_key", "well_known",
+            ):
+                assert all(s is None for s in spec), (name, spec)
+        for name in ("gk_g", "gk_k", "gk_w"):
+            assert ARG_SPECS[name] == ("data",)
+        for name in ("t_def", "t_mask", "t_alloc", "t_cap", "t_mvoh"):
+            assert ARG_SPECS[name] == ("model",)
 
     def _assert_full_equality(self, single, sharded, n_groups):
         """ALL solver outputs agree between the single-device and sharded
@@ -56,8 +102,23 @@ class TestMesh:
             sharded = fn(*padded)
         self._assert_full_equality(single, sharded, args[0].shape[0])
 
+    def test_every_factorization_matches(self, mesh):
+        """Every (scenario=1, data, model) factorization of 8 devices —
+        including the mixed ones and the sparse segment path — produces
+        the single-device outputs exactly."""
+        args, statics = _example()
+        statics = dict(statics, sparse_groups=True)
+        single = solve_all(*args, **statics)
+        for data in (1, 2, 4, 8):
+            m = make_mesh(8, data=data)
+            padded = pad_args_for_mesh(args, m)
+            fn = sharded_solve_fn(m, **statics)
+            with m:
+                sharded = fn(*padded)
+            self._assert_full_equality(single, sharded, args[0].shape[0])
+
     def test_sharded_matches_single_device_many_groups(self, mesh):
-        """G far beyond the data axis (hundreds of groups over data=2):
+        """G far beyond the old data-axis semantics (hundreds of groups):
         every output must still match the single-device program exactly."""
         from karpenter_tpu.api import resources as res
         from karpenter_tpu.api.objects import ObjectMeta, Pod, PodSpec
@@ -97,7 +158,7 @@ class TestMesh:
         nmax = solver._estimate_nmax(snap, solver._fit_matrix(snap))
         statics = dict(
             nmax=nmax, zone_kid=snap.zone_kid, ct_kid=snap.ct_kid,
-            has_domains=False,
+            has_domains=False, sparse_groups=True,
         )
         args = snap.solve_args(a_tzc, res_cap0, a_res)
         G = args[0].shape[0]
@@ -139,25 +200,7 @@ class TestMesh:
         sharded = solve(SolverConfig(mesh=mesh))
         assert not single.pod_errors and not sharded.pod_errors
         assert single.node_count() == sharded.node_count()
-        a = sorted(
-            (
-                c.template.node_pool_name,
-                tuple(sorted(p.uid for p in c.pods)),
-                tuple(sorted(t.name for t in c.instance_type_options)),
-                repr(sorted(c.requirements.keys())),
-            )
-            for c in single.new_node_claims
-        )
-        b = sorted(
-            (
-                c.template.node_pool_name,
-                tuple(sorted(p.uid for p in c.pods)),
-                tuple(sorted(t.name for t in c.instance_type_options)),
-                repr(sorted(c.requirements.keys())),
-            )
-            for c in sharded.new_node_claims
-        )
-        assert a == b
+        assert _claim_key(single) == _claim_key(sharded)
 
     def test_driver_mesh_matches_single_device_10k(self, mesh):
         """North-star-scale through the driver (VERDICT r4 #3): 10k
@@ -189,22 +232,397 @@ class TestMesh:
         sharded = solve(SolverConfig(mesh=mesh))
         assert not single.pod_errors and not sharded.pod_errors
         assert single.node_count() == sharded.node_count()
-        a = sorted(
-            (tuple(sorted(p.uid for p in c.pods)),
-             tuple(sorted(t.name for t in c.instance_type_options)))
-            for c in single.new_node_claims
-        )
-        b = sorted(
-            (tuple(sorted(p.uid for p in c.pods)),
-             tuple(sorted(t.name for t in c.instance_type_options)))
-            for c in sharded.new_node_claims
-        )
-        assert a == b
+        assert _claim_key(single) == _claim_key(sharded)
+
+    def test_dense_mesh_refactorizes_for_sparse_off(self, mesh, monkeypatch):
+        """With the sparse segment path off (KTPU_SPARSE_FEAS=0, the
+        tiled-mode shape), 'data' sharding would shard only the unused
+        gk_* index — the driver must re-factorize the devices onto
+        'model' (the dense layout that actually shards the type tables)
+        and still match single-device decisions."""
+        from karpenter_tpu.parallel.mesh import dense_mesh
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+
+        dm = dense_mesh(mesh)
+        assert dict(zip(dm.axis_names, dm.devices.shape)) == {
+            "scenario": 1, "data": 1, "model": 8,
+        }
+        assert dense_mesh(dm) is dm  # already dense: identity
+
+        monkeypatch.setenv("KTPU_SPARSE_FEAS", "0")
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import mixed_pods
+
+        pods = mixed_pods(300, gpu_fraction=0.0)
+        pools = [example_nodepool()]
+        its_by_pool = {p.name: corpus.generate(16) for p in pools}
+
+        def solve(cfg, cache):
+            topology = Topology(
+                Client(TestClock()), [], pools, its_by_pool, pods
+            )
+            s = TpuSolver(
+                pools, its_by_pool, topology, config=cfg,
+                encode_cache=cache,
+            )
+            return s, s.solve(pods)
+
+        cache = EncodeCache()
+        _, r_mesh = solve(SolverConfig(mesh=mesh), cache)
+        _, r_one = solve(SolverConfig(), EncodeCache())
+        assert _claim_key(r_mesh) == _claim_key(r_one)
+        # the staged buffers live on the DENSE re-factorization, not the
+        # data-major base mesh
+        assert cache.device_store._mesh_key == dm
 
     def test_dryrun_entrypoint(self, mesh):
         import __graft_entry__ as graft
 
         graft.dryrun_multichip(8)
+
+
+class TestScanStructure:
+    """The per-scan-step-collectives regression, pinned on dispatch
+    STRUCTURE (compiled HLO), not wall-clock — CPU CI cannot flake it."""
+
+    def test_scan_body_has_no_collectives(self, mesh):
+        """The default (data-major) layout: the sharded feasibility stage
+        folds into replicated tables at the scan boundary, and the
+        while-loop bodies of the packing scan carry ZERO collective ops.
+        (r05 measured the opposite layout at 12x single-device: the scan
+        paid an all-gather per step.)"""
+        args, statics = _example()
+        statics = dict(statics, sparse_groups=True)
+        padded = pad_args_for_mesh(args, mesh)
+        fn = sharded_solve_fn(mesh, **statics)
+        report = scan_collective_report(
+            fn.lower(*padded).compile().as_text()
+        )
+        assert report["computations"] > 0
+        assert report["scan_computations"] > 0, "no while loop found"
+        # the feasibility stage DOES communicate (segment sums fold over
+        # the sharded live-pair axis) — proves the parse sees collectives
+        assert report["collectives_total"] > 0
+        assert report["collectives_in_scan"] == 0, report["offenders"]
+
+    def test_scenario_dispatch_scan_is_local(self, mesh):
+        """The scenario-major mesh: each scenario shard runs the whole
+        solve locally; its scan bodies carry zero collectives too."""
+        import jax.numpy as jnp
+
+        args, statics = _example()
+        statics = dict(statics, sparse_groups=True)
+        smesh = scenario_mesh(mesh, 8)
+        assert dict(zip(smesh.axis_names, smesh.devices.shape)) == {
+            "scenario": 8, "data": 1, "model": 1,
+        }
+        # model sharding is never folded away by the scenario
+        # re-factorization: its HBM-headroom purpose (catalogs too large
+        # for one chip) must survive a consolidation search
+        model_mesh = make_mesh(8, data=1)
+        assert dict(
+            zip(model_mesh.axis_names, model_mesh.devices.shape)
+        ) == {"scenario": 1, "data": 1, "model": 8}
+        sm = scenario_mesh(model_mesh, 8)
+        assert dict(zip(sm.axis_names, sm.devices.shape)) == {
+            "scenario": 1, "data": 1, "model": 8,
+        }
+        S = 8
+        g_count_s = np.repeat(np.asarray(args[0])[None], S, axis=0)
+        idx_n_tol = SOLVE_ARG_NAMES.index("n_tol")
+        n_tol_s = np.repeat(np.asarray(args[idx_n_tol])[None], S, axis=0)
+        sargs = list(pad_args_for_mesh(args, smesh))
+        sargs[0] = g_count_s
+        sargs[idx_n_tol] = n_tol_s
+        fn = sharded_scenarios_fn(
+            smesh, jnp.int32, False, **statics
+        )
+        report = scan_collective_report(
+            fn.lower(*sargs).compile().as_text()
+        )
+        assert report["scan_computations"] > 0
+        # the scenario axis's only in-scan communication is the scalar
+        # "are all shards done" trip vote (O(1) bytes per step) — zero
+        # DATA collectives, which is what the r05 regression was made of
+        assert report["collectives_in_scan_data"] == 0, report["offenders"]
+        # parity of the sharded scenario outputs against the plain solve
+        single = solve_all(*args, **statics)
+        with smesh:
+            out = fn(*sargs)
+        n_open = int(single[2])
+        for si in range(S):
+            assert int(np.asarray(out[2])[si]) == n_open
+
+    def test_scenario_mixed_factorization_scan_is_local(self, mesh):
+        """A scenario mesh that RETAINS data>1 (devices exceed the
+        scenario bucket, e.g. 16 devices / 8 scenarios): the sharded
+        feasibility tables must still fold at the scan boundary — without
+        the table constraint on the scenario program this pays the r05
+        all-gather every scan step."""
+        import jax.numpy as jnp
+
+        args, statics = _example()
+        statics = dict(statics, sparse_groups=True)
+        smesh = make_mesh(8, data=2, scenario=4)
+        assert dict(zip(smesh.axis_names, smesh.devices.shape)) == {
+            "scenario": 4, "data": 2, "model": 1,
+        }
+        S = 8
+        idx_n_tol = SOLVE_ARG_NAMES.index("n_tol")
+        sargs = list(pad_args_for_mesh(args, smesh))
+        sargs[0] = np.repeat(np.asarray(args[0])[None], S, axis=0)
+        sargs[idx_n_tol] = np.repeat(
+            np.asarray(args[idx_n_tol])[None], S, axis=0
+        )
+        fn = sharded_scenarios_fn(smesh, jnp.int32, False, **statics)
+        report = scan_collective_report(
+            fn.lower(*sargs).compile().as_text()
+        )
+        assert report["scan_computations"] > 0
+        assert report["collectives_in_scan_data"] == 0, report["offenders"]
+        single = solve_all(*args, **statics)
+        with smesh:
+            out = fn(*sargs)
+        for si in range(S):
+            assert int(np.asarray(out[2])[si]) == int(single[2])
+
+
+class TestDeltaApplySharded:
+    """delta_apply_rows on mesh-resident buffers: global row index ->
+    (shard, local row), applied shard-locally."""
+
+    def test_delta_apply_shard_local(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.ops import solve as ops_solve
+
+        rng = np.random.default_rng(11)
+        host = rng.standard_normal((64, 16)).astype(np.float32)
+        arr = jax.device_put(host, NamedSharding(mesh, P("data")))
+        idx = np.asarray([0, 5, 9, 17, 33, 34, 63], np.int32)
+        rows = rng.standard_normal((len(idx), 16)).astype(np.float32)
+        out = ops_solve.delta_apply_rows(arr, idx, rows)
+        want = host.copy()
+        want[idx] = rows
+        np.testing.assert_array_equal(np.asarray(out), want)
+        # the update keeps the buffer's sharding (the next dispatch reuses
+        # it without a reshard)
+        assert out.sharding.spec == arr.sharding.spec
+        # structural: the compiled shard-local apply has NO collectives
+        lidx, lrows, live = ops_solve._decompose_rows_by_shard(
+            idx, rows, host.shape[0] // 8, 8
+        )
+        fn = ops_solve._apply_rows_shard_fn(mesh, "data", donate=False)
+        report = scan_collective_report(
+            fn.lower(arr, lidx, lrows, live).compile().as_text()
+        )
+        assert report["collectives_total"] == 0, report["offenders"]
+
+    def test_delta_apply_row_zero_with_padding(self, mesh):
+        """A real update to a shard's LOCAL ROW 0 while another shard
+        carries more rows (so this shard's bucket has padding slots):
+        padding must be idempotent repeats of the shard's own first
+        entry, never masked rewrites of the current row-0 value — under
+        duplicate-index scatter the old value could win and silently
+        revert the delta."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.ops.solve import delta_apply_rows
+
+        rng = np.random.default_rng(5)
+        host = rng.standard_normal((64, 4)).astype(np.float32)
+        arr = jax.device_put(host, NamedSharding(mesh, P("data")))
+        # shard 0 (block 0..7): only row 0 -> 3 padding slots in a
+        # bucket of 4; shard 1 (block 8..15): four rows, fills the bucket
+        idx = np.asarray([0, 8, 9, 10, 11], np.int32)
+        rows = rng.standard_normal((len(idx), 4)).astype(np.float32)
+        out = delta_apply_rows(arr, idx, rows)
+        want = host.copy()
+        want[idx] = rows
+        np.testing.assert_array_equal(np.asarray(out), want)
+
+    def test_delta_apply_shard_local_donated(self, mesh, monkeypatch):
+        """KTPU_DONATE_DELTA=1 keeps its HBM contract on the sharded
+        path: the update is correct and the input buffer is donated
+        (deleted) rather than left as a second resident copy."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.ops.solve import delta_apply_rows
+
+        monkeypatch.setenv("KTPU_DONATE_DELTA", "1")
+        host = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+        arr = jax.device_put(host, NamedSharding(mesh, P("data")))
+        idx = np.asarray([0, 3, 17], np.int32)
+        rows = -np.ones((3, 4), np.float32)
+        out = delta_apply_rows(arr, idx, rows)
+        want = host.copy()
+        want[idx] = rows
+        np.testing.assert_array_equal(np.asarray(out), want)
+        assert arr.is_deleted(), "donated input buffer survived"
+
+    def test_delta_apply_replicated_buffer(self, mesh):
+        """A replicated mesh buffer (the r06 layout's group/node arrays)
+        takes the plain path: every device applies the full row set."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.ops.solve import delta_apply_rows
+
+        host = np.arange(48, dtype=np.int32).reshape(16, 3)
+        arr = jax.device_put(host, NamedSharding(mesh, P()))
+        idx = np.asarray([2, 7, 11], np.int32)
+        rows = -np.ones((3, 3), np.int32)
+        out = delta_apply_rows(arr, idx, rows)
+        want = host.copy()
+        want[idx] = rows
+        np.testing.assert_array_equal(np.asarray(out), want)
+
+
+class TestMeshWarmPath:
+    """The PR-8 warm path survives partitioning: REUSE and row-delta
+    outcomes on the mesh match the single-device solver exactly."""
+
+    def _fixtures(self, n_pods=400, workload="mixed"):
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import (
+            constrained_mix, diverse_reference_mix, mixed_pods,
+        )
+
+        pools = [example_nodepool()]
+        its_by_pool = {p.name: corpus.generate(24) for p in pools}
+        pods = {
+            "mixed": lambda n: mixed_pods(n, gpu_fraction=0.0),
+            "constrained": constrained_mix,
+            "diverse": diverse_reference_mix,
+        }[workload](n_pods)
+        return pools, its_by_pool, pods
+
+    def _solver(self, pools, its_by_pool, pods, cfg, cache):
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+
+        topology = Topology(Client(TestClock()), [], pools, its_by_pool, pods)
+        return TpuSolver(
+            pools, its_by_pool, topology, config=cfg, encode_cache=cache
+        )
+
+    def _churn_script(self, pods, ticks=3, k=8):
+        import random
+
+        rng = random.Random(13)
+        regen = list(pods)
+        out = [list(pods)]
+        cur = list(pods)
+        for _ in range(ticks):
+            cur = list(cur)
+            idx = rng.sample(range(len(cur)), k)
+            jdx = rng.sample(range(len(regen)), k)
+            for i, j in zip(idx, jdx):
+                # a shape-preserving swap: counts shift between groups —
+                # the steady-state delta the row banks turn into a
+                # count/node row update
+                cur[i] = regen[jdx[0] if j == i else j]
+            out.append(cur)
+        return out
+
+    @pytest.mark.parametrize("workload", ["mixed", "constrained", "diverse"])
+    def test_reuse_and_row_delta_survive_mesh(self, mesh, workload):
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+
+        pools, its_by_pool, pods = self._fixtures(
+            n_pods=400 if workload == "mixed" else 240, workload=workload
+        )
+        script = self._churn_script(pods)
+
+        def run(cfg):
+            cache = EncodeCache()
+            out = []
+            for tick_pods in script:
+                s = self._solver(pools, its_by_pool, tick_pods, cfg, cache)
+                r = s.solve(tick_pods)
+                out.append(
+                    (
+                        bool(s.last_encode_reused),
+                        int(s.last_delta_rows),
+                        s.fallback_solves,
+                        _claim_key(r),
+                    )
+                )
+            return out, cache
+
+        single, _ = run(SolverConfig())
+        sharded, cache = run(SolverConfig(mesh=mesh))
+        assert single == sharded
+        # the script exercised the warm outcomes, not just cold solves
+        assert any(reused for reused, *_ in single[1:]) or any(
+            rows for _, rows, *_ in single[1:]
+        )
+        # staged buffers live on the mesh with their ARG_SPECS shardings
+        store = cache.device_store
+        assert store is not None and store._mesh_key == mesh
+        gk = store._dev_buffers.get("gk_g")
+        if gk is not None:
+            assert tuple(gk.sharding.spec) == ("data",)
+
+    def test_mesh_to_single_device_switch_restages(self, mesh):
+        """One EncodeCache serving a mesh solve then a single-device solve
+        (a failover shape): the store sheds the mesh buffers and both
+        answers stay correct."""
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+
+        pools, its_by_pool, pods = self._fixtures(n_pods=200)
+        cache = EncodeCache()
+        s1 = self._solver(
+            pools, its_by_pool, pods, SolverConfig(mesh=mesh), cache
+        )
+        r1 = s1.solve(pods)
+        s2 = self._solver(pools, its_by_pool, pods, SolverConfig(), cache)
+        r2 = s2.solve(pods)
+        assert _claim_key(r1) == _claim_key(r2)
+        assert cache.device_store._mesh_key is None
+
+
+class TestMeshScenarios:
+    """The scenario axis shards: a consolidation-shaped scenario batch
+    under the mesh stays <= 2 dispatches and matches the unsharded batch."""
+
+    def test_scenario_batch_parity_under_mesh(self, mesh):
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.kube import Client, TestClock
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+        from karpenter_tpu.solver.driver import (
+            EncodeCache, Scenario, SolverConfig,
+        )
+        from karpenter_tpu.solver.example import example_nodepool
+        from karpenter_tpu.solver.workloads import mixed_pods
+
+        pods = mixed_pods(300, gpu_fraction=0.0)
+        pools = [example_nodepool()]
+        its_by_pool = {p.name: corpus.generate(24) for p in pools}
+        scens = [Scenario(pods=pods[: 50 * (i + 1)]) for i in range(5)]
+
+        def run(cfg):
+            topology = Topology(
+                Client(TestClock()), [], pools, its_by_pool, pods
+            )
+            s = TpuSolver(
+                pools, its_by_pool, topology, config=cfg,
+                encode_cache=EncodeCache(),
+            )
+            return s, s.solve_scenarios(scens)
+
+        s1, r1 = run(SolverConfig())
+        s2, r2 = run(SolverConfig(mesh=mesh))
+        assert r1 is not None and r2 is not None
+        assert [_claim_key(r) for r in r1] == [_claim_key(r) for r in r2]
+        assert s2.last_scenario_dispatches <= 2
+        assert s2.fallback_solves == 0
 
 
 class TestEntry:

@@ -11,7 +11,10 @@ python -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
 
 # the `go vet` analog: dataflow passes (analysis/core/) for tracer-safety
 # in the kernels, device-residency (DTX9xx) over the solve path, clock
-# discipline (CLK10xx) over the determinism surface, retry hygiene, lock
+# discipline (CLK10xx) and order discipline (DET11xx — unordered sources
+# to order-sensitive sinks, the PYTHONHASHSEED interning class) over the
+# determinism surface, kernel-arg registry consistency (ARG12xx — the
+# six hand-aligned SOLVE_ARG_NAMES surfaces), retry hygiene, lock
 # ordering / callback-under-lock in the store layer, blocking calls in
 # reconcile paths, schema<->CRD drift, kernel-twin parity skeletons
 # (pack / pack_classed / solve_core.cc via `// parity:` anchors), and

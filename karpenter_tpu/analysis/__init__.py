@@ -21,15 +21,22 @@ must go through the injectable kube/clock.py (blocking.py), and structural
 drift between api/schema.py and the checked-in CRD YAML (schema_drift.py).
 
 Since the dataflow core landed (analysis/core/: intraprocedural CFG +
-forward fixpoint + one-level same-module helper summaries), the
-flow-shaped families ride it: tracer.py and retry.py are migrated, and
-two new families guard the delta-encode roadmap — device.py (DTX9xx:
-device values tracked from jnp/device_put/kernel-dispatch origins to
-host-sync sinks, with ``jax.device_get`` as the explicitly sanctioned
-decode boundary) and clock.py (CLK10xx: every timestamp in
+forward fixpoint + helper summaries, now propagated bottom-up over a
+module-set call graph with SCC-collapsed cycles), the flow-shaped
+families ride it: tracer.py and retry.py are migrated, and further
+families guard the delta-encode roadmap — device.py (DTX9xx: device
+values tracked from jnp/device_put/kernel-dispatch origins to host-sync
+sinks, with ``jax.device_get`` as the explicitly sanctioned decode
+boundary), clock.py (CLK10xx: every timestamp in
 controllers/faults/obs/solver must flow from an injected clock or the
 documented RealClock seams — the replay-determinism contract,
-machine-checked).
+machine-checked), det.py (DET11xx: values born from unordered sources —
+sets, os.environ, unseeded RNG — flagged at order-sensitive sinks on
+the determinism surface; the PR 14 PYTHONHASHSEED interning bug, closed
+as a class), and args_registry.py (ARG12xx: the 56-argument kernel
+registry diffed across its six hand-aligned surfaces — encode assembly,
+ARG_SPECS, mesh padding, native wrapper, residency delta classes,
+scenario batching).
 
 Run ``python -m karpenter_tpu.analysis`` (or hack/analyze.py); it exits
 nonzero on any new finding. Suppress with an inline
@@ -58,14 +65,14 @@ def all_rules() -> Dict[str, str]:
     pass modules. The meta-test in tests/test_analysis.py asserts each has
     a seeded-bad fixture; the SARIF writer uses it for rule metadata."""
     from . import (
-        blocking, clock, device, locks, obs, parity, retry, schema_drift,
-        shapes, stale, tracer,
+        args_registry, blocking, clock, det, device, locks, obs, parity,
+        retry, schema_drift, shapes, stale, tracer,
     )
 
     out: Dict[str, str] = {}
     for mod in (
         tracer, locks, blocking, schema_drift, parity, shapes, retry, obs,
-        device, clock, stale,
+        device, clock, det, args_registry, stale,
     ):
         out.update(getattr(mod, "RULES", {}))
     return out

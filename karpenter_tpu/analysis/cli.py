@@ -17,6 +17,12 @@ Default targets mirror the hazards each pass exists for:
             (DTX9xx device-residency dataflow)
 - clock:    karpenter_tpu/controllers, faults/, obs/, solver/
             (CLK10xx clock-discipline dataflow)
+- det:      karpenter_tpu/solver, ops/, sim/, obs/
+            (DET11xx order-discipline dataflow: unordered sources to
+            order-sensitive sinks)
+- args:     solver/encode.py, parallel/mesh.py, solver/residency.py,
+            native/__init__.py, ops/solve.py (ARG12xx kernel-arg
+            registry surfaces vs SOLVE_ARG_NAMES)
 
 Positional paths (with ``--pass``) override a pass's default targets so
 fixture suites can point a single pass at seeded-bad files. Exit status is
@@ -48,8 +54,10 @@ from typing import Dict, List, Optional, Set
 
 from . import (
     all_rules,
+    args_registry,
     blocking,
     clock,
+    det,
     device,
     locks,
     obs,
@@ -126,11 +134,32 @@ PASS_TARGETS = {
         "karpenter_tpu/obs",
         "karpenter_tpu/solver",
     ],
+    # order discipline over the determinism surface: unordered-source
+    # values (sets, os.environ, unseeded RNG) must not reach
+    # order-sensitive sinks un-sorted (DET11xx — the PYTHONHASHSEED
+    # interning class, statically)
+    "det": [
+        "karpenter_tpu/solver",
+        "karpenter_tpu/ops",
+        "karpenter_tpu/sim",
+        "karpenter_tpu/obs",
+    ],
+    # the kernel-arg registry's six hand-aligned surfaces, diffed
+    # against SOLVE_ARG_NAMES (ARG12xx)
+    "args": [
+        "karpenter_tpu/solver/encode.py",
+        "karpenter_tpu/parallel/mesh.py",
+        "karpenter_tpu/solver/residency.py",
+        "karpenter_tpu/native/__init__.py",
+        "karpenter_tpu/ops/solve.py",
+    ],
 }
 
-# passes whose targets are a comparison pair, not a scanned file set:
-# --changed-only runs them when ANY of their targets changed
-_PAIR_PASSES = {"schema", "parity"}
+# passes whose targets are a comparison pair (or cross-file registry),
+# not an independently scannable file set: --changed-only runs them in
+# full when ANY of their targets changed — a partial scan would read as
+# "surface missing" instead of "surface unchanged"
+_PAIR_PASSES = {"schema", "parity", "args"}
 
 
 def _run_pass(name: str, targets: List[str]):
@@ -163,7 +192,31 @@ def _run_pass(name: str, targets: List[str]):
         return device.check_paths(targets)
     if name == "clock":
         return clock.check_paths(targets)
+    if name == "det":
+        return det.check_paths(targets)
+    if name == "args":
+        return args_registry.check_paths(targets)
     raise ValueError(f"unknown pass {name!r}")
+
+
+# pass name -> producing module, for RULES lookup (stale-audit scope) —
+# the single place a new pass registers itself besides PASS_TARGETS
+PASS_MODULES = {
+    "tracer": tracer, "locks": locks, "blocking": blocking,
+    "schema": schema_drift, "parity": parity, "shapes": shapes,
+    "retry": retry, "obs": obs, "device": device, "clock": clock,
+    "det": det, "args": args_registry,
+}
+
+
+def _pass_worker(job):
+    """Run one pass in a worker process: (name, targets) ->
+    (name, findings, sources, seconds). Module-level so the process
+    pool can pickle it by reference."""
+    name, targets = job
+    t0 = time.perf_counter()
+    findings, sources = _run_pass(name, targets)
+    return name, findings, sources, round(time.perf_counter() - t0, 4)
 
 
 def _changed_files(root: str, base: str) -> Optional[Set[str]]:
@@ -275,8 +328,9 @@ def main(argv=None) -> int:
         description="Static analysis on the shared dataflow core: "
         "tracer-safety, lock ordering, blocking calls, schema drift, "
         "kernel-twin parity, axis/dtype shape discipline, retry hygiene, "
-        "observability hygiene, device-residency (DTX9xx), and clock "
-        "discipline (CLK10xx)",
+        "observability hygiene, device-residency (DTX9xx), clock "
+        "discipline (CLK10xx), order discipline (DET11xx), and "
+        "kernel-arg registry consistency (ARG12xx)",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -328,6 +382,11 @@ def main(argv=None) -> int:
         "--format", choices=("text", "sarif"), default="text",
         help="finding output format (sarif: SARIF 2.1.0 JSON on stdout)",
     )
+    parser.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="run pass modules in an N-process pool (passes are "
+        "independent file scans; 1 = in-process, the default)",
+    )
     args = parser.parse_args(argv)
 
     selected = args.passes or sorted(PASS_TARGETS)
@@ -357,11 +416,7 @@ def main(argv=None) -> int:
             )
 
     t_start = time.perf_counter()
-    pass_seconds: Dict[str, float] = {}
-    all_findings: List[Finding] = []
-    all_sources: Dict[str, SourceFile] = {}
-    # rule id -> abs paths its pass scanned (stale-audit accuracy gate)
-    scanned_by_rule: Dict[str, Set[str]] = {}
+    jobs: List = []
     for name in selected:
         if args.paths:
             targets = args.paths
@@ -372,20 +427,32 @@ def main(argv=None) -> int:
                 targets = _scope_targets(name, targets, changed)
             if not targets:
                 continue
-        t0 = time.perf_counter()
-        findings, sources = _run_pass(name, targets)
-        pass_seconds[name] = round(time.perf_counter() - t0, 4)
+        jobs.append((name, targets))
+
+    if args.jobs > 1 and len(jobs) > 1:
+        # passes are independent file scans with picklable results; a
+        # process pool turns sum-of-pass wall time into max-of-pass.
+        # Results are reassembled in selection order, so output and exit
+        # status are byte-identical to the sequential run.
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(
+            max_workers=min(args.jobs, len(jobs))
+        ) as pool:
+            results = list(pool.map(_pass_worker, jobs))
+    else:
+        results = [_pass_worker(job) for job in jobs]
+
+    pass_seconds: Dict[str, float] = {}
+    all_findings: List[Finding] = []
+    all_sources: Dict[str, SourceFile] = {}
+    # rule id -> abs paths its pass scanned (stale-audit accuracy gate)
+    scanned_by_rule: Dict[str, Set[str]] = {}
+    for name, findings, sources, seconds in results:
+        pass_seconds[name] = seconds
         all_findings.extend(findings)
         all_sources.update(sources)
-        rules = getattr(
-            {
-                "tracer": tracer, "locks": locks, "blocking": blocking,
-                "schema": schema_drift, "parity": parity, "shapes": shapes,
-                "retry": retry, "obs": obs, "device": device, "clock": clock,
-            }[name],
-            "RULES", {},
-        )
-        for rule in rules:
+        for rule in getattr(PASS_MODULES[name], "RULES", {}):
             scanned_by_rule.setdefault(rule, set()).update(sources)
 
     # repo-relative paths in output and baseline keys
@@ -485,6 +552,11 @@ def main(argv=None) -> int:
         properties = {
             "analysisSeconds": total_seconds,
             "passSeconds": pass_seconds,
+            # sum of per-pass seconds = the sequential-equivalent wall;
+            # with --jobs > 1 the gap to analysisSeconds is the measured
+            # pool speedup, recorded so it regresses visibly
+            "sequentialSeconds": round(sum(pass_seconds.values()), 4),
+            "jobs": args.jobs,
             "sanctionedSites": len(sanctioned_fs),
             "suppressedFindings": len(suppressed_fs),
             "changedOnly": changed is not None,

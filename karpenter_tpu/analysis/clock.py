@@ -11,9 +11,12 @@ faults/, obs/, solver/) with real dataflow:
 
 - CLK1001: a wall-clock read — ``time.time``/``monotonic``/
   ``perf_counter`` (and ``_ns`` variants), ``datetime.now``/``utcnow``/
-  ``today`` — reached by a direct call OR through a variable the
-  analysis tracked the function reference into (``f = time.monotonic``
-  ... ``f()``);
+  ``today`` — reached by a direct call, through a variable the analysis
+  tracked the function reference into (``f = time.monotonic`` ...
+  ``f()``), or through a local helper that RETURNS a wall-clock callable
+  (``f = _pick_clock()`` ... ``f()`` — return-kind summaries propagate
+  bottom-up over the module-set call graph, core.summaries, with
+  recursive clusters collapsed to plain);
 - CLK1002: a wall-clock callable escaping as a value (assigned, passed,
   returned) — a hidden clock source the injection seams can't replace.
 
@@ -29,13 +32,19 @@ Everything else threads the injected clock or ``obs.now()``.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from .astutil import call_name, dotted_name
 from .core.cfg import Atom, build_cfg
 from .core.dataflow import Env, run_forward, sweep
 from .core.lattice import Lattice
-from .core.summaries import ModuleInfo, load_modules
+from .core.summaries import (
+    ModuleInfo,
+    SummaryTable,
+    build_call_graph,
+    load_modules,
+    resolve_local,
+)
 from .findings import Finding, Severity, SourceFile
 
 RULES = {
@@ -72,9 +81,17 @@ class _ClockAnalysis:
     """One function under the clock lattice: wall-clock function
     references tracked through bindings; calls and escapes flagged."""
 
-    def __init__(self, mod: ModuleInfo, findings: List[Finding]):
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        findings: List[Finding],
+        modules: Optional[Dict[str, ModuleInfo]] = None,
+        summaries: Optional[SummaryTable] = None,
+    ):
         self.mod = mod
         self.findings = findings
+        self.modules = modules if modules is not None else {mod.path: mod}
+        self.summaries = summaries
         self._flagged: Set[Tuple[int, str]] = set()
 
     def _flag(self, rule: str, node: ast.AST, message: str) -> None:
@@ -113,6 +130,24 @@ class _ClockAnalysis:
             return max((self.kind(v, env) for v in node.values), default=PLAIN)
         if isinstance(node, ast.NamedExpr):
             return self.kind(node.value, env)
+        if isinstance(node, ast.Call):
+            # call-graph reach: a bare-name call resolving to a local
+            # helper takes the helper's summarized return kind — a
+            # helper that hands back time.monotonic makes its call site
+            # a clock source (`f = _pick_clock(); ... f()`)
+            raw = dotted_name(node.func)
+            if (
+                self.summaries is not None
+                and raw is not None
+                and "." not in raw
+                and not env.has(raw)
+            ):
+                hit = resolve_local(self.mod, raw, self.modules)
+                if hit is not None:
+                    return _return_kind(
+                        hit[0], hit[1], self.modules, self.summaries
+                    )
+            return PLAIN
         return PLAIN
 
     # -- transfer ---------------------------------------------------------
@@ -150,7 +185,8 @@ class _ClockAnalysis:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 check_function(self.mod, node, self.findings, parent=self)
             elif isinstance(node, ast.ClassDef):
-                _check_class(self.mod, node, self.findings)
+                _check_class(self.mod, node, self.findings,
+                             modules=self.modules, summaries=self.summaries)
             return
         if atom.kind == "for":
             self._check_expr(node.iter, env)
@@ -212,13 +248,50 @@ class _ClockAnalysis:
                 self._check_expr(child, env)
 
 
+def _return_kind(
+    mod: ModuleInfo,
+    fn: ast.FunctionDef,
+    modules: Dict[str, ModuleInfo],
+    summaries: SummaryTable,
+) -> int:
+    """Does the helper return a wall-clock callable? Joined over every
+    return expression, bottom-up over the call graph (a helper returning
+    another helper's clock result resolves too); recursive clusters read
+    PLAIN by SCC collapse."""
+
+    def compute() -> int:
+        analysis = _ClockAnalysis(mod, [], modules=modules, summaries=summaries)
+        init = Env(LATTICE)
+        cfg = build_cfg(fn.body)
+        envs = run_forward(cfg, init, analysis.transfer)
+        out = [PLAIN]
+
+        def collect(atom: Atom, env: Env) -> None:
+            if (
+                atom.kind == "stmt"
+                and isinstance(atom.node, ast.Return)
+                and atom.node.value is not None
+            ):
+                out.append(analysis.kind(atom.node.value, env))
+
+        sweep(cfg, envs, init, analysis.transfer, collect)
+        return max(out)
+
+    return summaries.get((mod.path, fn.name), compute)
+
+
 def check_function(
     mod: ModuleInfo,
     fn: ast.FunctionDef,
     findings: List[Finding],
     parent: "_ClockAnalysis" = None,
+    modules: Optional[Dict[str, ModuleInfo]] = None,
+    summaries: Optional[SummaryTable] = None,
 ) -> None:
-    analysis = _ClockAnalysis(mod, findings)
+    if parent is not None:
+        modules = modules if modules is not None else parent.modules
+        summaries = summaries if summaries is not None else parent.summaries
+    analysis = _ClockAnalysis(mod, findings, modules=modules, summaries=summaries)
     if parent is not None:
         analysis._flagged = parent._flagged
     init = Env(LATTICE)
@@ -227,14 +300,22 @@ def check_function(
     sweep(cfg, envs, init, analysis.transfer, analysis.check)
 
 
-def _check_class(mod: ModuleInfo, cls: ast.ClassDef, findings: List[Finding]):
+def _check_class(
+    mod: ModuleInfo,
+    cls: ast.ClassDef,
+    findings: List[Finding],
+    modules: Optional[Dict[str, ModuleInfo]] = None,
+    summaries: Optional[SummaryTable] = None,
+):
     if cls.name in _SEAM_CLASSES:
         return  # the documented RealClock seams read the wall clock
     for item in cls.body:
         if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            check_function(mod, item, findings)
+            check_function(mod, item, findings, modules=modules,
+                           summaries=summaries)
         elif isinstance(item, ast.ClassDef):
-            _check_class(mod, item, findings)
+            _check_class(mod, item, findings, modules=modules,
+                         summaries=summaries)
 
 
 def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
@@ -245,10 +326,12 @@ def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]
         findings.append(
             Finding("CLK1000", Severity.ERROR, path, 0, f"unparsable: {exc}")
         )
+    summaries = SummaryTable(default=PLAIN, graph=build_call_graph(modules))
     for mod in modules.values():
         # module body (constants like `_NOW = time.time()`), then every
         # top-level function and class method
-        analysis = _ClockAnalysis(mod, findings)
+        analysis = _ClockAnalysis(mod, findings, modules=modules,
+                                  summaries=summaries)
         init = Env(LATTICE)
         cfg = build_cfg(
             [s for s in mod.tree.body
@@ -258,8 +341,10 @@ def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]
         envs = run_forward(cfg, init, analysis.transfer)
         sweep(cfg, envs, init, analysis.transfer, analysis.check)
         for fn in mod.index.functions.values():
-            check_function(mod, fn, findings)
+            check_function(mod, fn, findings, modules=modules,
+                           summaries=summaries)
         for node in mod.tree.body:
             if isinstance(node, ast.ClassDef):
-                _check_class(mod, node, findings)
+                _check_class(mod, node, findings, modules=modules,
+                             summaries=summaries)
     return findings, sources

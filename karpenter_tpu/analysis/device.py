@@ -11,9 +11,12 @@ boundary.
 Hosted on the dataflow core: values originating from ``jnp.*`` /
 ``jax.device_put`` / kernel-dispatch returns (``dispatch_*`` /
 ``solve_all*`` by the ops/solve.py naming convention) are tracked as
-DEVICE through assignments, attributes, tuple unpacks, and one level of
-same-module helper calls; everything the analysis loses track of joins
-to UNKNOWN and never flags (poison-to-unknown). Host-sync sinks flag
+DEVICE through assignments, attributes, tuple unpacks, and helper calls
+— return-kind summaries propagate bottom-up over the module-set call
+graph (core.summaries), so a device origin buried several helper hops
+down still reaches the call site; everything the analysis loses track
+of joins to UNKNOWN and never flags (poison-to-unknown), and recursive
+helper clusters collapse to UNKNOWN by SCC. Host-sync sinks flag
 only on *definite* device values:
 
 - DTX901: truthiness — ``if``/``while``/``assert``/ternary/``not``/
@@ -59,7 +62,8 @@ from .core.dataflow import Env, run_forward, sweep
 from .core.lattice import Lattice
 from .core.summaries import (
     ModuleInfo,
-    ReturnSummaries,
+    SummaryTable,
+    build_call_graph,
     load_modules,
     resolve_local,
 )
@@ -117,7 +121,7 @@ class _DeviceAnalysis:
         mod: ModuleInfo,
         modules: Dict[str, ModuleInfo],
         findings: List[Finding],
-        summaries: Optional[ReturnSummaries],
+        summaries: Optional[SummaryTable],
     ):
         self.mod = mod
         self.modules = modules
@@ -519,12 +523,13 @@ def _return_kind(
     mod: ModuleInfo,
     fn: ast.FunctionDef,
     modules: Dict[str, ModuleInfo],
-    summaries: ReturnSummaries,
+    summaries: SummaryTable,
 ) -> int:
-    """One-level helper summary: nested helper calls unresolved."""
+    """Call-graph helper summary: nested helper calls resolve through
+    the same table (bottom-up, SCC-collapsed to UNKNOWN)."""
 
     def compute() -> int:
-        analysis = _DeviceAnalysis(mod, modules, [], summaries=None)
+        analysis = _DeviceAnalysis(mod, modules, [], summaries=summaries)
         init = _param_env(fn, Env(LATTICE))
         cfg = build_cfg(fn.body)
         envs = run_forward(cfg, init, analysis.transfer)
@@ -549,7 +554,7 @@ def _check_function(
     fn: ast.FunctionDef,
     findings: List[Finding],
     modules: Dict[str, ModuleInfo],
-    summaries: Optional[ReturnSummaries],
+    summaries: Optional[SummaryTable],
     parent_env: Optional[Env] = None,
     shared_flags: Optional[Set[Tuple[int, str]]] = None,
 ) -> None:
@@ -571,7 +576,7 @@ def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]
         findings.append(
             Finding("DTX900", Severity.ERROR, path, 0, f"unparsable: {exc}")
         )
-    summaries = ReturnSummaries(default=UNKNOWN)
+    summaries = SummaryTable(default=UNKNOWN, graph=build_call_graph(modules))
     for mod in modules.values():
         # module body first (a top-level `_TABLE = jnp.arange(8)` fed
         # into list()/print()/np.asarray is a host sync like any other);

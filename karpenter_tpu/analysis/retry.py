@@ -22,12 +22,14 @@ visible:
   bounded replacement.
 
 Hosted on the dataflow core's module layer (analysis/core/summaries):
-the bound detection reaches through ONE level of same-module helpers —
-a loop whose handler calls ``self._pause()`` or a module-level
-``_backoff_step()`` that itself touches a Backoff/clock/attempt bound is
-bounded, where the first-generation AST matcher only saw the loop's own
-text and flagged it (those false positives are why the reach exists;
-suppressions they used to require are deleted, not kept).
+the bound detection reaches through helpers over the module-set call
+graph — a loop whose handler calls ``self._pause()`` or a module-level
+``_backoff_step()`` that itself touches a Backoff/clock/attempt bound
+(directly, or through further helpers) is bounded, where the
+first-generation AST matcher only saw the loop's own text and flagged
+it (those false positives are why the reach exists; suppressions they
+used to require are deleted, not kept). Recursive helper clusters
+collapse to "no bound" by SCC — a cycle can't vouch for itself.
 
 The bound detection stays deliberately permissive (any attempt-counter-ish
 name comparison, any backoff/clock reference, any escape statement in the
@@ -41,7 +43,13 @@ import ast
 from typing import Dict, List, Optional, Tuple
 
 from .astutil import dotted_name
-from .core.summaries import ModuleInfo, ReturnSummaries, load_modules, resolve_local
+from .core.summaries import (
+    ModuleInfo,
+    SummaryTable,
+    build_call_graph,
+    load_modules,
+    resolve_local,
+)
 from .findings import Finding, Severity, SourceFile
 
 RULES = {
@@ -55,7 +63,7 @@ _SWALLOW_BODY = (ast.Pass, ast.Continue)
 _BOUND_NAME_HINTS = ("backoff", "attempt", "retries", "tries", "deadline")
 _BOUND_CALL_ATTRS = {"sleep", "delay", "ready", "failure", "call", "retry"}
 
-# summary values for the one-level helper reach
+# summary values for the call-graph helper reach
 _NO_BOUND = 0
 _HAS_BOUND = 1
 
@@ -107,52 +115,74 @@ def _own_bound_evidence(node: ast.AST) -> bool:
     return False
 
 
+def _call_targets(
+    node: ast.AST, mod: ModuleInfo, modules: Dict[str, ModuleInfo]
+) -> List[Tuple[ModuleInfo, ast.FunctionDef]]:
+    """Resolvable helper targets of every call inside ``node``: bare
+    names through resolve_local, ``self._helper()`` against every class
+    method table in the module (conservative: any method of that name
+    counts)."""
+    out: List[Tuple[ModuleInfo, ast.FunctionDef]] = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        raw = dotted_name(sub.func)
+        if raw is not None and "." not in raw:
+            target = resolve_local(mod, raw, modules)
+            if target is not None:
+                out.append(target)
+        elif (
+            isinstance(sub.func, ast.Attribute)
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == "self"
+        ):
+            for table in mod.index.methods.values():
+                if sub.func.attr in table:
+                    out.append((mod, table[sub.func.attr]))
+                    break
+    return out
+
+
 def _helper_bound_summary(
     mod: ModuleInfo,
     fn: ast.FunctionDef,
-    summaries: ReturnSummaries,
+    modules: Dict[str, ModuleInfo],
+    summaries: SummaryTable,
 ) -> int:
-    """Does the helper's own body carry bound evidence? One level: nested
-    helper calls inside the helper are not chased further."""
-    return summaries.get(
-        (mod.path, fn.name),
-        lambda: _HAS_BOUND if _own_bound_evidence(fn) else _NO_BOUND,
-    )
+    """Does the helper carry bound evidence — in its own body, or in any
+    helper it reaches over the call graph? Bottom-up through the
+    SummaryTable; recursive clusters read _NO_BOUND by SCC collapse (a
+    cycle of helpers deferring to each other proves nothing)."""
+
+    def compute() -> int:
+        if _own_bound_evidence(fn):
+            return _HAS_BOUND
+        for t_mod, t_fn in _call_targets(fn, mod, modules):
+            if t_fn is fn:
+                continue
+            if _helper_bound_summary(t_mod, t_fn, modules, summaries):
+                return _HAS_BOUND
+        return _NO_BOUND
+
+    return summaries.get((mod.path, fn.name), compute)
 
 
 def _has_bound(
     loop: ast.While,
     mod: Optional[ModuleInfo],
     modules: Dict[str, ModuleInfo],
-    summaries: Optional[ReturnSummaries],
+    summaries: Optional[SummaryTable],
 ) -> bool:
     """Any structural evidence the loop's retrying is bounded — in the
-    loop's own text, or one call away in a same-module helper."""
+    loop's own text, or any number of helper hops away on the call
+    graph."""
     if _own_bound_evidence(loop):
         return True
     if mod is None or summaries is None:
         return False
-    for sub in ast.walk(loop):
-        if not isinstance(sub, ast.Call):
-            continue
-        raw = dotted_name(sub.func)
-        target: Optional[Tuple[ModuleInfo, ast.FunctionDef]] = None
-        if raw is not None and "." not in raw:
-            target = resolve_local(mod, raw, modules)
-        elif (
-            isinstance(sub.func, ast.Attribute)
-            and isinstance(sub.func.value, ast.Name)
-            and sub.func.value.id == "self"
-        ):
-            # self._helper(): resolve against every class method table in
-            # the module (conservative: any method of that name counts)
-            for table in mod.index.methods.values():
-                if sub.func.attr in table:
-                    target = (mod, table[sub.func.attr])
-                    break
-        if target is not None:
-            if _helper_bound_summary(target[0], target[1], summaries):
-                return True
+    for t_mod, t_fn in _call_targets(loop, mod, modules):
+        if _helper_bound_summary(t_mod, t_fn, modules, summaries):
+            return True
     return False
 
 
@@ -175,7 +205,7 @@ def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]
         findings.append(
             Finding("RTY700", Severity.ERROR, path, 0, f"unparsable: {exc}")
         )
-    summaries = ReturnSummaries(default=_NO_BOUND)
+    summaries = SummaryTable(default=_NO_BOUND, graph=build_call_graph(modules))
     for path, mod in modules.items():
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.ExceptHandler):

@@ -11,9 +11,11 @@ pins that convention.
 Hosted on the shared dataflow core (analysis/core/): each traced function
 is analyzed over its CFG with a forward fixpoint, so value kinds merge
 correctly at branch joins and survive loop back-edges, and bare-name
-calls to same-module helpers resolve through one level of return-kind
+calls to same-module helpers resolve through call-graph return-kind
 summaries (``core.summaries``) instead of defaulting to static — a
-helper that hands back a ``jnp`` result is traced at the call site too.
+helper that hands back a ``jnp`` result is traced at the call site even
+when the jnp call sits several helper hops down (bottom-up propagation
+over the module-set call graph; recursive clusters collapse to static).
 
 Traced-function discovery (unchanged from the AST-walker generation):
 - decorated with ``jax.jit`` (directly or via ``partial(jax.jit, ...)``);
@@ -47,7 +49,8 @@ from .core.dataflow import Env, run_forward, sweep
 from .core.lattice import Lattice
 from .core.summaries import (
     ModuleInfo,
-    ReturnSummaries,
+    SummaryTable,
+    build_call_graph,
     load_modules,
     resolve_local,
 )
@@ -156,7 +159,7 @@ class _FunctionAnalysis:
         mod: ModuleInfo,
         modules: Dict[str, ModuleInfo],
         findings: List[Finding],
-        summaries: Optional[ReturnSummaries],
+        summaries: Optional[SummaryTable],
     ):
         self.mod = mod
         self.modules = modules
@@ -245,10 +248,12 @@ class _FunctionAnalysis:
             # method on a traced value yields a traced value
             if self.kind(node.func.value, env) == TRACED:
                 return TRACED
-        # one level of interprocedural reach: a bare-name call resolving
-        # to a same-module (or from-import sibling) helper returns the
-        # helper's summarized return kind — `hidden = make_mask(x)` is
-        # traced when make_mask returns a jnp result
+        # interprocedural reach on the call graph: a bare-name call
+        # resolving to a same-module (or from-import sibling) helper
+        # returns the helper's summarized return kind — `hidden =
+        # make_mask(x)` is traced when make_mask returns a jnp result,
+        # even when the jnp call sits several helper hops down
+        # (core.summaries: bottom-up propagation, SCC-collapsed cycles)
         raw = dotted_name(node.func)
         if (
             self.summaries is not None
@@ -455,14 +460,18 @@ def _return_kind(
     mod: ModuleInfo,
     fn: ast.FunctionDef,
     modules: Dict[str, ModuleInfo],
-    summaries: ReturnSummaries,
+    summaries: SummaryTable,
 ) -> int:
-    """One-level return-kind summary: the helper's own fixpoint with
-    nested helper calls UNRESOLVED (summaries=None), joined over every
-    return expression."""
+    """Return-kind summary on the call graph: the helper's own fixpoint
+    with nested helper calls resolved through the SAME table, joined
+    over every return expression — facts propagate bottom-up through any
+    number of hops, and the table's SCC collapse keeps recursive
+    clusters at the default."""
 
     def compute() -> int:
-        analysis = _FunctionAnalysis(mod, modules, findings=[], summaries=None)
+        analysis = _FunctionAnalysis(
+            mod, modules, findings=[], summaries=summaries
+        )
         init = _param_env(mod, fn, None)
         cfg = build_cfg(fn.body)
         envs = run_forward(cfg, init, analysis.transfer)
@@ -487,7 +496,7 @@ def check_function(
     fn: ast.FunctionDef,
     findings: List[Finding],
     modules: Optional[Dict[str, ModuleInfo]] = None,
-    summaries: Optional[ReturnSummaries] = None,
+    summaries: Optional[SummaryTable] = None,
     parent_env: Optional[Env] = None,
 ) -> None:
     modules = modules if modules is not None else {mod.path: mod}
@@ -509,7 +518,7 @@ def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]
     for mod in modules.values():
         mod.static_names = _collect_static_argnames(mod.tree)
 
-    summaries = ReturnSummaries(default=STATIC)
+    summaries = SummaryTable(default=STATIC, graph=build_call_graph(modules))
     traced = _traced_functions(modules)
     for mod in modules.values():
         for fname, fn in mod.index.functions.items():

@@ -1051,9 +1051,12 @@ class TpuSolver:
                 for ni, pid, _en in row_by_name.values()
                 if pid in cand_pids
             }
+            # the loop only ever returns None; which key trips it first is
+            # analysis: sanctioned[DET1101] any-mismatch early-return
             for key in dyn_keys:
                 catalog = topo.domain_groups.get(key)
                 universe = catalog.domains() if catalog is not None else set()
+                # analysis: sanctioned[DET1101] same any-mismatch shape
                 for ni in cand_rows:
                     en = self.oracle.existing_nodes[ni]
                     dom = enc._node_single_value(en, key)
@@ -2313,6 +2316,7 @@ class TpuSolver:
                 # one slot per reservation ID per claim (a rid may back
                 # offerings on several instance types), matching the
                 # kernel's res_rem[r] -= k
+                # analysis: sanctioned[DET1101] per-rid decrements commute
                 for rid in {o.reservation_id() for o in held}:
                     resv_ledger[rid] -= 1
                 claim.reserved_offerings = held

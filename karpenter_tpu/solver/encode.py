@@ -2501,7 +2501,10 @@ def _resolve_topology(
         if constraints:
             spec.host_cap = min(c for c, _ in constraints)
             spec.host_nsrc = len(constraints)
-            for d in {d for _, counts in constraints for d in counts}:
+            # sorted: host_counts insertion order is content-ordered, not
+            # hash-ordered (its fold key already sorts items; this keeps
+            # any future iteration deterministic too)
+            for d in sorted({d for _, counts in constraints for d in counts}):
                 residual = min(c - counts.get(d, 0) for c, counts in constraints)
                 spec.host_counts[d] = spec.host_cap - max(residual, 0)
         g.topo = spec
@@ -2663,6 +2666,8 @@ def _resolve_topology(
         admitted = _admit()
         if admitted is not None:
             kind, desc, thresh = admitted
+            # admitted flips to None when ANY owner fails; order never escapes
+            # analysis: sanctioned[DET1101] check-only loop
             for gi in owner_gis:
                 spec = group_specs.get(gi)
                 if spec is None or gi in demote:
@@ -2680,6 +2685,7 @@ def _resolve_topology(
                     break
             if admitted is not None:
                 if kind == "gate":
+                    # analysis: sanctioned[DET1101] one keyed add per owner
                     for gi in owner_gis:
                         key, allowed = desc
                         groups[gi].requirements.add(
@@ -2688,6 +2694,7 @@ def _resolve_topology(
                     topology.kernel_static_folds.append(tg)
                     # static gate: no carry, no partner coupling
                 else:
+                    # analysis: sanctioned[DET1101] per-owner writes commute
                     for gi in owner_gis:
                         spec = group_specs[gi]
                         is_self = gi in self_gis
@@ -2711,6 +2718,8 @@ def _resolve_topology(
                             spec.dmin0 = desc.min0
                             spec.dprior = desc.prior
                             spec.dreg = desc.reg
+                    # one append per contributor's own list, so the cross-gi
+                    # analysis: sanctioned[DET1101] order is unobservable
                     for gi in contrib_gis:
                         g = groups[gi]
                         if g.topo is None:
@@ -2720,6 +2729,8 @@ def _resolve_topology(
                         else:
                             g.topo.contrib_d.append(desc)
                     parties = owner_gis | contrib_gis
+                    # partners is read by keyed .get() only, so its
+                    # analysis: sanctioned[DET1101] insertion order never escapes
                     for gi in parties:
                         partners.setdefault(gi, set()).update(parties - {gi})
         if admitted is None:
@@ -2816,5 +2827,7 @@ def _resolve_topology(
         g for gi, g in enumerate(groups)
         if gi not in demote and gi not in merged
     ]
-    demoted_pods = [p for gi in demote for p in groups[gi].pods]
+    # sorted: the demoted-pod list escapes to the oracle side; hash-order
+    # here would reorder oracle processing across PYTHONHASHSEED twins
+    demoted_pods = [p for gi in sorted(demote) for p in groups[gi].pods]
     return kept, demoted_pods

@@ -152,6 +152,8 @@ class Vocab:
                 mask_row[i] = v not in r.values and _within(v, gt, lt)
             mask_row[-1] = self._band_has_unseen(kid, gt, lt) if (gt is not None or lt is not None) else True
         else:
+            # idempotent mask bit-sets keyed by interned value id, so the
+            # analysis: sanctioned[DET1101] order cannot reach the row bytes
             for v in r.values:
                 # concrete sets have bounds stripped by intersection, but a
                 # raw Gt-filtered In set may carry them

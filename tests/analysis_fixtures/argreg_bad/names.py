@@ -1,0 +1,10 @@
+"""Mini twin of the kernel-arg registry, seeded with one drift per
+ARG12xx rule (the other surfaces live in the sibling files, mirroring
+the real encode/mesh/native/residency module split)."""
+
+SOLVE_ARG_NAMES = ("g_count", "g_req", "t_def", "gk_w")
+
+
+class EncodedSnapshot:
+    def solve_args(self, gk_w):
+        return (self.g_count, self.g_req, self.t_def, gk_w)

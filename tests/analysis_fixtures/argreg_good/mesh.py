@@ -1,0 +1,23 @@
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+ARG_SPECS = {
+    "g_count": (),
+    "g_req": (),
+    "t_def": (AXIS_MODEL,),
+    "gk_w": (AXIS_DATA,),
+}
+
+
+def pad_axis(arr, axis, mult, fill=0):
+    return arr
+
+
+def pad_args_for_mesh(args, mesh):
+    model = mesh.devices.shape[1]
+    data = mesh.devices.shape[0]
+    byname = dict(zip(("g_count", "g_req", "t_def", "gk_w"), args))
+    for name in ("t_def",):
+        byname[name] = pad_axis(byname[name], 0, model)
+    byname["gk_w"] = pad_axis(byname["gk_w"], 0, data)
+    return tuple(byname[name] for name in ("g_count", "g_req", "t_def", "gk_w"))

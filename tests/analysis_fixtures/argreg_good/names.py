@@ -1,0 +1,9 @@
+"""Clean twin of argreg_bad: every surface consistent with the
+authority tuple."""
+
+SOLVE_ARG_NAMES = ("g_count", "g_req", "t_def", "gk_w")
+
+
+class EncodedSnapshot:
+    def solve_args(self, gk_w):
+        return (self.g_count, self.g_req, self.t_def, gk_w)

@@ -1,0 +1,2 @@
+def solve_core_native(g_count, g_req, t_def, gk_w, nmax=0):
+    return (g_count, g_req, t_def, gk_w, nmax)

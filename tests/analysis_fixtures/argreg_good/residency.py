@@ -1,0 +1,7 @@
+GROUP_ARGS = frozenset({"g_req", "gk_w"})
+GCOUNT_ARGS = frozenset({"g_count"})
+
+NO_ROW_DELTA = frozenset({"gk_w"})
+
+SCENARIO_BATCHED_ARGS = ("g_count",)
+SCENARIO_TOPO_BATCHED_ARGS = SCENARIO_BATCHED_ARGS + ("g_req",)

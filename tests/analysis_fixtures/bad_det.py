@@ -1,0 +1,46 @@
+"""Seeded-bad fixture for the DET11xx order-discipline pass.
+
+Every rule in the family appears at least once, including the
+multi-hop shape (an unordered value born two helper calls away) that
+needs the call-graph summaries to see.
+"""
+
+import os
+import random
+
+import numpy as np
+
+
+def intern_values(vocab):
+    seen = {"zone-a", "zone-b"}
+    for v in seen:                      # DET1101: hash-order interning
+        vocab.append(v)
+    frozen = list(seen)                 # DET1102: order-fixing freeze
+    record = ",".join(seen)             # DET1103: hash-ordered record
+    return frozen, record
+
+
+def env_sweep():
+    out = []
+    for key in os.environ:              # DET1101: environment order
+        out.append(key)
+    return out
+
+
+def _leaf_pool():
+    return {"us-east1", "us-west4"}
+
+
+def _hop():
+    return _leaf_pool()
+
+
+def multi_hop_consumer():
+    pool = _hop()
+    for zone in pool:                   # DET1101: two hops from the set
+        print(zone)
+
+
+def jitter(items):
+    random.shuffle(items)               # DET1104: unseeded global RNG
+    return np.random.rand(3)            # DET1104: legacy numpy global

@@ -1,0 +1,50 @@
+"""Clean twin of bad_det.py: every consumption is either canonicalized
+through sorted(), a commutative reduction, a seeded RNG instance, or a
+sanctioned boundary."""
+
+import random
+
+import numpy as np
+
+
+def intern_values(vocab):
+    seen = {"zone-a", "zone-b"}
+    for v in sorted(seen):
+        vocab.append(v)
+    frozen = sorted(seen)
+    record = ",".join(sorted(seen))
+    count = len(seen)  # commutative reduction: order-free by construction
+    return frozen, record, count
+
+
+def _leaf_pool():
+    return {"us-east1", "us-west4"}
+
+
+def _hop():
+    return _leaf_pool()
+
+
+def multi_hop_consumer():
+    for zone in sorted(_hop()):
+        print(zone)
+
+
+def member_check(pool, zone):
+    return zone in pool  # membership never observes order
+
+
+def seeded(seed):
+    rng = np.random.default_rng(seed)
+    det = random.Random(seed)
+    return rng.integers(0, 4), det.random()
+
+
+def boundary_count():
+    pool = {"zone-a", "zone-b"}
+    total = 0
+    # pure counting commutes, so the hash iteration
+    # analysis: sanctioned[DET1101] order cannot reach the sum
+    for _item in pool:
+        total += 1
+    return total

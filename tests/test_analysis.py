@@ -10,8 +10,10 @@ import pytest
 
 from karpenter_tpu.analysis import (
     all_rules,
+    args_registry,
     blocking,
     clock,
+    det,
     device,
     locks,
     obs,
@@ -1110,7 +1112,7 @@ class TestRuleRegistry:
         rules = all_rules()
         for prefix in (
             "TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6", "RTY7", "OBS8",
-            "DTX9", "CLK10", "STALE",
+            "DTX9", "CLK10", "DET11", "ARG12", "STALE",
         ):
             assert any(r.startswith(prefix) for r in rules), prefix
 
@@ -1149,6 +1151,8 @@ class TestRuleRegistry:
                 [fixture("bad_device_sync.py"), str(broken)]
             ),
             clock.check_paths([fixture("bad_clock.py"), str(broken)]),
+            det.check_paths([fixture("bad_det.py"), str(broken)]),
+            args_registry.check_paths([fixture("argreg_bad"), str(broken)]),
             # STALE001's seeded-bad shape is a marker matching nothing
             stale.audit(
                 [],
@@ -1415,3 +1419,364 @@ class TestCli:
             cwd="/",  # wrapper must work from any cwd
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestDetPass:
+    """DET11xx: unordered-source values must not reach order-sensitive
+    sinks un-sorted on the determinism surface (the PYTHONHASHSEED
+    interning class PR 14 fixed dynamically, closed statically)."""
+
+    REAL_TARGETS = [
+        os.path.join(REPO, "karpenter_tpu", "solver"),
+        os.path.join(REPO, "karpenter_tpu", "ops"),
+        os.path.join(REPO, "karpenter_tpu", "sim"),
+        os.path.join(REPO, "karpenter_tpu", "obs"),
+    ]
+
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = det.check_paths([fixture("bad_det.py")])
+        assert rules_of(findings) == {
+            "DET1101", "DET1102", "DET1103", "DET1104"
+        }
+        # the call-graph case: a set born two helper hops away still
+        # taints the consuming loop (line 40)
+        assert any(
+            f.rule == "DET1101" and f.line == 40 for f in findings
+        ), "multi-hop unordered return not flagged"
+
+    def test_clean_fixture_silent(self):
+        findings, sources = det.check_paths([fixture("good_det.py")])
+        kept, _, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        # the commutative-counting boundary is sanctioned, not hidden
+        assert [f.rule for f in sanctioned] == ["DET1101"]
+
+    def test_annotated_set_attribute_is_a_source(self, tmp_path):
+        src = (
+            "from typing import Set\n"
+            "class Req:\n"
+            "    def __init__(self, values):\n"
+            "        self.values: Set[str] = set(values)\n"
+            "def consume(r: Req):\n"
+            "    return list(r.values)\n"
+        )
+        p = tmp_path / "attr.py"
+        p.write_text(src)
+        findings, _ = det.check_paths([str(p)])
+        assert [(f.rule, f.line) for f in findings] == [("DET1102", 6)]
+
+    def test_dict_views_ordered_unless_dict_born_unordered(self, tmp_path):
+        # plain dicts are insertion-ordered (language guarantee since
+        # 3.7); only a dict BUILT from an unordered source inherits its
+        # hash order
+        src = (
+            'clean = {"a": 1, "b": 2}\n'
+            "for k in clean:\n"
+            "    print(k)\n"
+            'pairs = {("a", 1), ("b", 2)}\n'
+            "tainted = dict(pairs)\n"
+            "for k in tainted:\n"
+            "    print(k)\n"
+        )
+        p = tmp_path / "views.py"
+        p.write_text(src)
+        findings, _ = det.check_paths([str(p)])
+        assert [(f.rule, f.line) for f in findings] == [("DET1101", 6)]
+
+    def test_parameters_are_unknown_never_flagged(self, tmp_path):
+        # poison-to-unknown: the pass only flags values whose unordered
+        # origin it can SEE; an opaque parameter stays silent
+        src = (
+            "def f(maybe_set):\n"
+            "    for v in maybe_set:\n"
+            "        print(v)\n"
+            "    return list(maybe_set)\n"
+        )
+        p = tmp_path / "params.py"
+        p.write_text(src)
+        findings, _ = det.check_paths([str(p)])
+        assert findings == []
+
+    def test_recursive_helpers_collapse_to_unknown(self, tmp_path):
+        # a recursive helper cluster cannot vouch for what it returns:
+        # SCC collapse pins it to UNKNOWN, which never flags
+        src = (
+            "def ping(n):\n"
+            "    return pong(n - 1) if n else {1, 2}\n"
+            "def pong(n):\n"
+            "    return ping(n)\n"
+            "def consume():\n"
+            "    for v in ping(3):\n"
+            "        print(v)\n"
+        )
+        p = tmp_path / "cycle.py"
+        p.write_text(src)
+        findings, _ = det.check_paths([str(p)])
+        assert findings == []
+
+    def test_real_determinism_surface_clean(self):
+        """solver/ops/sim/obs carry no unsanctioned order-discipline
+        findings: the PR 14 interning fix stays sorted, the demote-set
+        materialization and host-count insertion are content-ordered,
+        and the provably-commutative set loops are sanctioned."""
+        findings, sources = det.check_paths(self.REAL_TARGETS)
+        kept, suppressed, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert suppressed == []
+        assert len(sanctioned) == 9
+        assert {f.rule for f in sanctioned} == {"DET1101"}
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = det.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"DET1100"}
+
+
+class TestArgsRegistryPass:
+    """ARG12xx: the kernel-arg registry's hand-aligned surfaces diffed
+    against SOLVE_ARG_NAMES."""
+
+    REAL_TARGETS = [
+        os.path.join(REPO, "karpenter_tpu", "solver", "encode.py"),
+        os.path.join(REPO, "karpenter_tpu", "parallel", "mesh.py"),
+        os.path.join(REPO, "karpenter_tpu", "solver", "residency.py"),
+        os.path.join(REPO, "karpenter_tpu", "native", "__init__.py"),
+        os.path.join(REPO, "karpenter_tpu", "ops", "solve.py"),
+    ]
+
+    def test_bad_twin_seeds_one_finding_per_rule(self):
+        findings, _ = args_registry.check_paths([fixture("argreg_bad")])
+        assert sorted(f.rule for f in findings) == [
+            "ARG1201", "ARG1202", "ARG1203", "ARG1204"
+        ]
+
+    def test_clean_twin_silent(self):
+        # also exercises the BASE + ("more",) scenario-tuple spelling
+        findings, _ = args_registry.check_paths([fixture("argreg_good")])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_real_registry_surfaces_consistent(self):
+        findings, _ = args_registry.check_paths(self.REAL_TARGETS)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_native_wrapper_missing_param(self, tmp_path):
+        (tmp_path / "names.py").write_text(
+            'SOLVE_ARG_NAMES = ("g_count", "g_req")\n'
+        )
+        (tmp_path / "native.py").write_text(
+            "def solve_core_native(g_count, nmax=0):\n"
+            "    return g_count\n"
+        )
+        findings, _ = args_registry.check_paths([str(tmp_path)])
+        assert [f.rule for f in findings] == ["ARG1201"]
+        assert "g_req" in findings[0].message
+
+    def test_scenario_batching_unknown_arg(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            'SOLVE_ARG_NAMES = ("g_count",)\n'
+            'SCENARIO_BATCHED_ARGS = ("g_count", "n_ghost")\n'
+        )
+        findings, _ = args_registry.check_paths([str(tmp_path)])
+        assert [f.rule for f in findings] == ["ARG1201"]
+        assert "n_ghost" in findings[0].message
+
+    def test_no_authority_in_scope_stays_quiet(self, tmp_path):
+        # a partial scan with no SOLVE_ARG_NAMES has nothing to diff
+        # against; guessing would make --changed-only noisy
+        (tmp_path / "residency.py").write_text(
+            'GROUP_ARGS = frozenset({"g_req"})\n'
+            'NO_ROW_DELTA = frozenset({"mystery"})\n'
+        )
+        findings, _ = args_registry.check_paths([str(tmp_path)])
+        assert findings == []
+
+
+class TestStaticMutations:
+    """The mutation contract: revert a known determinism/registry fix in
+    a scratch copy of the REAL module and the new passes must flag it —
+    proof the rules guard the actual shipped code paths, not just
+    hand-built fixtures."""
+
+    def test_vocab_unsorted_interning_flagged(self, tmp_path):
+        # PR 14's fix in the flesh: revert `sorted(r.values)` to bare
+        # set iteration in a copy of solver/vocab.py -> DET1101
+        src_path = os.path.join(
+            REPO, "karpenter_tpu", "solver", "vocab.py"
+        )
+        with open(src_path, encoding="utf-8") as fh:
+            text = fh.read()
+        assert text.count("for v in sorted(r.values):") == 1
+        mutated = text.replace(
+            "for v in sorted(r.values):", "for v in r.values:"
+        )
+        p = tmp_path / "vocab.py"
+        p.write_text(mutated)
+        bad_line = next(
+            i for i, line in enumerate(mutated.splitlines(), start=1)
+            if line.strip() == "for v in r.values:"
+        )
+        findings, _ = det.check_paths([str(p)])
+        assert any(
+            f.rule == "DET1101" and f.line == bad_line for f in findings
+        ), [f.render() for f in findings]
+
+    def test_group_args_member_drop_flagged(self, tmp_path):
+        # drop gk_g from residency.GROUP_ARGS in a copy: NO_ROW_DELTA
+        # still claims it, so the delta classes are inconsistent
+        src_path = os.path.join(
+            REPO, "karpenter_tpu", "solver", "residency.py"
+        )
+        encode_path = os.path.join(
+            REPO, "karpenter_tpu", "solver", "encode.py"
+        )
+        with open(src_path, encoding="utf-8") as fh:
+            text = fh.read()
+        # the GROUP_ARGS spelling ends the set literal with goff_idx and
+        # a trailing comma; NO_ROW_DELTA's does not — mutate ONLY the
+        # GROUP_ARGS occurrence
+        assert text.count('"gk_g", "gk_k", "gk_w", "goff_idx",') == 1
+        mutated = text.replace(
+            '"gk_g", "gk_k", "gk_w", "goff_idx",',
+            '"gk_k", "gk_w", "goff_idx",',
+        )
+        p = tmp_path / "residency.py"
+        p.write_text(mutated)
+        findings, _ = args_registry.check_paths([str(p), encode_path])
+        assert any(
+            f.rule == "ARG1203" and "gk_g" in f.message for f in findings
+        ), [f.render() for f in findings]
+        # and the unmutated pair is clean (the mutation is the signal)
+        clean, _ = args_registry.check_paths([src_path, encode_path])
+        assert clean == []
+
+
+class TestCallGraphCore:
+    """The tentpole's core contract: bottom-up summary propagation over
+    the module-set call graph, with recursion collapsed by SCC."""
+
+    def _load(self, tmp_path, src):
+        from karpenter_tpu.analysis.core.summaries import load_modules
+
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        modules, _, errors = load_modules([str(p)])
+        assert not errors
+        return str(p), modules
+
+    def test_scc_members_pinned_to_default(self, tmp_path):
+        from karpenter_tpu.analysis.core.summaries import (
+            SummaryTable, build_call_graph,
+        )
+
+        path, modules = self._load(
+            tmp_path,
+            "def leaf():\n"
+            "    return 1\n"
+            "def mid():\n"
+            "    return leaf()\n"
+            "def top():\n"
+            "    return mid()\n"
+            "def r1():\n"
+            "    return r2()\n"
+            "def r2():\n"
+            "    return r1()\n"
+            "def selfie():\n"
+            "    return selfie()\n",
+        )
+        graph = build_call_graph(modules)
+        assert (path, "r1") in graph.cycle_members
+        assert (path, "r2") in graph.cycle_members
+        assert (path, "selfie") in graph.cycle_members
+        assert (path, "top") not in graph.cycle_members
+        assert (path, "mid") not in graph.cycle_members
+        table = SummaryTable(default=0, graph=graph)
+        # cycle members read the default WITHOUT running compute
+        assert table.get((path, "r1"), lambda: 99) == 0
+        assert table.get((path, "selfie"), lambda: 99) == 0
+
+    def test_multi_hop_bottom_up_propagation(self, tmp_path):
+        from karpenter_tpu.analysis.core.summaries import (
+            SummaryTable, build_call_graph, resolve_local,
+        )
+
+        path, modules = self._load(
+            tmp_path,
+            "def leaf():\n"
+            "    return 7\n"
+            "def mid():\n"
+            "    return leaf()\n"
+            "def top():\n"
+            "    return mid()\n",
+        )
+        table = SummaryTable(default=0, graph=build_call_graph(modules))
+        mod = modules[path]
+
+        def summarize(name):
+            import ast
+
+            fn = mod.index.functions[name]
+
+            def compute():
+                # a toy client: a function's summary is 1 if it returns
+                # a constant, else whatever its bare-name callee summarizes
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Return):
+                        if isinstance(node.value, ast.Constant):
+                            return 1
+                        if isinstance(node.value, ast.Call):
+                            callee = node.value.func.id
+                            hit = resolve_local(mod, callee, modules)
+                            if hit is not None:
+                                return summarize(callee)
+                return 0
+
+            return table.get((path, name), compute)
+
+        # three hops: top -> mid -> leaf, driven entirely by compute
+        # thunks recursing through the shared table
+        assert summarize("top") == 1
+        # and the intermediate results were memoized bottom-up
+        assert table.get((path, "mid"), lambda: 99) == 1
+        assert table.get((path, "leaf"), lambda: 99) == 1
+
+
+class TestAnalyzerPerf:
+    """The analyzer's own runtime is a guarded budget: presubmit's slow
+    lane gives the full run 60 s of wall, and the SARIF run properties
+    are the regression record."""
+
+    def _sarif_run(self, *extra):
+        import json
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "karpenter_tpu.analysis",
+             "--format", "sarif", *extra],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        return json.loads(proc.stdout)["runs"][0]["properties"]
+
+    def test_full_run_within_presubmit_wall_budget(self):
+        props = self._sarif_run()
+        assert props["analysisSeconds"] < 60, (
+            "full analysis blew the presubmit 60s wall budget: "
+            f"{props['analysisSeconds']}s"
+        )
+        # per-pass budget: no single pass may hog the lane (each is a
+        # few seconds today; 20s means something superlinear landed)
+        for name, seconds in props["passSeconds"].items():
+            assert seconds < 20, f"pass {name} took {seconds}s (>20s budget)"
+        assert props["sequentialSeconds"] >= max(
+            props["passSeconds"].values()
+        )
+
+    def test_jobs_pool_runs_and_records(self):
+        props = self._sarif_run("--pass", "det", "--pass", "args",
+                                "--jobs", "2")
+        assert props["jobs"] == 2
+        assert set(props["passSeconds"]) == {"det", "args"}
+        # sequential-equivalent wall is recorded alongside the actual
+        # wall so the pool's effect is measurable per-artifact
+        assert props["sequentialSeconds"] == round(
+            sum(props["passSeconds"].values()), 4
+        )

@@ -15,7 +15,10 @@ python -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
 # to order-sensitive sinks, the PYTHONHASHSEED interning class) over the
 # determinism surface, kernel-arg registry consistency (ARG12xx — the
 # six hand-aligned SOLVE_ARG_NAMES surfaces), retry hygiene, lock
-# ordering / callback-under-lock in the store layer, blocking calls in
+# ordering / callback-under-lock over the whole threaded tree (LCK2xx),
+# guarded-by inference with explicit thread roots (GRD13xx) plus
+# check-then-act windows and cross-module lock-order cycles (ATM14xx)
+# over the same surface, blocking calls in
 # reconcile paths, schema<->CRD drift, kernel-twin parity skeletons
 # (pack / pack_classed / solve_core.cc via `// parity:` anchors), and
 # axis/dtype shape discipline over ops/+solver/ (karpenter_tpu/analysis/).
@@ -123,10 +126,12 @@ python -m pytest tests/e2e -k chaos -m 'not slow' -q
 
 # the race tier re-runs with different hash seeds (dict/set iteration
 # orders) — the deflake analog of the reference's `-race` + `-count`
-# loops (Makefile:78,85-93); the full suite above already ran it once
+# loops (Makefile:78,85-93); the full suite above already ran it once.
+# test_concurrency.py rides along: the warm-path churn hammer is the
+# dynamic half of the GRD/ATM static contract
 echo "== race tier (reseeded) =="
 for seed in 7 23; do
-  PYTHONHASHSEED=$seed python -m pytest tests/test_races.py -q
+  PYTHONHASHSEED=$seed python -m pytest tests/test_races.py tests/test_concurrency.py -q
 done
 
 # mechanical perf-regression gate (benchstat analog): enforced when a

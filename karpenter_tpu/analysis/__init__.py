@@ -33,10 +33,17 @@ documented RealClock seams — the replay-determinism contract,
 machine-checked), det.py (DET11xx: values born from unordered sources —
 sets, os.environ, unseeded RNG — flagged at order-sensitive sinks on
 the determinism surface; the PR 14 PYTHONHASHSEED interning bug, closed
-as a class), and args_registry.py (ARG12xx: the 56-argument kernel
+as a class), args_registry.py (ARG12xx: the 56-argument kernel
 registry diffed across its six hand-aligned surfaces — encode assembly,
 ARG_SPECS, mesh padding, native wrapper, residency delta classes,
-scenario batching).
+scenario batching), guarded.py (GRD13xx: per-class guarded-by inference
+over the whole threaded tree with explicitly modeled thread roots —
+mixed guarded/lock-free access, guarded state escaping by reference,
+locking callbacks published from ``__init__``), and atomicity.py
+(ATM14xx: check-then-act split across a lock release, plus the
+cross-module lock-order cycles the store-local LCK201 scan cannot
+connect — the machine-checked concurrency contract the multi-tenant
+solver service ratchets against).
 
 Run ``python -m karpenter_tpu.analysis`` (or hack/analyze.py); it exits
 nonzero on any new finding. Suppress with an inline
@@ -65,14 +72,14 @@ def all_rules() -> Dict[str, str]:
     pass modules. The meta-test in tests/test_analysis.py asserts each has
     a seeded-bad fixture; the SARIF writer uses it for rule metadata."""
     from . import (
-        args_registry, blocking, clock, det, device, locks, obs, parity,
-        retry, schema_drift, shapes, stale, tracer,
+        args_registry, atomicity, blocking, clock, det, device, guarded,
+        locks, obs, parity, retry, schema_drift, shapes, stale, tracer,
     )
 
     out: Dict[str, str] = {}
     for mod in (
         tracer, locks, blocking, schema_drift, parity, shapes, retry, obs,
-        device, clock, det, args_registry, stale,
+        device, clock, det, args_registry, guarded, atomicity, stale,
     ):
         out.update(getattr(mod, "RULES", {}))
     return out

@@ -3,8 +3,9 @@
 Default targets mirror the hazards each pass exists for:
 
 - tracer:   karpenter_tpu/ops, karpenter_tpu/solver
-- locks:    kube/store.py, kube/filestore.py, controllers/state.py,
-            solver/driver.py, metrics/registry.py
+- locks:    the threaded tree (solver/, ops/, controllers/, kube/, obs/,
+            metrics/, sim/, operator.py) — generalized from the store
+            layer in PR 19
 - blocking: karpenter_tpu/controllers, karpenter_tpu/__main__.py,
             solver/service.py, kube/leader.py
 - schema:   api/schema.py vs api/crds/
@@ -23,6 +24,11 @@ Default targets mirror the hazards each pass exists for:
 - args:     solver/encode.py, parallel/mesh.py, solver/residency.py,
             native/__init__.py, ops/solve.py (ARG12xx kernel-arg
             registry surfaces vs SOLVE_ARG_NAMES)
+- guarded:  the threaded tree (GRD13xx guarded-by inference: mixed
+            guarded/lock-free access, reference escapes, locking
+            __init__-published callbacks)
+- atomicity: the threaded tree (ATM14xx: check-then-act across a lock
+            release, cross-module lock-order cycles)
 
 Positional paths (with ``--pass``) override a pass's default targets so
 fixture suites can point a single pass at seeded-bad files. Exit status is
@@ -55,10 +61,12 @@ from typing import Dict, List, Optional, Set
 from . import (
     all_rules,
     args_registry,
+    atomicity,
     blocking,
     clock,
     det,
     device,
+    guarded,
     locks,
     obs,
     parity,
@@ -80,15 +88,23 @@ from .findings import (
 
 DEFAULT_BASELINE = os.path.join("hack", "analysis_baseline.txt")
 
+# the whole threaded surface: every layer that constructs a lock or a
+# thread — the GRD/ATM dogfood set, and (since PR 19) the locks pass's
+# generalized scope (it was store-local before)
+_THREADED_TREE = [
+    "karpenter_tpu/solver",
+    "karpenter_tpu/ops",
+    "karpenter_tpu/controllers",
+    "karpenter_tpu/kube",
+    "karpenter_tpu/obs",
+    "karpenter_tpu/metrics",
+    "karpenter_tpu/sim",
+    "karpenter_tpu/operator.py",
+]
+
 PASS_TARGETS = {
     "tracer": ["karpenter_tpu/ops", "karpenter_tpu/solver"],
-    "locks": [
-        "karpenter_tpu/kube/store.py",
-        "karpenter_tpu/kube/filestore.py",
-        "karpenter_tpu/controllers/state.py",
-        "karpenter_tpu/solver/driver.py",
-        "karpenter_tpu/metrics/registry.py",
-    ],
+    "locks": list(_THREADED_TREE),
     "blocking": [
         "karpenter_tpu/controllers",
         "karpenter_tpu/__main__.py",
@@ -153,6 +169,10 @@ PASS_TARGETS = {
         "karpenter_tpu/native/__init__.py",
         "karpenter_tpu/ops/solve.py",
     ],
+    # guarded-by inference (GRD13xx) and atomicity/lock-order (ATM14xx)
+    # over the same threaded tree the generalized locks pass scans
+    "guarded": list(_THREADED_TREE),
+    "atomicity": list(_THREADED_TREE),
 }
 
 # passes whose targets are a comparison pair (or cross-file registry),
@@ -196,6 +216,10 @@ def _run_pass(name: str, targets: List[str]):
         return det.check_paths(targets)
     if name == "args":
         return args_registry.check_paths(targets)
+    if name == "guarded":
+        return guarded.check_paths(targets)
+    if name == "atomicity":
+        return atomicity.check_paths(targets)
     raise ValueError(f"unknown pass {name!r}")
 
 
@@ -205,7 +229,8 @@ PASS_MODULES = {
     "tracer": tracer, "locks": locks, "blocking": blocking,
     "schema": schema_drift, "parity": parity, "shapes": shapes,
     "retry": retry, "obs": obs, "device": device, "clock": clock,
-    "det": det, "args": args_registry,
+    "det": det, "args": args_registry, "guarded": guarded,
+    "atomicity": atomicity,
 }
 
 
@@ -329,8 +354,9 @@ def main(argv=None) -> int:
         "tracer-safety, lock ordering, blocking calls, schema drift, "
         "kernel-twin parity, axis/dtype shape discipline, retry hygiene, "
         "observability hygiene, device-residency (DTX9xx), clock "
-        "discipline (CLK10xx), order discipline (DET11xx), and "
-        "kernel-arg registry consistency (ARG12xx)",
+        "discipline (CLK10xx), order discipline (DET11xx), kernel-arg "
+        "registry consistency (ARG12xx), guarded-by inference "
+        "(GRD13xx), and atomicity/lock-order (ATM14xx)",
     )
     parser.add_argument(
         "paths", nargs="*",

@@ -22,6 +22,14 @@ Mechanics (AST only, no imports):
   recursing through same-set method calls with dynamic dispatch from the
   entry class.
 
+Since PR 19 the pass loads its file set through the call-graph core
+(``core.summaries.load_modules`` — one parse per file, shared with the
+GRD/ATM passes) and runs tree-wide, not just over the store layer.
+``build_analyzer`` exposes the walked acquisition graph to the atomicity
+pass: LCK201 claims cycles whose locks live in ONE module; cycles
+spanning modules are ATM1402's (atomicity.py), so the two rules
+partition the cycle space.
+
 Rules:
 - LCK201: cycle in the acquisition-order graph (ABBA deadlock)
 - LCK202: watcher/callback invoked while a lock is held
@@ -33,7 +41,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
-from .astutil import dotted_name, import_aliases, iter_py_files, parse_file
+from .astutil import dotted_name, import_aliases
+from .core.summaries import load_modules
 from .findings import Finding, Severity, SourceFile
 
 RULES = {
@@ -544,7 +553,18 @@ class _Analyzer:
 
     # -- cycle detection ---------------------------------------------------
 
-    def detect_cycles(self) -> None:
+    def detect_cycles(
+        self, rule: str = "LCK201", cross_module_only: bool = False
+    ) -> None:
+        """Report acquisition-order cycles.
+
+        The default (LCK201) reports cycles whose locks all live in one
+        module — the store-layer ABBA class this pass was built for. With
+        ``cross_module_only`` the SAME graph yields the complementary set
+        (cycles spanning ≥2 modules) under the caller's rule id: the
+        atomicity pass (ATM1402) runs the tree-wide walk and claims those,
+        so the two rules partition the cycle space instead of
+        double-reporting one deadlock."""
         graph: Dict[str, Set[str]] = {}
         for (a, b) in self.edges:
             graph.setdefault(a, set()).add(b)
@@ -558,16 +578,28 @@ class _Analyzer:
                         continue
                     seen.add(key)
                     cycle = path + [start]
+                    modules = {p.partition("::")[0] for p in path}
+                    if cross_module_only != (len(modules) > 1):
+                        continue
                     site = self.edges.get((path[-1], start)) or \
                         self.edges.get((path[0], path[1]), ("", 0))
-                    self.findings.append(
-                        Finding(
-                            "LCK201", Severity.ERROR, site[0], site[1],
+                    if cross_module_only:
+                        msg = (
+                            "interprocedural lock-order cycle across "
+                            "modules: "
+                            + " -> ".join(_short(p) for p in cycle)
+                            + " (ABBA deadlock potential; keep one global "
+                            "acquisition order across layers)"
+                        )
+                    else:
+                        msg = (
                             "lock-order cycle: "
                             + " -> ".join(_short(p) for p in cycle)
                             + " (ABBA deadlock; keep a single global "
-                            "acquisition order)",
+                            "acquisition order)"
                         )
+                    self.findings.append(
+                        Finding(rule, Severity.ERROR, site[0], site[1], msg)
                     )
                 elif nxt not in path:
                     dfs(start, nxt, path + [nxt])
@@ -583,31 +615,34 @@ def _short(ident: str) -> str:
     return f"{os.path.basename(path)}::{name}"
 
 
-def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
-    """Run the lock-order pass over the given files/directories."""
-    files: List[_File] = []
-    sources: Dict[str, SourceFile] = {}
-    parse_findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        try:
-            src, tree = parse_file(path)
-        except (OSError, SyntaxError) as exc:
-            parse_findings.append(
-                Finding("LCK200", Severity.ERROR, path, 0, f"unparsable: {exc}")
-            )
-            continue
-        f = _File(path, src, tree)
-        files.append(f)
-        sources[path] = src
+def build_analyzer(modules) -> "_Analyzer":
+    """A fully-walked acquisition analyzer over core-loaded modules.
 
+    Shared entry for this pass and the atomicity pass (ATM1402): both
+    need the same held-set symbolic walk and the same acquisition-edge
+    graph; they differ only in which cycle population they claim. The
+    walk also emits LCK202/LCK203 findings into ``analyzer.findings`` —
+    callers keep or drop those by rule."""
+    files = [_File(m.path, m.src, m.tree) for m in modules.values()]
     analyzer = _Analyzer(files)
-    analyzer.findings.extend(parse_findings)
     for f in files:
         for cls in f.classes.values():
             for mname, method in cls.methods.items():
                 analyzer.analyze_method(f, cls, method, held=())
         for fn in f.functions.values():
             analyzer.analyze_method(f, None, fn, held=())
+    return analyzer
+
+
+def check_paths(paths: List[str]) -> Tuple[List[Finding], Dict[str, SourceFile]]:
+    """Run the lock-order pass over the given files/directories."""
+    modules, sources, errors = load_modules(paths)
+    parse_findings = [
+        Finding("LCK200", Severity.ERROR, path, 0, f"unparsable: {exc}")
+        for path, exc in errors
+    ]
+    analyzer = build_analyzer(modules)
+    analyzer.findings = parse_findings + analyzer.findings
     analyzer.detect_cycles()
     # one finding per (rule, site): entry paths multiply otherwise
     unique: Dict[Tuple[str, str, int], Finding] = {}

@@ -246,6 +246,7 @@ class Cluster:
         self._pod_acks: Dict[str, float] = {}  # uid -> first provisioner sight
         self._pods_schedulable_times: Dict[str, float] = {}  # uid -> success time
         self._pods_scheduling_attempted: Dict[str, float] = {}  # uid -> first attempt
+        # analysis: sanctioned[GRD1303] informer callback registered before the initial list; the store notifies outside its own lock (kube/store.py) so _on_event taking Cluster._lock cannot deadlock — pinned by tests/test_races.py
         client.watch(self._on_event)
         self._synced_once = False
         self._unsynced_since: Optional[float] = None

@@ -42,14 +42,19 @@ class Counter(Metric):
             self._values[key] = self._values.get(key, 0.0) + value
 
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
 
     def clear(self) -> None:
         with self._lock:
             self._values.clear()
 
     def collect(self):
-        return [("counter", self.name, dict(k), v) for k, v in self._values.items()]
+        # snapshot under the lock: a concurrent inc() inserting a new
+        # label key mid-iteration is a RuntimeError (GRD1301 dogfood)
+        with self._lock:
+            items = list(self._values.items())
+        return [("counter", self.name, dict(k), v) for k, v in items]
 
 
 class Gauge(Metric):
@@ -74,14 +79,17 @@ class Gauge(Metric):
                 del self._values[key]
 
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
 
     def clear(self) -> None:
         with self._lock:
             self._values.clear()
 
     def collect(self):
-        return [("gauge", self.name, dict(k), v) for k, v in self._values.items()]
+        with self._lock:
+            items = list(self._values.items())
+        return [("gauge", self.name, dict(k), v) for k, v in items]
 
 
 class Histogram(Metric):
@@ -103,10 +111,12 @@ class Histogram(Metric):
             self._totals[key] = self._totals.get(key, 0) + 1
 
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
-        return self._totals.get(_label_key(labels), 0)
+        with self._lock:
+            return self._totals.get(_label_key(labels), 0)
 
     def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
-        return self._sums.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._sums.get(_label_key(labels), 0.0)
 
     def clear(self) -> None:
         with self._lock:
@@ -115,9 +125,11 @@ class Histogram(Metric):
             self._totals.clear()
 
     def collect(self):
+        with self._lock:
+            pairs = [(k, self._totals[k], self._sums[k]) for k in self._totals]
         return [
-            ("histogram", self.name, dict(k), {"count": self._totals[k], "sum": self._sums[k]})
-            for k in self._totals
+            ("histogram", self.name, dict(k), {"count": total, "sum": s})
+            for k, total, s in pairs
         ]
 
 
@@ -155,8 +167,14 @@ class Registry:
             self._metrics.append(metric)
 
     def collect(self):
+        # snapshot the metric list under the registry lock (a concurrent
+        # register() grows it); each metric then snapshots its own series
+        # under its own lock — registry -> metric is the one acquisition
+        # order (render() below follows it too)
+        with self._lock:
+            metrics = list(self._metrics)
         out = []
-        for m in self._metrics:
+        for m in metrics:
             out.extend(m.collect())
         return out
 

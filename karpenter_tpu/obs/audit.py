@@ -122,7 +122,8 @@ class AuditLog:
             pass
 
     def __len__(self) -> int:
-        return len(self._records)
+        with self._lock:
+            return len(self._records)
 
     def last(self) -> Optional[AuditRecord]:
         with self._lock:

@@ -251,7 +251,9 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._finished.clear()
-        self.dropped = 0
+            # under the same lock as _finish's `dropped +=`: a reset
+            # racing a drop must not resurrect the pre-clear count
+            self.dropped = 0
 
     def phase_totals(self) -> Dict[str, float]:
         """{span name: summed duration seconds} over the finished buffer —

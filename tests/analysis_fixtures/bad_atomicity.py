@@ -1,0 +1,30 @@
+"""Seeded atomicity violations: a check-then-act split across a lock
+release, and (with bad_atomicity_peer.py) one half of a cross-module
+lock-order cycle."""
+
+import threading
+
+
+class HintSlot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hint = 0
+
+    def bump(self, n):
+        with self._lock:
+            cur = self._hint
+        if n > cur:  # decision on the stale read, lock released
+            with self._lock:
+                self._hint = n  # ATM1401: the gap loses another's bump
+
+
+class Staging:
+    """Acquires staging -> registry (the peer closes the cycle)."""
+
+    def __init__(self, registry: "Registry" = None):
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    def stage(self):
+        with self._lock:
+            self._registry.publish()  # ATM1402 half: staging -> registry

@@ -1,0 +1,18 @@
+"""The other module of the seeded cross-module lock-order cycle: the
+registry acquires its own lock and calls back into Staging.stage()."""
+
+import threading
+
+
+class Registry:
+    def __init__(self, staging: "Staging" = None):
+        self._lock = threading.Lock()
+        self._staging = staging
+
+    def publish(self):
+        with self._lock:
+            return True
+
+    def rebuild(self):
+        with self._lock:
+            self._staging.stage()  # ATM1402 half: registry -> staging

@@ -1,0 +1,43 @@
+"""Seeded guarded-by violations: a mixed guarded/unguarded attribute, a
+guarded container escaping by reference, and an __init__-published
+callback that acquires the lock."""
+
+import threading
+
+
+class Buffered:
+    """`_items` is guarded in add() but raced in flush()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def flush(self):
+        out = list(self._items)  # GRD1301: lock-free read of guarded state
+        self._items.clear()  # lock-free write widens the race
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return self._items  # GRD1302: guarded list escapes by reference
+
+
+class Publisher:
+    def __init__(self, bus):
+        self._lock = threading.Lock()
+        self._state = {}
+        bus.subscribe(self._on_event)  # GRD1303: published callback locks
+
+    def _on_event(self, evt):
+        with self._lock:
+            self._state[evt] = True
+
+    def get(self, key):
+        with self._lock:
+            return self._state.get(key)

@@ -1,0 +1,42 @@
+"""The bad_atomicity.py shapes done right: check and act in one critical
+section (or a commutative merge under the second lock), and a single
+global acquisition order."""
+
+import threading
+
+
+class HintSlot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hint = 0
+
+    def bump(self, n):
+        with self._lock:
+            # decision and write share the critical section
+            if n > self._hint:
+                self._hint = n
+
+    def bump_merge(self, n):
+        with self._lock:
+            self._hint = max(self._hint, n)
+
+
+class Staging:
+    """Acquires staging -> registry; the registry never calls back."""
+
+    def __init__(self, registry: "Registry" = None):
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    def stage(self):
+        with self._lock:
+            self._registry.publish()
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def publish(self):
+        with self._lock:
+            return True

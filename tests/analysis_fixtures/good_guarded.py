@@ -1,0 +1,48 @@
+"""The bad_guarded.py shapes done right: every access under the lock,
+escapes copied out, callbacks published after construction (and the
+published one takes no lock)."""
+
+import threading
+
+
+class Buffered:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def flush(self):
+        with self._lock:
+            out = list(self._items)
+            self._items.clear()
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)  # copied out: no reference escape
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def start(self, bus):
+        # published after construction, and the callback is lock-free
+        bus.subscribe(self._on_event)
+
+    def _on_event(self, evt):
+        self.enqueue(evt)
+
+    def enqueue(self, evt):
+        with self._lock:
+            self._state[evt] = True
+
+    def get(self, key):
+        with self._lock:
+            return self._state.get(key)

@@ -11,10 +11,12 @@ import pytest
 from karpenter_tpu.analysis import (
     all_rules,
     args_registry,
+    atomicity,
     blocking,
     clock,
     det,
     device,
+    guarded,
     locks,
     obs,
     parity,
@@ -164,6 +166,168 @@ class TestLocksPass:
         assert cycles, "ABBA cycle between Store and Index not detected"
         assert "Store._lock" in cycles[0].message
         assert "Index._lock" in cycles[0].message
+
+    def test_real_threaded_tree_only_suppressed_sites(self):
+        # the pass generalized tree-wide (ISSUE 19): the whole threaded
+        # surface, not just the five store-layer files, carries nothing
+        # but the documented inline-suppressed callback sites
+        from karpenter_tpu.analysis.cli import _THREADED_TREE
+
+        targets = [os.path.join(REPO, p) for p in _THREADED_TREE]
+        findings, sources = locks.check_paths(targets)
+        kept, _suppressed, _sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+
+
+class TestGuardedPass:
+    """GRD13xx: per-class guarded-by inference with explicit thread
+    roots — mixed guarded/lock-free access reachable from two sides,
+    guarded mutable state escaping by reference, locking callbacks
+    published from ``__init__``."""
+
+    def test_bad_fixture_flags_every_rule(self):
+        findings, _ = guarded.check_paths([fixture("bad_guarded.py")])
+        assert sorted((f.rule, f.line) for f in findings) == [
+            ("GRD1301", 22), ("GRD1302", 28), ("GRD1303", 35),
+        ], [f.render() for f in findings]
+        # the inferred guard is named in the mixed-access message
+        mixed = next(f for f in findings if f.rule == "GRD1301")
+        assert "_lock" in mixed.message and "_items" in mixed.message
+
+    def test_clean_fixture_silent(self):
+        findings, _ = guarded.check_paths([fixture("good_guarded.py")])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_real_threaded_tree_single_sanctioned_site(self):
+        """The dogfood contract: the whole threaded surface is clean save
+        the ONE documented boundary — Cluster.__init__ registering its
+        informer callback (the store notifies outside its own lock, so
+        the callback taking Cluster._lock cannot deadlock; pinned
+        dynamically by tests/test_races.py)."""
+        from karpenter_tpu.analysis.cli import _THREADED_TREE
+
+        targets = [os.path.join(REPO, p) for p in _THREADED_TREE]
+        findings, sources = guarded.check_paths(targets)
+        kept, suppressed, sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+        assert suppressed == []
+        assert [f.rule for f in sanctioned] == ["GRD1303"]
+        assert sanctioned[0].path.endswith("state.py")
+
+    def test_private_helper_not_an_entry(self, tmp_path):
+        # a private helper only ever reached from a locked public method
+        # is NOT its own thread entry: walking it lock-free used to yield
+        # a bogus unguarded access (the dogfood FP class)
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def add(self, x):\n"
+            "        with self._lock:\n"
+            "            self._index(x)\n"
+            "    def size(self):\n"
+            "        with self._lock:\n"
+            "            return len(self._items)\n"
+            "    def _index(self, x):\n"
+            "        self._items.append(x)\n"
+        )
+        p = tmp_path / "box.py"
+        p.write_text(src)
+        findings, _ = guarded.check_paths([str(p)])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_thread_target_makes_private_method_an_entry(self, tmp_path):
+        # ...but the SAME helper named as a Thread target is a root: its
+        # lock-free writes now race the guarded public reads
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._pump).start()\n"
+            "    def size(self):\n"
+            "        with self._lock:\n"
+            "            return len(self._items)\n"
+            "    def _pump(self):\n"
+            "        self._items.append(1)\n"
+        )
+        p = tmp_path / "box.py"
+        p.write_text(src)
+        findings, _ = guarded.check_paths([str(p)])
+        assert any(
+            f.rule == "GRD1301" and "_items" in f.message for f in findings
+        ), [f.render() for f in findings]
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = guarded.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"GRD1300"}
+
+
+class TestAtomicityPass:
+    """ATM14xx: check-then-act split across a lock release, and the
+    cross-module lock-order cycles LCK201's module-local scan cannot
+    connect."""
+
+    def test_bad_fixtures_flag_every_rule(self):
+        findings, _ = atomicity.check_paths(
+            [fixture("bad_atomicity.py"), fixture("bad_atomicity_peer.py")]
+        )
+        assert rules_of(findings) == {"ATM1401", "ATM1402"}
+        cta = next(f for f in findings if f.rule == "ATM1401")
+        assert cta.line == 17 and cta.path.endswith("bad_atomicity.py")
+        assert "_hint" in cta.message and "lost" in cta.message
+        cyc = next(f for f in findings if f.rule == "ATM1402")
+        assert "across modules" in cyc.message
+
+    def test_cross_module_cycle_needs_both_halves(self):
+        # scanning one module alone sees no cycle: the whole point of
+        # hosting ATM1402 on the tree-wide call-graph core
+        findings, _ = atomicity.check_paths([fixture("bad_atomicity.py")])
+        assert "ATM1402" not in rules_of(findings)
+
+    def test_clean_fixture_silent(self):
+        findings, _ = atomicity.check_paths([fixture("good_atomicity.py")])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_real_threaded_tree_clean(self):
+        from karpenter_tpu.analysis.cli import _THREADED_TREE
+
+        targets = [os.path.join(REPO, p) for p in _THREADED_TREE]
+        findings, sources = atomicity.check_paths(targets)
+        kept, _suppressed, _sanctioned = partition_findings(findings, sources)
+        assert kept == [], [f.render() for f in kept]
+
+    def test_rebound_local_severs_taint(self, tmp_path):
+        # a local recomputed after the release no longer carries the
+        # stale read: deciding on the fresh value is fine
+        src = (
+            "import threading\n"
+            "class Slot:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._v = 0\n"
+            "    def bump(self, n):\n"
+            "        with self._lock:\n"
+            "            cur = self._v\n"
+            "        cur = n - 1\n"
+            "        if n > cur:\n"
+            "            with self._lock:\n"
+            "                self._v = n\n"
+        )
+        p = tmp_path / "slot.py"
+        p.write_text(src)
+        findings, _ = atomicity.check_paths([str(p)])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_unparsable_file_reported(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def oops(:\n")
+        findings, _ = atomicity.check_paths([str(tmp_path)])
+        assert rules_of(findings) == {"ATM1400"}
 
 
 class TestBlockingPass:
@@ -948,6 +1112,28 @@ class TestDataflowMigration:
         ("RTY701", 9), ("RTY701", 16), ("RTY701", 24), ("RTY701", 32),
         ("RTY702", 29), ("RTY702", 37),
     ]
+    # the LCK migration (ISSUE 19: parse via the shared load_modules, the
+    # cycle scan parameterized for ATM1402's cross-module half) pins the
+    # MESSAGES too: detect_cycles' rendering is shared with ATM1402, so a
+    # wording drift here would silently rewrite the LCK201 contract
+    PRE_MIGRATION_LOCKS = [
+        (
+            "LCK201", 33,
+            "lock-order cycle: bad_locks.py::Index._lock -> "
+            "bad_locks.py::Store._lock -> bad_locks.py::Index._lock "
+            "(ABBA deadlock; keep a single global acquisition order)",
+        ),
+        (
+            "LCK202", 22,
+            "callback 'handler(...)' invoked while holding "
+            "bad_locks.py::Store._lock; release the lock before notifying",
+        ),
+        (
+            "LCK203", 47,
+            "non-reentrant lock bad_locks.py::Plain._lock re-acquired "
+            "while already held",
+        ),
+    ]
 
     def test_tracer_fixture_identical_pre_post_migration(self):
         findings, _ = tracer.check_paths([fixture("bad_tracer.py")])
@@ -960,6 +1146,14 @@ class TestDataflowMigration:
         assert sorted(
             (f.rule, f.line) for f in findings
         ) == self.PRE_MIGRATION_RETRY
+
+    def test_locks_fixture_identical_pre_post_migration(self):
+        findings, _ = locks.check_paths([fixture("bad_locks.py")])
+        assert sorted(
+            (f.rule, f.line, f.message) for f in findings
+        ) == self.PRE_MIGRATION_LOCKS
+        clean, _ = locks.check_paths([fixture("good_locks.py")])
+        assert clean == []
 
     def test_tracer_interprocedural_reach_through_helper(self, tmp_path):
         # what the migration BUYS: a helper returning a jnp result makes
@@ -1112,7 +1306,7 @@ class TestRuleRegistry:
         rules = all_rules()
         for prefix in (
             "TRC1", "LCK2", "BLK3", "SCH4", "PAR5", "SHP6", "RTY7", "OBS8",
-            "DTX9", "CLK10", "DET11", "ARG12", "STALE",
+            "DTX9", "CLK10", "DET11", "ARG12", "GRD13", "ATM14", "STALE",
         ):
             assert any(r.startswith(prefix) for r in rules), prefix
 
@@ -1153,6 +1347,14 @@ class TestRuleRegistry:
             clock.check_paths([fixture("bad_clock.py"), str(broken)]),
             det.check_paths([fixture("bad_det.py"), str(broken)]),
             args_registry.check_paths([fixture("argreg_bad"), str(broken)]),
+            guarded.check_paths([fixture("bad_guarded.py"), str(broken)]),
+            atomicity.check_paths(
+                [
+                    fixture("bad_atomicity.py"),
+                    fixture("bad_atomicity_peer.py"),
+                    str(broken),
+                ]
+            ),
             # STALE001's seeded-bad shape is a marker matching nothing
             stale.audit(
                 [],
@@ -1317,6 +1519,8 @@ class TestCli:
         [
             ("device", "bad_device_sync.py"),
             ("clock", "bad_clock.py"),
+            ("guarded", "bad_guarded.py"),
+            ("atomicity", "bad_atomicity.py"),
         ],
     )
     def test_cli_nonzero_on_new_families(self, pass_name, target):
@@ -1649,6 +1853,38 @@ class TestStaticMutations:
         clean, _ = args_registry.check_paths([src_path, encode_path])
         assert clean == []
 
+    def test_lock_deletion_in_real_audit_log_flagged(self, tmp_path):
+        # delete ONE `with self._lock:` from a copy of the real AuditLog
+        # (record()'s, the append path) and the guarded-by inference must
+        # notice: _records/_seq stay guarded everywhere else, so the now
+        # lock-free writes are exactly the GRD1301 mixed-access shape
+        src_path = os.path.join(REPO, "karpenter_tpu", "obs", "audit.py")
+        with open(src_path, encoding="utf-8") as fh:
+            text = fh.read()
+        anchor = (
+            '        fields.setdefault("timestamp", self._now())\n'
+            "        with self._lock:\n"
+        )
+        assert text.count(anchor) == 1
+        mutated = text.replace(
+            anchor,
+            '        fields.setdefault("timestamp", self._now())\n'
+            "        if True:\n",
+        )
+        p = tmp_path / "audit.py"
+        p.write_text(mutated)
+        findings, _ = guarded.check_paths([str(p)])
+        flagged = {
+            m for f in findings if f.rule == "GRD1301"
+            for m in ("_records", "_seq") if m in f.message
+        }
+        assert flagged == {"_records", "_seq"}, [
+            f.render() for f in findings
+        ]
+        # the unmutated module is clean (the deletion is the signal)
+        clean, _ = guarded.check_paths([src_path])
+        assert clean == [], [f.render() for f in clean]
+
 
 class TestCallGraphCore:
     """The tentpole's core contract: bottom-up summary propagation over
@@ -1780,3 +2016,13 @@ class TestAnalyzerPerf:
         assert props["sequentialSeconds"] == round(
             sum(props["passSeconds"].values()), 4
         )
+
+    def test_jobs_pool_covers_concurrency_passes(self):
+        # the GRD/ATM passes ride the same worker pool and record their
+        # per-pass wall in the SARIF run properties (the presubmit slow
+        # lane's regression record)
+        props = self._sarif_run("--pass", "guarded", "--pass", "atomicity",
+                                "--jobs", "2")
+        assert set(props["passSeconds"]) == {"guarded", "atomicity"}
+        for seconds in props["passSeconds"].values():
+            assert seconds < 20

@@ -1,0 +1,352 @@
+"""Warm-path concurrency stress: the GRD/ATM passes' dynamic counterpart.
+
+The static tier (analysis/guarded.py, analysis/atomicity.py) proves the
+lock discipline on paper; this suite hammers the actual shared warm-path
+objects — one EncodeCache and its DeviceResidentArgs under two solving
+threads, a shared DispatchQueue driven submit/drain from both sides, the
+metrics registry scraped mid-update — and pins the contract the passes
+guard: decisions byte-identical to serial replay, zero warm-state
+corruption, no torn snapshots. Everything is seeded; a failure here is a
+real race, not a flake.
+"""
+
+import sys
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.kube import Client, TestClock
+
+from helpers import make_nodepool, make_pods
+
+
+def _decision_signature(results):
+    """Canonical, order-independent serialization of one solve's decisions
+    (same shape tests/test_delta_encode.py pins for the delta path)."""
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+                repr(sorted(map(repr, c.requirements))),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(
+            (en.name, tuple(sorted(p.uid for p in en.pods)))
+            for en in results.existing_nodes
+        ),
+        sorted(results.pod_errors),
+    )
+
+
+class TestSharedCacheChurn:
+    N_THREADS = 2
+    N_ITERS = 3
+
+    def test_threaded_decisions_byte_identical_to_serial(self):
+        """Two threads churning through ONE shared EncodeCache must make
+        exactly the decisions a serial replay of the same pod batches
+        makes, and the warm state they leave behind must still serve a
+        clean follow-up solve — cache contention may cost encode reuse,
+        never correctness."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import TpuSolver
+        from karpenter_tpu.solver.driver import EncodeCache, SolverConfig
+
+        pools = [make_nodepool()]
+        its = {pools[0].name: corpus.generate(12)}
+        # the SAME pod objects feed both runs: uids are generated at
+        # construction, so rebuilding batches would trivially diverge
+        batches = {
+            (t, i): make_pods(8 + 3 * t + 2 * i, cpu="1", memory="1Gi")
+            for t in range(self.N_THREADS)
+            for i in range(self.N_ITERS)
+        }
+
+        def solve_one(cache, pods):
+            topo = Topology(Client(TestClock()), [], pools, its, pods)
+            # relax=False pins the exact-kernel route (the bulk pre-solver
+            # would swallow these identical-pod batches and skip the
+            # warm-path machinery under test)
+            solver = TpuSolver(
+                pools, its, topo,
+                config=SolverConfig(relax=False),
+                encode_cache=cache,
+            )
+            r = solver.solve(pods)
+            assert r.all_pods_scheduled(), r.pod_errors
+            return _decision_signature(r)
+
+        # serial oracle: fresh cache, every batch in order
+        serial_cache = EncodeCache()
+        serial = {
+            key: solve_one(serial_cache, pods)
+            for key, pods in sorted(batches.items())
+        }
+
+        shared = EncodeCache()
+        threaded = {}
+        errors = []
+        barrier = threading.Barrier(self.N_THREADS)
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)  # injected yields
+
+        def churn(tid):
+            try:
+                barrier.wait()
+                for i in range(self.N_ITERS):
+                    threaded[(tid, i)] = solve_one(shared, batches[(tid, i)])
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=churn, args=(t,))
+                for t in range(self.N_THREADS)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+        finally:
+            sys.setswitchinterval(old_interval)
+        assert not errors, errors
+        assert threaded == serial
+
+        # zero warm-state corruption: the contended cache must still
+        # produce the canonical answer for a batch it has never seen
+        probe = make_pods(11, cpu="1", memory="1Gi")
+        want = solve_one(EncodeCache(), probe)
+        assert solve_one(shared, probe) == want
+        # and the adaptive NMAX hint survived the storm (a torn update
+        # would re-trigger the overflow ladder on the next big solve)
+        assert shared.cache.get("nmax_hint") is not None
+
+
+class TestDispatchQueueConcurrent:
+    def test_submit_drain_from_two_threads_serialized(self):
+        """DispatchQueue is documented driver-serialized (no internal
+        lock); concurrent sidecar solves serialize its edges on the
+        EncodeCache lock. This mirrors that topology with an explicit
+        edge lock: each thread must always drain exactly the outputs it
+        submitted, and the two-slot window must end the storm empty."""
+        from karpenter_tpu.solver.residency import DispatchQueue
+
+        queue = DispatchQueue()
+        edge_lock = threading.Lock()
+        errors = []
+        barrier = threading.Barrier(2)
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+
+        def pump(tid):
+            try:
+                barrier.wait()
+                for i in range(50):
+                    payload = np.full(4, tid * 1000 + i)
+
+                    def dispatch(p=payload):
+                        return p
+
+                    with edge_lock:
+                        slot = queue.submit(f"t{tid}-{i}", dispatch)
+                    with edge_lock:
+                        out = queue.drain(slot)
+                    assert out is payload, (tid, i)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=pump, args=(t,)) for t in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        finally:
+            sys.setswitchinterval(old_interval)
+        assert not errors, errors
+        assert queue.depth() == 0
+
+
+class TestResidencyHammer:
+    def test_stage_reset_storm_returns_staged_content(self):
+        """Two threads staging disjoint arg names through ONE shared
+        DeviceResidentArgs while interleaving reset(): every stage must
+        hand back buffers equal to the host arrays passed in THAT call,
+        and the buffer/meta maps must never tear (a lost lock here shows
+        up as KeyError or dict-changed-size, the GRD1301 shape)."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from karpenter_tpu.solver.residency import DeviceResidentArgs
+
+        dra = DeviceResidentArgs()
+        errors = []
+        barrier = threading.Barrier(2)
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+
+        def delta(version):
+            # every name falls through _class_of to the static class:
+            # one version counter drives the reuse/restage decision
+            return SimpleNamespace(
+                v_nodes=version, node_rows=None,
+                v_cross=version, cross_rows=None,
+                v_gcount=version, count_rows=None,
+                v_groups=version, group_rows=None,
+                v_static=version,
+            )
+
+        def hammer(tid):
+            try:
+                barrier.wait()
+                names = (f"t{tid}_a", f"t{tid}_b")
+                for i in range(40):
+                    hosts = [
+                        np.full(6, tid * 100 + i, dtype=np.int32),
+                        np.arange(i, i + 5, dtype=np.float32),
+                    ]
+                    d = delta(tid * 1000 + i)
+                    for _attempt in range(2):  # second pass takes reuse
+                        out = dra.stage(names, hosts, d)
+                        for host, buf in zip(hosts, out):
+                            assert np.array_equal(np.asarray(buf), host)
+                    if i % 7 == 6:
+                        dra.reset()
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=hammer, args=(t,)) for t in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+        finally:
+            sys.setswitchinterval(old_interval)
+        assert not errors, errors
+
+
+class TestAuditLogUnderFire:
+    def test_len_and_query_during_record_churn(self):
+        """__len__/query/to_json snapshot under AuditLog._lock: reading
+        the trail while another solve thread appends must never tear,
+        and the final count must equal the records that landed (the
+        obs/audit.py GRD1301 dogfood fix)."""
+        from karpenter_tpu.obs.audit import AuditLog
+
+        log = AuditLog(maxlen=4096, clock=lambda: 0.0)
+        fields = dict(
+            kind="solve", trace_id="t", duration_ms=1.0, encode_hash="h",
+            pods=1, claims=1, errors=0, scenario_count=0, dispatches=1,
+            rung="kernel", guard="ok",
+        )
+        errors = []
+        stop = threading.Event()
+        barrier = threading.Barrier(2)
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+
+        def writer():
+            try:
+                barrier.wait()
+                for _ in range(2000):
+                    log.record(**fields)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        def reader():
+            try:
+                barrier.wait()
+                while not stop.is_set():
+                    n = len(log)
+                    assert 0 <= n <= 4096
+                    log.query(kind="solve")
+                    log.to_json()
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=writer),
+                threading.Thread(target=reader),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+        finally:
+            sys.setswitchinterval(old_interval)
+        assert not errors, errors
+        assert len(log) == 2000
+        assert log.last().decision_id == "d002000"
+
+
+class TestMetricsSnapshotUnderFire:
+    def test_collect_and_render_during_label_churn(self):
+        """collect()/render() snapshot each series map under its metric's
+        lock: a scrape racing an inc() that inserts NEW label keys must
+        never raise (the exact dict-changed-size RuntimeError the GRD1301
+        dogfood found in metrics/registry.py before the snapshot fix)."""
+        from karpenter_tpu.metrics.registry import (
+            Counter, Histogram, Registry,
+        )
+
+        reg = Registry()
+        counter = Counter("conc_test_total", registry=reg)
+        histo = Histogram("conc_test_seconds", registry=reg)
+        errors = []
+        stop = threading.Event()
+        barrier = threading.Barrier(2)
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+
+        def writer():
+            try:
+                barrier.wait()
+                for i in range(4000):
+                    counter.inc({"k": f"v{i % 60}"})
+                    histo.observe(0.001 * (i % 10), {"k": f"v{i % 60}"})
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        def scraper():
+            try:
+                barrier.wait()
+                while not stop.is_set():
+                    reg.collect()
+                    reg.render()
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=writer),
+                threading.Thread(target=scraper),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+        finally:
+            sys.setswitchinterval(old_interval)
+        assert not errors, errors
+        # the final scrape is consistent: every series landed
+        assert counter.value({"k": "v0"}) > 0
+        assert histo.count({"k": "v0"}) > 0

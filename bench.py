@@ -813,10 +813,156 @@ def run_mesh(
     return rows
 
 
+def run_tenants(
+    n_tenants: int = 4,
+    n_pods: int = 200,
+    n_types: int = 100,
+    rounds: int = 4,
+) -> Dict:
+    """Sustained multi-tenant traffic through ONE TenantService (ISSUE
+    20): ``n_tenants`` concurrent control planes each issuing ``rounds``
+    solves of ``n_pods`` pods against their own warm state. Reports
+    aggregate solves/sec, the per-tenant p50/p99 solve latency, and the
+    noisy-neighbor delta — the p50 shift a bystander tenant sees while
+    an extra tenant hammers oversized batches alongside it. The
+    isolation gates ride the row: zero in-process fallbacks, zero
+    admission rejections, every tenant still on the batched rung."""
+    import threading
+
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import TestClock
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.service import TenantService
+    from karpenter_tpu.solver.tenancy import TenantQoS, TenantRegistry
+    from karpenter_tpu.solver.workloads import constrained_mix
+
+    pools = [example_nodepool()]
+    its_by_pool = {pools[0].name: corpus.generate(n_types)}
+    tenants = [f"tenant-{i}" for i in range(n_tenants)]
+
+    def request(n: int) -> bytes:
+        return wire.encode_solve_request(
+            constrained_mix(n), pools, its_by_pool,
+            solver_options={"reserved_capacity_enabled": False},
+        )
+
+    def service() -> TenantService:
+        return TenantService(
+            registry=TenantRegistry(
+                clock=TestClock(),
+                max_inflight=max(32, 2 * n_tenants),
+                qos={
+                    "standard": TenantQoS(
+                        rate=10_000.0, burst=10_000.0,
+                        max_queue=max(32, 2 * n_tenants),
+                    )
+                },
+            )
+        )
+
+    svc = service()
+    reqs = {tid: request(n_pods) for tid in tenants}
+    # warm every tenant's cache + compile outside the timed phase
+    for tid in tenants:
+        svc.solve_for(tid, wire.decode_solve_request(reqs[tid]))
+
+    def drive(extra_noise: bool) -> Dict[str, List[float]]:
+        latencies: Dict[str, List[float]] = {tid: [] for tid in tenants}
+        errors: List[Exception] = []
+        stop = threading.Event()
+        n_threads = n_tenants + (1 if extra_noise else 0)
+        barrier = threading.Barrier(n_threads)
+
+        def tenant_loop(tid):
+            try:
+                barrier.wait()
+                for _ in range(rounds):
+                    snap = wire.decode_solve_request(reqs[tid])
+                    t0 = time.perf_counter()
+                    svc.solve_for(tid, snap)
+                    latencies[tid].append(time.perf_counter() - t0)
+            except Exception as exc:  # pragma: no cover - bench resilience
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        def noise_loop():
+            noisy_req = request(4 * n_pods)
+            try:
+                barrier.wait()
+                while not stop.is_set():
+                    svc.solve_for(
+                        "noisy", wire.decode_solve_request(noisy_req)
+                    )
+            except Exception as exc:  # pragma: no cover - bench resilience
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=tenant_loop, args=(tid,))
+            for tid in tenants
+        ]
+        if extra_noise:
+            threads.append(threading.Thread(target=noise_loop))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        if errors:
+            raise errors[0]
+        latencies["_wall"] = [time.perf_counter() - t0]
+        return latencies
+
+    quiet = drive(extra_noise=False)
+    noisy = drive(extra_noise=True)
+
+    def flat(lat: Dict[str, List[float]]) -> List[float]:
+        return sorted(
+            s for tid, ls in lat.items() if tid != "_wall" for s in ls
+        )
+
+    q = flat(quiet)
+    nz = flat(noisy)
+    p50 = statistics.median(q)
+    noisy_p50 = statistics.median(nz)
+    total_solves = len(q)
+    stats = svc.registry.stats()
+    entry = {
+        "config": "tenants",
+        "tenants": n_tenants,
+        "pods": n_pods,
+        "types": n_types,
+        "solves_per_sec": round(total_solves / quiet["_wall"][0], 2),
+        "best_ms": round(min(q) * 1000, 1),
+        "p50_ms": round(p50 * 1000, 1),
+        "p99_ms": round(q[max(0, int(len(q) * 0.99) - 1)] * 1000, 1),
+        "noisy_p50_ms": round(noisy_p50 * 1000, 1),
+        "noisy_delta_ms": round((noisy_p50 - p50) * 1000, 1),
+        "fallback_solves": sum(
+            s["fallback_solves"] for s in stats if s["tenant"] != "noisy"
+        ),
+        "rejections": sum(
+            s["rejected"] for s in stats if s["tenant"] != "noisy"
+        ),
+        "degraded_tenants": sum(
+            1
+            for tid in tenants
+            if svc.registry.get(tid).health.level() > 0
+        ),
+    }
+    print(
+        "bench[tenants]: "
+        + " ".join(f"{k}={v}" for k, v in entry.items()),
+        file=sys.stderr,
+    )
+    return entry
+
+
 def _entry_key(e: Dict) -> tuple:
     return (
         e.get("config"), e.get("pods"), e.get("types"), e.get("nodes"),
-        e.get("devices"),
+        e.get("devices"), e.get("tenants"),
     )
 
 
@@ -992,6 +1138,18 @@ def main() -> None:
         ):
             sys.exit(1)
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--tenants":
+        # bench.py --tenants [N] [n_pods]: just the multi-tenant
+        # sustained-traffic row, as JSON
+        init_backend()
+        entry = run_tenants(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 4,
+            int(sys.argv[3]) if len(sys.argv) > 3 else 200,
+        )
+        print(json.dumps(entry, indent=1))
+        if entry["fallback_solves"] or entry["degraded_tenants"]:
+            sys.exit(1)
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == "--compare":
         # bench.py --compare old_grid.json [new_grid.json]
         old = sys.argv[2]
@@ -1052,6 +1210,11 @@ def main() -> None:
             grid.append(run_twin(500, minutes=6))
         except Exception as exc:  # pragma: no cover - bench resilience
             print(f"bench: twin row failed: {exc}", file=sys.stderr)
+        # ISSUE 20: multi-tenant sustained traffic at survival scale
+        try:
+            grid.append(run_tenants(2, n_pods=100, n_types=50, rounds=2))
+        except Exception as exc:  # pragma: no cover - bench resilience
+            print(f"bench: tenants row failed: {exc}", file=sys.stderr)
         headline = run_config(
             "constrained", N_HEADLINE_PODS, N_HEADLINE_TYPES, trials=1,
             with_oracle=False,
@@ -1124,6 +1287,13 @@ def main() -> None:
         grid.append(run_twin(2_000, minutes=10))
     except Exception as exc:  # pragma: no cover - bench resilience
         print(f"bench: twin row failed: {exc}", file=sys.stderr)
+
+    # ISSUE 20: multi-tenant sustained traffic — N isolated control
+    # planes through one service, with the noisy-neighbor delta column
+    try:
+        grid.append(run_tenants(4, n_pods=200, n_types=100))
+    except Exception as exc:  # pragma: no cover - bench resilience
+        print(f"bench: tenants row failed: {exc}", file=sys.stderr)
 
     # the north star: 50k constrained pods x 800 types (BASELINE config[2])
     headline = run_config(

@@ -88,6 +88,14 @@ python hack/group_smoke.py
 echo "== mesh smoke (virtual 8-device mesh, parity + warm path) =="
 python hack/mesh_smoke.py
 
+# tenant-isolation smoke (ISSUE 20): two tenants through one resident
+# service under a fixed-seed chaos plan aimed at tenant A — tenant B's
+# decisions must stay byte-identical to its fault-free solo run, its
+# rung must stay `batched`, and A must quarantine then recover on the
+# injected clock — all inside a wall-time budget
+echo "== tenant smoke (noisy-neighbor isolation, fixed seed) =="
+python hack/tenant_smoke.py
+
 # slow lane: the full analysis over every default target, with the
 # stale-suppression audit (STALE001) on, behind a wall-time budget —
 # analyzer-speed regressions fail here before they bloat every local

@@ -1,0 +1,206 @@
+"""Presubmit multi-tenant isolation smoke (ISSUE 20).
+
+One resident solver service, two tenants, a fixed-seed tenant-scoped
+chaos plan aimed at tenant A (kernel dispatch crash, corrupt kernel
+output, corrupt encode delta, service-level solve crash) while tenant B
+keeps solving through the SAME service. The gate:
+
+- tenant B's decisions are BYTE-IDENTICAL to its fault-free solo run,
+  its rung stays ``batched``, and its ``fallback_solves``/``rejected``
+  counters stay 0 (the noisy-neighbor isolation wall);
+- tenant A actually suffered: the corrupt output tripped the invariant
+  guard into quarantine, its rung degraded, and the service-level crash
+  surfaced to its caller;
+- once the faults clear and the breaker cool-down elapses on the
+  injected clock, tenant A re-closes its ladder (recovery);
+- the whole smoke finishes inside a wall-time budget.
+
+Everything is seeded and clock-injected; a failure here is a real
+isolation leak or a ladder regression, not a flake.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if (jax.config.jax_platforms or "axon").split(",")[0] == "axon":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+SEED = 7
+N_ROUNDS = 4
+BUDGET_S = 90.0  # measured ~15 s cold on the fallback host; ~6x headroom
+
+
+def _signature(results):
+    """Order-independent canonical form of a Results — the byte-identity
+    basis (mirrors tests/helpers.decision_signature)."""
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(
+            (n.name, tuple(sorted(p.uid for p in pods)))
+            for n, pods in results.existing_nodes
+        ),
+        sorted(results.pod_errors),
+    )
+
+
+def main() -> int:
+    from karpenter_tpu import faults
+    from karpenter_tpu.cloudprovider import corpus
+    from karpenter_tpu.kube import TestClock
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.solver.driver import SolverConfig
+    from karpenter_tpu.solver.example import example_nodepool
+    from karpenter_tpu.solver.service import TenantService
+    from karpenter_tpu.solver.tenancy import TenantRegistry
+    from karpenter_tpu.solver.workloads import mixed_pods
+
+    t_start = time.perf_counter()
+    pools = [example_nodepool()]
+    its = {pools[0].name: corpus.generate(16)}
+
+    # request bytes encoded ONCE per (tenant, round) — decoding the same
+    # bytes for the chaos run and the baseline pins identical pod uids,
+    # which the byte-identity witness keys on
+    def requests(prefix, sizes):
+        out = []
+        for i, n in enumerate(sizes):
+            pods = mixed_pods(n, seed=SEED + i, gpu_fraction=0.0)
+            for j, p in enumerate(pods):
+                p.metadata.name = f"{prefix}{i}-{j}"
+                p.metadata.uid = f"uid-{prefix}{i}-{j}"
+            out.append(
+                wire.encode_solve_request(
+                    pods,
+                    pools,
+                    its,
+                    solver_options={"reserved_capacity_enabled": False},
+                )
+            )
+        return out
+
+    a_reqs = requests("a", [12 + 2 * i for i in range(N_ROUNDS)])
+    b_reqs = requests("b", [10 + 2 * i for i in range(N_ROUNDS)])
+
+    def chaos_rules(victim):
+        def only_victim(ctx):
+            return ctx.get("tenant") == victim
+
+        def corrupt_fills(outs):
+            outs = list(outs)
+            outs[5] = np.asarray(outs[5]) - 7  # claim_fills negative
+            return tuple(outs)
+
+        return [
+            faults.FaultRule(
+                faults.SOLVER_DISPATCH, times=1, match=only_victim
+            ),
+            # times=2: the guard's first rejection on a warm encoding
+            # takes the delta-fallback half-step (shed + full re-encode
+            # retry); the corruption must persist through the retry to
+            # reach the quarantine leg
+            faults.FaultRule(
+                faults.SOLVER_OUTPUT,
+                mutate=corrupt_fills,
+                times=2,
+                match=only_victim,
+            ),
+            faults.FaultRule(
+                faults.ENCODE_DELTA,
+                mutate=lambda vals: np.asarray(vals) + 13,
+                match=only_victim,
+            ),
+            faults.FaultRule(
+                faults.TENANT_SOLVE, times=1, after=1, match=only_victim
+            ),
+        ]
+
+    # -- fault-free solo baseline for tenant B ----------------------------
+    baseline_svc = TenantService(config=SolverConfig(relax=False))
+    baseline = [
+        _signature(baseline_svc.solve_for("b", wire.decode_solve_request(r)))
+        for r in b_reqs
+    ]
+
+    # -- the chaos run: A's fault plan fires, B keeps solving -------------
+    clock = TestClock()
+    svc = TenantService(
+        registry=TenantRegistry(clock=clock),
+        config=SolverConfig(relax=False),
+    )
+    inj = faults.install(
+        faults.FaultInjector(chaos_rules("a"), seed=SEED, clock=clock)
+    )
+    b_sigs = []
+    a_errors = 0
+    try:
+        for a_req, b_req in zip(a_reqs, b_reqs):
+            try:
+                svc.solve_for("a", wire.decode_solve_request(a_req))
+            except faults.InjectedFault:
+                a_errors += 1
+            b_sigs.append(
+                _signature(svc.solve_for("b", wire.decode_solve_request(b_req)))
+            )
+
+        fired_sites = {s for s, _, _ in inj.log}
+        assert faults.SOLVER_OUTPUT in fired_sites, sorted(fired_sites)
+        assert faults.SOLVER_DISPATCH in fired_sites, sorted(fired_sites)
+        assert faults.TENANT_SOLVE in fired_sites, sorted(fired_sites)
+        a = svc.registry.get("a")
+        assert a.health.quarantines >= 1, "corrupt output never quarantined"
+        assert a.health.level() > 0, "victim's ladder never degraded"
+        assert a_errors >= 1, "service-level crash never surfaced to A"
+
+        b = svc.registry.get("b")
+        assert b_sigs == baseline, (
+            "ISOLATION LEAK: bystander decisions moved under neighbor chaos"
+        )
+        assert b.health.RUNGS[b.health.level()] == "batched", (
+            "bystander rung moved"
+        )
+        assert b.health.quarantines == 0
+        assert b.stats()["fallback_solves"] == 0, b.stats()
+        assert b.stats()["rejected"] == 0, b.stats()
+
+        # -- recovery: faults clear, cool-down elapses, ladder re-closes --
+        inj.clear()
+        clock.step(130.0)  # past the 120 s breaker cool-down
+        recover = svc.solve_for("a", wire.decode_solve_request(a_reqs[0]))
+        assert recover.all_pods_scheduled()
+        assert a.health.level() == 0, "victim never re-closed its ladder"
+    finally:
+        faults.uninstall()
+
+    elapsed = time.perf_counter() - t_start
+    assert elapsed < BUDGET_S, (
+        f"tenant smoke took {elapsed:.1f}s, over the {BUDGET_S:.0f}s budget"
+    )
+    print(
+        f"tenant smoke OK in {elapsed:.1f}s (budget {BUDGET_S:.0f}s):"
+        f" {N_ROUNDS} interleaved rounds, victim"
+        f" quarantines={a.health.quarantines}"
+        f" errors={a_errors} then recovered; bystander byte-identical,"
+        f" rung=batched, fallback_solves=0"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

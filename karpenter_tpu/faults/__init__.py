@@ -32,6 +32,7 @@ drift apart. Instrumented seams: the object store CRUD
 from __future__ import annotations
 
 import random
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -59,6 +60,14 @@ NATIVE_LOAD = "native.load"
 # outputs before the merge — the combined solve must trip the invariant
 # guard and shed to the full exact kernel, never commit
 RELAX_OUTPUT = "solver.relax_output"
+# multi-tenant service seams (solver/tenancy.py + service.py): admission
+# (hit with tenant= ctx — latency rules model admission stalls, error
+# rules model a rejecting policy backend) and the per-tenant solve entry
+# (hit with tenant= ctx inside the tenant's ambient scope — latency
+# rules on the registry clock model deadline overruns, error rules model
+# per-tenant solve crashes)
+TENANT_ADMIT = "tenant.admit"
+TENANT_SOLVE = "tenant.solve"
 
 ALL_SITES = (
     STORE_CREATE, STORE_UPDATE, STORE_DELETE,
@@ -66,7 +75,54 @@ ALL_SITES = (
     SOLVER_DISPATCH, SOLVER_OUTPUT, SOLVER_SCENARIOS,
     ENCODE_DELTA, DISPATCH_QUEUE,
     REMOTE_SOLVE, NATIVE_LOAD, RELAX_OUTPUT,
+    TENANT_ADMIT, TENANT_SOLVE,
 )
+
+# -- ambient context ---------------------------------------------------------
+# Deep sites (ENCODE_DELTA, SOLVER_DISPATCH, RELAX_OUTPUT) fire far below
+# any code that knows WHICH tenant's solve is running. The ambient scope
+# threads that identity down without touching every signature: rules use
+# ``match=lambda ctx: ctx.get("tenant") == "a"`` to pin a fault plan to
+# one tenant. Per-thread (the sidecar's thread pool runs one solve per
+# thread), layered (inner scopes win), and merged into hit/mutate ctx
+# only when an injector is installed — the zero-overhead-when-off
+# contract still costs exactly one module-global None check.
+
+_AMBIENT = threading.local()
+
+
+class ambient:
+    """Context manager layering ambient fault-site context (e.g.
+    ``tenant="a"``) onto every ``hit``/``mutate`` ctx in its dynamic
+    extent, for the current thread. Explicit call-site kwargs win over
+    ambient keys; inner scopes win over outer ones."""
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, **ctx):
+        self._ctx = ctx
+
+    def __enter__(self) -> "ambient":
+        stack = getattr(_AMBIENT, "stack", None)
+        if stack is None:
+            stack = _AMBIENT.stack = []
+        stack.append(self._ctx)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _AMBIENT.stack.pop()
+        return False
+
+
+def ambient_ctx() -> dict:
+    """The current thread's merged ambient context (outer → inner)."""
+    stack = getattr(_AMBIENT, "stack", None)
+    if not stack:
+        return {}
+    merged: dict = {}
+    for frame in stack:
+        merged.update(frame)
+    return merged
 
 
 class InjectedFault(Exception):
@@ -157,6 +213,9 @@ class FaultInjector:
         return True
 
     def hit(self, site: str, **ctx) -> None:
+        amb = ambient_ctx()
+        if amb:
+            ctx = {**amb, **ctx}
         n = self.calls[site] = self.calls.get(site, 0) + 1
         for idx, rule in enumerate(self.rules):
             if rule.site != site or rule.mutate is not None:
@@ -171,6 +230,9 @@ class FaultInjector:
                 # latency-only rule: slept, nothing to raise
 
     def mutate(self, site: str, value, **ctx):
+        amb = ambient_ctx()
+        if amb:
+            ctx = {**amb, **ctx}
         n = self.calls[site] = self.calls.get(site, 0) + 1
         for idx, rule in enumerate(self.rules):
             if rule.site != site or rule.mutate is None:
@@ -255,9 +317,11 @@ def mutate(site: str, value, **ctx):
 __all__ = [
     "FaultInjector", "FaultRule", "InjectedFault",
     "install", "uninstall", "active", "hit", "mutate",
+    "ambient", "ambient_ctx",
     "STORE_CREATE", "STORE_UPDATE", "STORE_DELETE",
     "PROVIDER_CREATE", "PROVIDER_DELETE", "PROVIDER_REGISTER",
     "SOLVER_DISPATCH", "SOLVER_OUTPUT", "SOLVER_SCENARIOS", "RELAX_OUTPUT",
     "ENCODE_DELTA", "DISPATCH_QUEUE",
-    "REMOTE_SOLVE", "NATIVE_LOAD", "ALL_SITES",
+    "REMOTE_SOLVE", "NATIVE_LOAD", "TENANT_ADMIT", "TENANT_SOLVE",
+    "ALL_SITES",
 ]

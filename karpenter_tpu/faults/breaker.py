@@ -163,6 +163,7 @@ class SolverHealth:
         recorder=None,
         failure_threshold: int = 2,
         cooldown: float = 120.0,
+        metric_labels: Optional[Dict[str, str]] = None,
     ):
         self.clock = clock
         self.recorder = recorder
@@ -172,7 +173,21 @@ class SolverHealth:
         self.quarantines = 0
         self.delta_fallbacks = 0
         self._last_level = 0
-        DEGRADATION_RUNG.set(0.0)
+        # multi-tenant service (solver/tenancy.py): each tenant's ladder
+        # publishes its own metric series (tenant=<id>). None keeps the
+        # original unlabeled series — the single-operator deployments and
+        # every existing dashboard/test read those unchanged. Cardinality
+        # stays bounded because TenantRegistry.max_tenants bounds who can
+        # mint a labeled SolverHealth.
+        self._labels = dict(metric_labels) if metric_labels else None
+        DEGRADATION_RUNG.set(0.0, labels=self._labels)
+
+    def _rung_labels(self, rung: str) -> Dict[str, str]:
+        if self._labels is None:
+            return {"rung": rung}
+        merged = {"rung": rung}
+        merged.update(self._labels)
+        return merged
 
     # -- gates --------------------------------------------------------------
 
@@ -199,7 +214,7 @@ class SolverHealth:
         solves clean the rung keeps its standing; if it trips the guard
         again, quarantine() follows as usual."""
         self.delta_fallbacks += 1
-        DELTA_FALLBACKS.inc()
+        DELTA_FALLBACKS.inc(labels=self._labels)
         obs.event("solver.delta_fallback", reason=reason[:200])
         self._publish(
             REASON_SOLVER_DEGRADED,
@@ -211,9 +226,9 @@ class SolverHealth:
         (the violating solve is discarded by the caller, never committed)."""
         self.quarantines += 1
         obs.event("solver.quarantine", rung=rung, reason=reason)
-        QUARANTINES.inc()
+        QUARANTINES.inc(labels=self._labels)
         self.ladder.trip(rung)
-        BREAKER_TRIPS.inc(labels={"rung": rung})
+        BREAKER_TRIPS.inc(labels=self._rung_labels(rung))
         self._publish(
             REASON_SOLVER_QUARANTINED,
             f"solver {rung} rung quarantined: {reason}",
@@ -228,7 +243,7 @@ class SolverHealth:
             # breaker trips land on the open span so a trace of a degraded
             # decision shows exactly which phase tripped which rung
             obs.event("solver.breaker_trip", rung=rung, reason=reason)
-            BREAKER_TRIPS.inc(labels={"rung": rung})
+            BREAKER_TRIPS.inc(labels=self._rung_labels(rung))
             self._publish(
                 REASON_SOLVER_DEGRADED,
                 f"solver {rung} rung opened after repeated failures"
@@ -268,7 +283,7 @@ class SolverHealth:
         # NEXT success would miss its restore announcement
         if probe_succeeded or level > self._last_level:
             self._last_level = level
-        DEGRADATION_RUNG.set(float(level))
+        DEGRADATION_RUNG.set(float(level), labels=self._labels)
 
     # -- checkpoint (sim/twin.py) -------------------------------------------
 
@@ -301,7 +316,7 @@ class SolverHealth:
             b.failures = int(bs["failures"])
             b.trips = int(bs["trips"])
             b._opened_at = float(bs["opened_at"])
-        DEGRADATION_RUNG.set(float(self._level()))
+        DEGRADATION_RUNG.set(float(self._level()), labels=self._labels)
 
     def _publish(self, reason: str, message: str) -> None:
         if self.recorder is None:

@@ -61,9 +61,14 @@ class EncodeCache:
     catalog change (new types, price/availability flips, new limits)
     resets the cache."""
 
-    def __init__(self):
+    def __init__(self, owner: str = ""):
         import threading
 
+        # multi-tenant attribution (solver/tenancy.py): the control plane
+        # this cache's warm state belongs to. Rides the ENCODE_DELTA fault
+        # ctx so chaos plans can pin corrupt-delta rules to one tenant's
+        # leases; "" for single-operator deployments.
+        self.owner = owner
         self._fingerprint = None
         # short content hash of the current catalog fingerprint — the
         # encode_hash every decision audit record carries. Computed once
@@ -78,7 +83,7 @@ class EncodeCache:
         # path) and the device-resident argument store both outlive
         # TpuSolver instances with this cache; a catalog change resets
         # them along with the vocab (lease() below)
-        self.cluster = enc.ClusterEncoding()
+        self.cluster = enc.ClusterEncoding(owner=owner)
         self.device_store = None  # solver/residency.py, built lazily
         # scenario-build warm path (ISSUE 10 satellite): consolidation
         # searches encode a DIFFERENT workload shape than provisioning
@@ -89,7 +94,7 @@ class EncodeCache:
         # searches within a reconcile pass (multi-node then single-node)
         # hit the content-hash REUSE outcome instead of re-paying the
         # ~130 ms cold encode per fresh environment.
-        self.scenario_cluster = enc.ClusterEncoding()
+        self.scenario_cluster = enc.ClusterEncoding(owner=owner)
         self.scenario_device_store = None
         # pure per-node scheduler model inputs (taints, daemon remainder,
         # label requirements) keyed by object resource versions — catalog-
@@ -245,10 +250,12 @@ class EncodeCache:
 
         if scenario:
             if self.scenario_device_store is None:
-                self.scenario_device_store = DeviceResidentArgs()
+                self.scenario_device_store = DeviceResidentArgs(
+                    owner=self.owner
+                )
             return self.scenario_device_store
         if self.device_store is None:
-            self.device_store = DeviceResidentArgs()
+            self.device_store = DeviceResidentArgs(owner=self.owner)
         return self.device_store
 
 
@@ -286,6 +293,10 @@ class SolverConfig:
     # on the exact kernel. None = auto (on for the plain single-device
     # jit path; KTPU_RELAX=0 disables); True/False force.
     relax: Optional[bool] = None
+    # multi-tenant attribution (solver/tenancy.py): which control plane
+    # this solve belongs to. Rides the decision audit records' attrs and
+    # the sidecar's per-tenant spans; "" (single-operator) adds nothing.
+    tenant: str = ""
 
 
 def _clone_existing_node(en):
@@ -491,6 +502,12 @@ class TpuSolver:
             if inj is not None
             else []
         )
+        # per-tenant attribution on the audit trail: a constant per
+        # configured solver, so canonical replay identity is unmoved;
+        # merged into any caller-supplied attrs (scenario error details)
+        attrs = dict(fields.pop("attrs", None) or {})
+        if self.config.tenant:
+            attrs.setdefault("tenant", self.config.tenant)
         obs.AUDIT.record(
             kind=kind,
             trace_id=getattr(sp, "trace_id", ""),
@@ -503,6 +520,7 @@ class TpuSolver:
             fault_sites=fired,
             encode_reused=self.last_encode_reused,
             delta_rows=self.last_delta_rows,
+            attrs=attrs,
             **fields,
         )
 

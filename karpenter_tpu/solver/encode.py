@@ -857,8 +857,12 @@ class ClusterEncoding:
     (the same discipline encode's shared vocab already requires).
     """
 
-    def __init__(self, compact_every: int = 64):
+    def __init__(self, compact_every: int = 64, owner: str = ""):
         self.compact_every = compact_every
+        # multi-tenant attribution (solver/tenancy.py): whose warm banks
+        # these are. Rides the ENCODE_DELTA fault ctx so tenant-scoped
+        # chaos plans can match a specific tenant's encode leases.
+        self.owner = owner
         self._epoch = None
         self._tol_epoch = None
         # content-keyed row banks; values are (last_used_tick, payload)
@@ -1105,7 +1109,7 @@ class ClusterEncoding:
 
         from .. import faults
 
-        faults.hit(faults.ENCODE_DELTA, reused=True, rows=0)
+        faults.hit(faults.ENCODE_DELTA, reused=True, rows=0, owner=self.owner)
         self._maybe_compact()
         return dataclasses.replace(
             self._prior_snap,
@@ -1257,7 +1261,8 @@ class ClusterEncoding:
         self._prior_ntags = self._ntags
         self._prior_tkeys = self._tkeys
         faults.hit(
-            faults.ENCODE_DELTA, reused=False, rows=delta.delta_rows
+            faults.ENCODE_DELTA, reused=False, rows=delta.delta_rows,
+            owner=self.owner,
         )
         self._maybe_compact()
         return delta

@@ -77,9 +77,13 @@ class DeviceResidentArgs:
     ``last_delta_rows``/``last_full_puts`` feed the bench/audit columns.
     """
 
-    def __init__(self):
+    def __init__(self, owner: str = ""):
         import threading
 
+        # multi-tenant attribution (solver/tenancy.py): whose device
+        # buffers these are — rides the ENCODE_DELTA mutate ctx so chaos
+        # plans can corrupt exactly one tenant's deltas
+        self.owner = owner
         # the resident-attribute naming convention (_dev*) is load-bearing:
         # the DTX9xx pass treats loads from it as device values, so any host
         # sink on a buffer between solves is a finding
@@ -233,7 +237,8 @@ class DeviceResidentArgs:
                     # rows — the pre-decode invariant guard must catch the
                     # resulting solve and force a full re-encode
                     vals = faults.mutate(
-                        faults.ENCODE_DELTA, vals, name=name, rows=len(rows)
+                        faults.ENCODE_DELTA, vals, name=name,
+                        rows=len(rows), owner=self.owner,
                     )
                     buf = delta_apply_rows(self._dev_buffers[name], rows, vals)
                     self._dev_buffers[name] = buf

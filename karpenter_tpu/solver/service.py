@@ -1,14 +1,30 @@
-"""Solver sidecar: gRPC service exposing batch Solve over the wire codec.
+"""Solver sidecar: multi-tenant gRPC service over the wire codec.
 
-The TPU-native deployment splits the control plane from the solver: the
-controller process (Go-shaped, level-triggered) ships snapshots over DCN to
-this sidecar, which runs the fused feasibility/packing kernels on its local
-TPU slice and returns packed claims (SURVEY.md §5, BASELINE.json
-north-star). In-process callers keep using TpuSolver directly; RemoteSolver
-is the same seam behind a channel.
+The TPU-native deployment splits the control plane from the solver: each
+controller process (Go-shaped, level-triggered) ships snapshots over DCN
+to this sidecar, which runs the fused feasibility/packing kernels on its
+local TPU slice and returns packed claims (SURVEY.md §5, BASELINE.json
+north-star). In-process callers keep using TpuSolver directly;
+RemoteSolver is the same seam behind a channel.
 
-The service is defined with grpc generic handlers over the msgpack codec in
-wire.py — no generated stubs, one method:
+Many control planes share one sidecar (the multi-tenant service,
+solver/tenancy.py): the tenant id rides the ``ktpu-tenant-id`` request
+metadata, and every tenant gets its OWN warm state (``EncodeCache`` →
+row banks + device buffers) and its OWN degradation ladder — isolation
+machinery, admission control, and QoS tiers live in ``TenantRegistry``.
+Error contract over the hop:
+
+- RESOURCE_EXHAUSTED — admission rejected (rate limit, queue bound,
+  tier shed, tenant capacity). The client must BACK OFF; solving the
+  same view in-process would defeat the quota.
+- DEADLINE_EXCEEDED — the solve ran but blew the tenant's latency
+  budget. The client's retry/fallback ladder treats it like a slow
+  sidecar and falls back in-process.
+- INVALID_ARGUMENT / INTERNAL — malformed request / sidecar bug, as
+  before.
+
+The service is defined with grpc generic handlers over the msgpack codec
+in wire.py — no generated stubs, one method:
 
     /karpenter_tpu.solver.v1.Solver/Solve   (unary-unary, bytes in/out)
 """
@@ -16,12 +32,15 @@ wire.py — no generated stubs, one method:
 from __future__ import annotations
 
 import copy
+import dataclasses
+import hashlib
 import logging
 from concurrent import futures
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 import grpc
+import msgpack
 
 from .. import faults, obs
 from ..api.objects import NodePool, Pod
@@ -30,19 +49,54 @@ from ..kube import Client, TestClock
 from ..scheduling.scheduler import Results
 from ..scheduling.topology import Topology
 from . import wire
-from .driver import DecodedClaim, EncodeCache, SolverConfig, TpuSolver
+from .driver import (
+    DecodedClaim,
+    EncodeCache,
+    Scenario,
+    SolverConfig,
+    TpuSolver,
+)
+from .tenancy import (
+    DEFAULT_TENANT,
+    AdmissionError,
+    CrossTenantBatcher,
+    DeadlineOverrunError,
+    TenantRegistry,
+    TenantState,
+)
 
 _LOG = logging.getLogger("karpenter_tpu.solver.service")
-
-# one process-wide cache: the sidecar serves many solves of one catalog
-_SIDECAR_ENCODE_CACHE = EncodeCache()
 
 SERVICE_NAME = "karpenter_tpu.solver.v1.Solver"
 SOLVE_METHOD = f"/{SERVICE_NAME}/Solve"
 
+# request metadata key carrying the caller's tenant id (lowercase per
+# gRPC metadata rules); absent → the "default" tenant. Tier assignment
+# is SERVER configuration (TenantRegistry), never client metadata.
+TENANT_ID_METADATA_KEY = "ktpu-tenant-id"
+
 # gRPC status codes that mean "the sidecar may answer if asked again" —
 # RemoteSolver retries these once, then degrades to an in-process solve
 RETRIABLE_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+# admission backpressure: retry once after the bounded retry, then RAISE
+# (SolverBackpressure) instead of falling back in-process — the service
+# refused to spend quota on this view, the client must back off
+BACKPRESSURE_CODES = ("RESOURCE_EXHAUSTED",)
+
+
+class SolverBackpressure(RuntimeError):
+    """The sidecar's admission control rejected the solve twice — the
+    caller should requeue with backoff. Deliberately NOT an in-process
+    fallback: the tenant is over quota, not the sidecar unreachable."""
+
+    def __init__(self, tenant: str, detail: str):
+        super().__init__(
+            f"solver sidecar admission backpressure"
+            + (f" for tenant {tenant!r}" if tenant else "")
+            + f": {detail}"
+        )
+        self.tenant = tenant
+        self.detail = detail
 
 
 class InjectedRpcError(grpc.RpcError):
@@ -134,26 +188,259 @@ def _solve_snapshot(data: bytes, config: Optional[SolverConfig]) -> bytes:
     return _solve_decoded(wire.decode_solve_request(data), config)
 
 
-def _solve_decoded(snap: dict, config: Optional[SolverConfig]) -> bytes:
+def _solve_objects(
+    snap: dict,
+    config: Optional[SolverConfig],
+    encode_cache: Optional[EncodeCache] = None,
+):
+    """One decoded snapshot solved end to end; returns ``(results,
+    solver)`` so the tenant layer can read the solver's telemetry
+    (fallback_solves) without re-plumbing it through the wire."""
     pods: List[Pod] = snap["pods"]
-    state_nodes = snap["state_nodes"]
     solver = build_solver(
         pods,
         snap["node_pools"],
         snap["instance_types"],
         snap["daemonset_pods"],
-        state_nodes,
+        snap["state_nodes"],
         snap["volume_objects"],
         # behavior knobs travel in the snapshot so controller and sidecar
         # can never disagree on gate-dependent packing
         bool(snap["solver_options"].get("reserved_capacity_enabled", False)),
         config=config,
-        encode_cache=_SIDECAR_ENCODE_CACHE,
+        encode_cache=encode_cache,
     )
-    results = solver.solve(pods)
+    return solver.solve(pods), solver
+
+
+def _solve_decoded(
+    snap: dict,
+    config: Optional[SolverConfig],
+    encode_cache: Optional[EncodeCache] = None,
+) -> bytes:
+    results, _solver = _solve_objects(snap, config, encode_cache)
     return wire.encode_solve_response(
-        results, state_nodes_packed=len(state_nodes)
+        results, state_nodes_packed=len(snap["state_nodes"])
     )
+
+
+def _batch_key(snap: dict) -> Optional[str]:
+    """Content key under which a snapshot may join a cross-tenant
+    microbatch, or None when its shape must solo-solve.
+
+    Only the shapes whose scenario-batched answer is PROVABLY the solo
+    answer batch: identical catalog sections (hashed below — tenants
+    with different catalogs land in different batches, never a wrong
+    one), no volume objects (the VolumeResolver's scratch store is
+    per-request), no pool limits (a shared kernel ledger would meter
+    the union, not each tenant), and no topology-spread/affinity pods
+    (union topology priors would leak one tenant's bound pods into
+    another's spread counting). Everything else declines to the solo
+    path — a lost batching opportunity, never a lost decision."""
+    if snap["volume_objects"]:
+        return None
+    for np_ in snap["node_pools"]:
+        if getattr(np_.spec, "limits", None):
+            return None
+    for p in snap["pods"]:
+        spec = p.spec
+        if getattr(spec, "topology_spread_constraints", None) or getattr(
+            spec, "affinity", None
+        ):
+            return None
+    for sn in snap["state_nodes"]:
+        # scenario exclusion masks key on provider ids: a node without
+        # one cannot be masked out of the other tenants' scenarios
+        if not getattr(sn, "provider_id", ""):
+            return None
+    payload = msgpack.packb(
+        wire.to_wire(
+            [
+                snap["node_pools"],
+                snap["instance_types"],
+                snap["daemonset_pods"],
+                snap["solver_options"],
+                snap["volume_objects"] is None,  # old-protocol marker
+            ]
+        ),
+        use_bin_type=True,
+    )
+    return hashlib.blake2b(payload, digest_size=12).hexdigest()
+
+
+class TenantService:
+    """Multi-tenant solve orchestration behind the gRPC surface.
+
+    One instance per sidecar process: holds the ``TenantRegistry`` (per-
+    tenant warm state + admission control), the cross-tenant batcher,
+    and the shared-batch-lane ``EncodeCache`` (its OWN isolation domain:
+    a corrupt delta in the batch lane sheds the batch lane, never a
+    tenant's private cache). Also the in-process facade the concurrency
+    storm, the chaos suite, and ``bench.py --tenants`` drive — the gRPC
+    handler is a thin codec shell around ``solve_encoded``."""
+
+    def __init__(
+        self,
+        registry: Optional[TenantRegistry] = None,
+        config: Optional[SolverConfig] = None,
+        batch_window: float = 0.0,
+        batch_max: int = 8,
+    ):
+        self.registry = registry if registry is not None else TenantRegistry()
+        self._base_config = config
+        self.batcher = CrossTenantBatcher(
+            window=batch_window, max_batch=batch_max
+        )
+        self._batch_cache = EncodeCache(owner="__batch__")
+
+    def solve_for(self, tenant_id: str, snap: dict) -> Results:
+        """Admission → (batched | solo) solve → deadline check, with the
+        tenant's ambient fault scope around everything that runs on its
+        behalf. Raises ``AdmissionError`` before any work and
+        ``DeadlineOverrunError`` after a budget-blowing solve."""
+        lease = self.registry.admit(tenant_id)  # AdmissionError propagates
+        tenant = lease.tenant
+        try:
+            t0 = self.registry.clock.now()
+            with faults.ambient(tenant=tenant_id):
+                # chaos seam: per-tenant solve crashes and latency (the
+                # injected-clock sleep is how deadline-overrun plans fire
+                # deterministically)
+                faults.hit(faults.TENANT_SOLVE, tenant=tenant_id)
+                with obs.span(
+                    "tenant.solve", tenant=tenant_id, tier=tenant.qos.tier
+                ):
+                    results, fallbacks = self._solve_admitted(tenant, snap)
+            elapsed = self.registry.clock.now() - t0
+            if elapsed > tenant.qos.solve_deadline:
+                tenant.note_deadline_overrun()
+                raise DeadlineOverrunError(
+                    tenant_id, elapsed, tenant.qos.solve_deadline
+                )
+            tenant.note_solve(fallbacks)
+            return results
+        finally:
+            lease.release()
+
+    def solve_encoded(self, tenant_id: str, snap: dict) -> bytes:
+        results = self.solve_for(tenant_id, snap)
+        return wire.encode_solve_response(
+            results, state_nodes_packed=len(snap["state_nodes"])
+        )
+
+    def _solve_admitted(self, tenant: TenantState, snap: dict):
+        key = None
+        if self.batcher.window > 0 and tenant.health.level() == 0:
+            # a degraded tenant drops out of the shared batch lane: its
+            # rung rides its own ladder, not the batch's
+            key = _batch_key(snap)
+        if key is None:
+            return self._solve_solo(tenant, snap)
+        return self.batcher.solve(
+            key,
+            (tenant, snap),
+            solo=lambda: self._solve_solo(tenant, snap),
+            grouped=self._solve_union,
+        )
+
+    def _solve_solo(self, tenant: TenantState, snap: dict):
+        cfg = (
+            self._base_config
+            if self._base_config is not None
+            else SolverConfig()
+        )
+        cfg = dataclasses.replace(
+            cfg, health=tenant.health, tenant=tenant.tenant_id
+        )
+        results, solver = _solve_objects(snap, cfg, tenant.encode_cache)
+        return results, solver.fallback_solves
+
+    def _solve_union(self, requests):
+        """One scenario-batched dispatch over every participant's solve:
+        union workload + union node set, one ``Scenario`` per tenant
+        activating its pods and masking the other tenants' nodes. Returns
+        per-request ``(results, fallbacks)`` aligned with ``requests``,
+        or None to decline (participants solo-solve). The ``__batch__``
+        ambient scope keeps tenant-pinned fault plans out of the shared
+        lane — isolation is a property of the per-tenant lanes, and a
+        faulted batch lane declines to them."""
+        union_pods: List[Pod] = []
+        seen_uids = set()
+        union_sns: list = []
+        seen_nodes = set()
+        pids_by_req: List[set] = []
+        for _tenant, snap in requests:
+            for p in snap["pods"]:
+                if p.uid in seen_uids:
+                    return None
+                seen_uids.add(p.uid)
+                union_pods.append(p)
+            pids = set()
+            for sn in snap["state_nodes"]:
+                pid = getattr(sn, "provider_id", "") or ""
+                name = sn.node.name if sn.node is not None else pid
+                if not pid or pid in seen_nodes or name in seen_nodes:
+                    return None
+                seen_nodes.add(pid)
+                seen_nodes.add(name)
+                pids.add(pid)
+                union_sns.append(sn)
+            pids_by_req.append(pids)
+        all_pids: set = set()
+        for pids in pids_by_req:
+            all_pids |= pids
+        first = requests[0][1]
+        cfg = (
+            self._base_config
+            if self._base_config is not None
+            else SolverConfig()
+        )
+        cfg = dataclasses.replace(cfg, health=None, tenant="__batch__")
+        solver = build_solver(
+            union_pods,
+            first["node_pools"],
+            first["instance_types"],
+            first["daemonset_pods"],
+            union_sns,
+            first["volume_objects"],  # keyed: all-None or all-empty
+            bool(
+                first["solver_options"].get(
+                    "reserved_capacity_enabled", False
+                )
+            ),
+            config=cfg,
+            encode_cache=self._batch_cache,
+        )
+        scenarios = [
+            Scenario(
+                pods=list(snap["pods"]),
+                excluded_provider_ids=frozenset(all_pids - pids_by_req[i]),
+            )
+            for i, (_tenant, snap) in enumerate(requests)
+        ]
+        with faults.ambient(tenant="__batch__"):
+            outs = solver.solve_scenarios(scenarios)
+        if outs is None:
+            return None
+        per_request = []
+        for (_tenant, snap), res in zip(requests, outs):
+            own = {
+                sn.node.name
+                for sn in snap["state_nodes"]
+                if sn.node is not None
+            }
+            existing = [en for en in res.existing_nodes if en.name in own]
+            per_request.append(
+                (
+                    Results(
+                        new_node_claims=res.new_node_claims,
+                        existing_nodes=existing,
+                        pod_errors=res.pod_errors,
+                    ),
+                    0,
+                )
+            )
+        return per_request
 
 
 class SolverService(grpc.GenericRpcHandler):
@@ -161,12 +448,21 @@ class SolverService(grpc.GenericRpcHandler):
 
     Exceptions map to proper gRPC status codes instead of crashing the
     stream through the generic handler: a request the codec cannot decode
-    is the CLIENT's bug (INVALID_ARGUMENT — retrying it can never help),
-    while a solve that raises is the sidecar's (INTERNAL, retriable by
+    is the CLIENT's bug (INVALID_ARGUMENT — retrying it can never help);
+    admission rejection is RESOURCE_EXHAUSTED (back off); a per-tenant
+    deadline overrun is DEADLINE_EXCEEDED (client falls back in-process);
+    a solve that raises is the sidecar's bug (INTERNAL, retriable by
     policy). RemoteSolver keys its retry/fallback ladder off these."""
 
-    def __init__(self, config: Optional[SolverConfig] = None):
+    def __init__(
+        self,
+        config: Optional[SolverConfig] = None,
+        tenants: Optional[TenantService] = None,
+    ):
         self.config = config
+        self.tenants = (
+            tenants if tenants is not None else TenantService(config=config)
+        )
 
     def _handle(self, request, context):
         # trace context rides the gRPC metadata (obs/trace.py): when the
@@ -174,10 +470,12 @@ class SolverService(grpc.GenericRpcHandler):
         # and parent on the caller's span — so the stitched trace shows
         # the RemoteSolver hop as one tree across both processes
         md = {k: v for k, v in (context.invocation_metadata() or ())}
+        tenant_id = md.get(TENANT_ID_METADATA_KEY) or DEFAULT_TENANT
         with obs.span(
             "sidecar.solve",
             trace_id=md.get(obs.TRACE_ID_METADATA_KEY),
             parent_id=md.get(obs.PARENT_ID_METADATA_KEY),
+            tenant=tenant_id,
         ):
             try:
                 snap = wire.decode_solve_request(request)
@@ -187,7 +485,15 @@ class SolverService(grpc.GenericRpcHandler):
                     f"malformed solve request: {type(exc).__name__}: {exc}",
                 )
             try:
-                return _solve_decoded(snap, self.config)
+                return self.tenants.solve_encoded(tenant_id, snap)
+            except AdmissionError as exc:
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"tenant {tenant_id!r} admission rejected "
+                    f"({exc.reason}): back off and retry",
+                )
+            except DeadlineOverrunError as exc:
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
             except Exception as exc:
                 _LOG.exception("solve failed")
                 context.abort(
@@ -209,11 +515,21 @@ def serve(
     address: str = "127.0.0.1:0",
     config: Optional[SolverConfig] = None,
     max_workers: int = 4,
+    registry: Optional[TenantRegistry] = None,
+    batch_window: float = 0.0,
 ) -> "grpc.Server":
     """Start a solver sidecar; returns the started server. The bound port is
-    available via server._bound_port (set here) when address ends in :0."""
+    available via server._bound_port (set here) when address ends in :0.
+    ``registry`` carries the tenant/QoS configuration (default: a fresh
+    registry with standard-tier defaults — unidentified traffic lands on
+    the "default" tenant); ``batch_window`` > 0 opts into cross-tenant
+    microbatching with that many seconds of batch formation delay."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((SolverService(config),))
+    tenants = TenantService(
+        registry=registry, config=config, batch_window=batch_window
+    )
+    server.add_generic_rpc_handlers((SolverService(config, tenants=tenants),))
+    server._tenant_service = tenants
     port = server.add_insecure_port(address)
     server._bound_port = port
     server.start()
@@ -245,8 +561,13 @@ class RemoteSolver:
     DEADLINE_EXCEEDED get exactly one retry; if the sidecar still doesn't
     answer, the solve degrades to an IN-PROCESS run over the same shipped
     cluster view (``build_solver`` — the sidecar's own recipe), so a gRPC
-    outage slows a reconcile instead of failing it. Any other status
-    (catalog skew, malformed request) propagates: retrying those lies."""
+    outage slows a reconcile instead of failing it. RESOURCE_EXHAUSTED is
+    the one status that gets a retry but NEVER the in-process fallback:
+    it means the sidecar's admission control rejected this tenant, and
+    solving locally would turn the backpressure signal into exactly the
+    overload it exists to prevent — ``SolverBackpressure`` propagates so
+    the caller re-queues the reconcile instead. Any other status (catalog
+    skew, malformed request) propagates: retrying those lies."""
 
     def __init__(
         self,
@@ -261,10 +582,14 @@ class RemoteSolver:
         volume_objects: Sequence = (),
         config: Optional[SolverConfig] = None,
         encode_cache: Optional[EncodeCache] = None,
+        tenant: str = "",
     ):
         self._channel = channel or grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(SOLVE_METHOD)
         self.config = config
+        # identifies this control plane to the sidecar's TenantRegistry;
+        # "" sends no metadata and lands on the "default" tenant
+        self.tenant = tenant
         self.timeout = (
             config.solve_deadline if config is not None else timeout
         )
@@ -291,18 +616,21 @@ class RemoteSolver:
         """The raw RPC with one bounded retry on retriable status codes;
         None when the sidecar is out (callers degrade in-process)."""
         # propagate trace context so the sidecar's spans stitch into the
-        # caller's trace (obs/trace.py; SolverService._handle reads these)
-        metadata = None
+        # caller's trace (obs/trace.py; SolverService._handle reads these),
+        # and the tenant id so the sidecar routes to the right control plane
+        pairs = []
         cur = obs.current_span()
         if cur is not None:
-            metadata = (
-                (obs.TRACE_ID_METADATA_KEY, cur.trace_id),
-                (obs.PARENT_ID_METADATA_KEY, cur.span_id),
-            )
+            pairs.append((obs.TRACE_ID_METADATA_KEY, cur.trace_id))
+            pairs.append((obs.PARENT_ID_METADATA_KEY, cur.span_id))
+        if self.tenant:
+            pairs.append((TENANT_ID_METADATA_KEY, self.tenant))
+        metadata = tuple(pairs) or None
+        last_backpressure: Optional[grpc.RpcError] = None
         for attempt in range(2):
             try:
                 # chaos seam: plans raise InjectedRpcError here to model
-                # channel outages and deadline blowouts
+                # channel outages, deadline blowouts, and admission rejects
                 faults.hit(faults.REMOTE_SOLVE, attempt=attempt)
                 with obs.span("remote.dispatch", attempt=attempt):
                     return self._solve(
@@ -310,12 +638,26 @@ class RemoteSolver:
                     )
             except grpc.RpcError as exc:
                 code = _status_name(exc)
+                if code in BACKPRESSURE_CODES:
+                    # admission rejection: retriable once (the bucket
+                    # refills), but NEVER the in-process fallback
+                    last_backpressure = exc
+                    _LOG.warning(
+                        "solver sidecar rejected tenant %r (attempt %d)",
+                        self.tenant or DEFAULT_TENANT, attempt + 1,
+                    )
+                    continue
+                last_backpressure = None
                 if code not in RETRIABLE_CODES:
                     raise
                 _LOG.warning(
                     "solver sidecar dispatch failed with %s (attempt %d)",
                     code, attempt + 1,
                 )
+        if last_backpressure is not None:
+            raise SolverBackpressure(
+                self.tenant or DEFAULT_TENANT, str(last_backpressure)
+            ) from last_backpressure
         return None
 
     def _solve_in_process(self, pods: Sequence[Pod]) -> Results:
@@ -420,9 +762,10 @@ class RemoteSolver:
 
 
 __all__ = [
-    "SOLVE_METHOD", "SolverService", "serve", "RemoteSolver",
-    "RemoteExistingNode", "InjectedRpcError", "build_solver",
-    "RETRIABLE_CODES",
+    "SOLVE_METHOD", "SolverService", "TenantService", "serve",
+    "RemoteSolver", "RemoteExistingNode", "InjectedRpcError",
+    "SolverBackpressure", "build_solver",
+    "RETRIABLE_CODES", "BACKPRESSURE_CODES", "TENANT_ID_METADATA_KEY",
 ]
 
 
@@ -441,8 +784,22 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="host:port for the gRPC solve endpoint",
     )
     parser.add_argument("--max-workers", type=int, default=4)
+    parser.add_argument(
+        "--max-tenants", type=int, default=16,
+        help="admission-control bound on distinct tenant ids",
+    )
+    parser.add_argument(
+        "--batch-window", type=float, default=0.0,
+        help="cross-tenant microbatch formation window in seconds "
+        "(0 disables batching)",
+    )
     args = parser.parse_args(argv)
-    server = serve(address=args.listen, max_workers=args.max_workers)
+    server = serve(
+        address=args.listen,
+        max_workers=args.max_workers,
+        registry=TenantRegistry(max_tenants=args.max_tenants),
+        batch_window=args.batch_window,
+    )
     print(f"solver sidecar listening on {args.listen}", flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -211,3 +211,25 @@ def snapshot_args(
         has_contrib=bool(snap.g_hcontrib.any() or snap.g_dcontrib.any()),
     )
     return snap.solve_args(a_tzc, res_cap0, a_res), statics
+
+
+def decision_signature(results):
+    """Canonical, order-independent serialization of one solve's decisions
+    (the byte-identity witness shared by the concurrency storm and the
+    multi-tenant isolation suite)."""
+    return (
+        sorted(
+            (
+                c.template.node_pool_name,
+                tuple(sorted(p.uid for p in c.pods)),
+                tuple(sorted(it.name for it in c.instance_type_options)),
+                repr(sorted(map(repr, c.requirements))),
+            )
+            for c in results.new_node_claims
+        ),
+        sorted(
+            (en.name, tuple(sorted(p.uid for p in en.pods)))
+            for en in results.existing_nodes
+        ),
+        sorted(results.pod_errors),
+    )

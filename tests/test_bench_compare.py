@@ -301,6 +301,48 @@ class TestCompareGrids:
         assert all(r["repeat_reused"] for r in rows)
         assert all(r["pods_per_chip_per_sec"] > 0 for r in rows)
 
+    def test_tenants_rows_enforced_and_keyed(self, tmp_path):
+        # ISSUE 20's multi-tenant sustained-traffic rows: keyed by the
+        # tenant count — the 4-tenant row regressing must trip the gate
+        # even when the same-shape 2-tenant row is healthy
+        def tenants_entry(tenants, best_ms):
+            return {
+                "config": "tenants", "tenants": tenants, "pods": 200,
+                "types": 100, "best_ms": best_ms,
+                "solves_per_sec": 1000.0 / best_ms,
+                "p50_ms": best_ms * 1.2, "p99_ms": best_ms * 3,
+                "noisy_delta_ms": 5.0,
+                "fallback_solves": 0, "rejections": 0,
+            }
+
+        old = _write(tmp_path, "old.json", _grid("cpu", [
+            tenants_entry(2, 400.0),
+            tenants_entry(4, 700.0),
+        ]))
+        new_ok = _write(tmp_path, "new_ok.json", _grid("cpu", [
+            tenants_entry(2, 420.0),
+            tenants_entry(4, 730.0),
+        ]))
+        assert compare_grids(old, new_ok) == 0
+        new_bad = _write(tmp_path, "new_bad.json", _grid("cpu", [
+            tenants_entry(2, 400.0),
+            tenants_entry(4, 1400.0),  # only the 4-tenant row regressed
+        ]))
+        assert compare_grids(old, new_bad) == 1
+
+    def test_tenants_row_live(self):
+        """The sustained-traffic row live at a tiny shape: two tenants,
+        zero fallbacks, zero rejections, nobody degraded."""
+        import bench
+
+        entry = bench.run_tenants(2, n_pods=40, n_types=20, rounds=2)
+        assert entry["tenants"] == 2
+        assert entry["fallback_solves"] == 0
+        assert entry["rejections"] == 0
+        assert entry["degraded_tenants"] == 0
+        assert entry["solves_per_sec"] > 0
+        assert entry["p99_ms"] >= entry["p50_ms"] >= 0
+
     def test_cli_entrypoint(self, tmp_path):
         old = _write(tmp_path, "old.json", _grid("tpu", [
             _entry("mixed", 5000, 400, 100.0),

@@ -19,28 +19,10 @@ import pytest
 
 from karpenter_tpu.kube import Client, TestClock
 
-from helpers import make_nodepool, make_pods
+from helpers import decision_signature, make_nodepool, make_pods
 
-
-def _decision_signature(results):
-    """Canonical, order-independent serialization of one solve's decisions
-    (same shape tests/test_delta_encode.py pins for the delta path)."""
-    return (
-        sorted(
-            (
-                c.template.node_pool_name,
-                tuple(sorted(p.uid for p in c.pods)),
-                tuple(sorted(it.name for it in c.instance_type_options)),
-                repr(sorted(map(repr, c.requirements))),
-            )
-            for c in results.new_node_claims
-        ),
-        sorted(
-            (en.name, tuple(sorted(p.uid for p in en.pods)))
-            for en in results.existing_nodes
-        ),
-        sorted(results.pod_errors),
-    )
+# canonical serialization now shared with tests/test_tenants.py
+_decision_signature = decision_signature
 
 
 class TestSharedCacheChurn:
@@ -350,3 +332,134 @@ class TestMetricsSnapshotUnderFire:
         # the final scrape is consistent: every series landed
         assert counter.value({"k": "v0"}) > 0
         assert histo.count({"k": "v0"}) > 0
+
+
+class TestTenantStorm:
+    """N-tenant storm through ONE multi-tenant service: T tenants x K
+    threads of seeded churn, every tenant's decisions byte-identical to
+    its own serial replay, every tenant's warm state clean enough to
+    serve a post-storm probe — contention may cost encode reuse or
+    batching opportunities, never a decision bit."""
+
+    N_TENANTS = 3
+    K_THREADS = 2  # concurrent threads PER tenant
+    N_ITERS = 2
+
+    def test_tenant_storm_byte_identical_per_tenant(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from karpenter_tpu.cloudprovider import corpus
+        from karpenter_tpu.kube import TestClock
+        from karpenter_tpu.solver import wire
+        from karpenter_tpu.solver.driver import SolverConfig
+        from karpenter_tpu.solver.service import TenantService
+        from karpenter_tpu.solver.tenancy import TenantQoS, TenantRegistry
+
+        pools = [make_nodepool()]
+        its = {pools[0].name: corpus.generate(10)}
+        tenants = [f"t{n}" for n in range(self.N_TENANTS)]
+
+        # request bytes encoded ONCE per (tenant, thread, iter): decoding
+        # the same bytes in the storm and the serial replay pins pod uids
+        requests = {}
+        for tn, tid in enumerate(tenants):
+            for k in range(self.K_THREADS):
+                for i in range(self.N_ITERS):
+                    pods = make_pods(
+                        4 + 2 * tn + k + i, cpu="1", memory="1Gi"
+                    )
+                    requests[(tid, k, i)] = wire.encode_solve_request(
+                        pods, pools, its,
+                        solver_options={
+                            "reserved_capacity_enabled": False
+                        },
+                    )
+
+        def service():
+            # generous QoS: the storm measures isolation under
+            # contention, not admission (rejections would fork the
+            # serial comparison)
+            return TenantService(
+                registry=TenantRegistry(
+                    clock=TestClock(),
+                    max_inflight=64,
+                    qos={
+                        "standard": TenantQoS(
+                            rate=1000.0, burst=1000.0, max_queue=64
+                        )
+                    },
+                ),
+                config=SolverConfig(relax=False),
+            )
+
+        # serial oracle: each tenant's requests in order, fresh service
+        serial_svc = service()
+        serial = {
+            key: decision_signature(
+                serial_svc.solve_for(
+                    key[0], wire.decode_solve_request(req)
+                )
+            )
+            for key, req in sorted(requests.items())
+        }
+
+        storm_svc = service()
+        stormed = {}
+        errors = []
+        n_threads = self.N_TENANTS * self.K_THREADS
+        barrier = threading.Barrier(n_threads)
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)  # injected yields
+
+        def churn(tid, k):
+            try:
+                barrier.wait()
+                for i in range(self.N_ITERS):
+                    stormed[(tid, k, i)] = decision_signature(
+                        storm_svc.solve_for(
+                            tid,
+                            wire.decode_solve_request(
+                                requests[(tid, k, i)]
+                            ),
+                        )
+                    )
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        try:
+            threads = [
+                threading.Thread(target=churn, args=(tid, k))
+                for tid in tenants
+                for k in range(self.K_THREADS)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+        finally:
+            sys.setswitchinterval(old_interval)
+        assert not errors, errors
+        assert set(stormed) == set(serial)
+        for key in sorted(serial):
+            assert stormed[key] == serial[key], (
+                f"tenant {key[0]} diverged from its serial replay at {key}"
+            )
+
+        # post-storm probe: every tenant's warm state still serves a
+        # clean solve, rung batched, zero fallbacks, zero overcommit
+        for tid in tenants:
+            probe = make_pods(5, cpu="1", memory="1Gi")
+            req = wire.encode_solve_request(
+                probe, pools, its,
+                solver_options={"reserved_capacity_enabled": False},
+            )
+            results = storm_svc.solve_for(
+                tid, wire.decode_solve_request(req)
+            )
+            assert results.all_pods_scheduled(), results.pod_errors
+            state = storm_svc.registry.get(tid)
+            assert state.health.level() == 0
+            assert state.stats()["fallback_solves"] == 0
+            assert state.stats()["rejected"] == 0
+            assert state.stats()["inflight"] == 0

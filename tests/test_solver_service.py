@@ -560,10 +560,10 @@ class TestRemoteRobustness:
 
         from karpenter_tpu.solver import service as service_mod
 
-        def boom(snap, config):
+        def boom(snap, config, encode_cache=None):
             raise RuntimeError("kernel exploded")
 
-        monkeypatch.setattr(service_mod, "_solve_decoded", boom)
+        monkeypatch.setattr(service_mod, "_solve_objects", boom)
         server = service_mod.serve("127.0.0.1:0")
         try:
             pools = [make_nodepool(name="default")]
